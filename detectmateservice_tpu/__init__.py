@@ -1,0 +1,19 @@
+"""DetectMate TPU: a TPU-native log-processing / anomaly-detection framework.
+
+Package exports match the reference service's public surface
+(reference: src/service/__init__.py) plus the TPU-build factories.
+"""
+from .core import Service
+from .settings import ServiceSettings
+from .engine import Engine, EngineSocketFactory, ZmqPairSocketFactory, InprocQueueSocketFactory
+from .metadata import VERSION as __version__
+
+__all__ = [
+    "Service",
+    "ServiceSettings",
+    "Engine",
+    "EngineSocketFactory",
+    "ZmqPairSocketFactory",
+    "InprocQueueSocketFactory",
+    "__version__",
+]

@@ -1,0 +1,68 @@
+"""``detectmate`` CLI: run one service process.
+
+Parity with the reference CLI (reference: src/service/cli.py:12-65): root
+logging splits records below ERROR to stdout and ERROR+ to stderr (pinned in
+the reference by tests/test_cli_logging_setup.py:21-44); ``--settings`` is
+required, ``--config`` optional; the service runs until Ctrl-C.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import List, Optional
+
+from .core import Service
+from .settings import ServiceSettings
+
+
+class _MaxLevelFilter(logging.Filter):
+    def __init__(self, max_level: int):
+        super().__init__()
+        self.max_level = max_level
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        return record.levelno < self.max_level
+
+
+def setup_logging(level: str = "INFO") -> None:
+    """stdout for < ERROR, stderr for >= ERROR (reference: cli.py:12-32)."""
+    root = logging.getLogger()
+    root.setLevel(level.upper())
+    for handler in list(root.handlers):
+        root.removeHandler(handler)
+    fmt = logging.Formatter("[%(asctime)s] %(levelname)s %(name)s: %(message)s")
+    out_handler = logging.StreamHandler(sys.stdout)
+    out_handler.addFilter(_MaxLevelFilter(logging.ERROR))
+    out_handler.setFormatter(fmt)
+    err_handler = logging.StreamHandler(sys.stderr)
+    err_handler.setLevel(logging.ERROR)
+    err_handler.setFormatter(fmt)
+    root.addHandler(out_handler)
+    root.addHandler(err_handler)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="detectmate", description="Run one DetectMate TPU service process"
+    )
+    parser.add_argument("--settings", required=True, help="service settings YAML")
+    parser.add_argument("--config", default=None, help="component config YAML")
+    args = parser.parse_args(argv)
+
+    settings = ServiceSettings.from_yaml(args.settings)
+    if args.config and not settings.config_file:
+        settings.config_file = args.config
+    setup_logging(settings.log_level)
+
+    service = Service(settings)
+    try:
+        with service:
+            service.run()
+    except KeyboardInterrupt:
+        service.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

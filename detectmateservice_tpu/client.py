@@ -1,0 +1,96 @@
+"""``detectmate-client`` CLI: drive a service's admin HTTP API.
+
+Parity with the reference client (reference: src/service/client.py:27-120):
+subcommands ``start`` / ``stop`` / ``status`` / ``metrics`` /
+``reconfigure [--persist]`` against ``--url``. Uses stdlib urllib — no extra
+dependencies.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, List, Optional
+
+import yaml
+
+
+class DetectMateClient:
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None) -> Any:
+        body = None if payload is None else json.dumps(payload).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + path,
+            data=body,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            if "json" in ctype:
+                return json.loads(raw)
+            return raw.decode("utf-8", errors="replace")
+
+    def start(self) -> Any:
+        return self._request("POST", "/admin/start")
+
+    def stop(self) -> Any:
+        return self._request("POST", "/admin/stop")
+
+    def shutdown(self) -> Any:
+        return self._request("POST", "/admin/shutdown")
+
+    def status(self) -> Any:
+        return self._request("GET", "/admin/status")
+
+    def metrics(self) -> str:
+        return self._request("GET", "/metrics")
+
+    def reconfigure(self, config: dict, persist: bool = False) -> Any:
+        return self._request(
+            "POST", "/admin/reconfigure", {"config": config, "persist": persist}
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="detectmate-client", description="Admin client for DetectMate TPU services"
+    )
+    parser.add_argument("--url", default="http://127.0.0.1:8000", help="service admin URL")
+    sub = parser.add_subparsers(dest="command", required=True)
+    sub.add_parser("start")
+    sub.add_parser("stop")
+    sub.add_parser("shutdown")
+    sub.add_parser("status")
+    sub.add_parser("metrics")
+    reconf = sub.add_parser("reconfigure")
+    reconf.add_argument("config_file", help="YAML file with the new component config")
+    reconf.add_argument("--persist", action="store_true")
+    args = parser.parse_args(argv)
+
+    client = DetectMateClient(args.url)
+    try:
+        if args.command == "reconfigure":
+            with open(args.config_file, "r", encoding="utf-8") as fh:
+                config = yaml.safe_load(fh) or {}
+            result = client.reconfigure(config, persist=args.persist)
+        else:
+            result = getattr(client, args.command)()
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"request failed: {exc}", file=sys.stderr)
+        return 1
+    if isinstance(result, str):
+        print(result)
+    else:
+        print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

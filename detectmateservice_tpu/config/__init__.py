@@ -1,0 +1,11 @@
+from .manager import ConfigManager, ServiceConfig
+from .resolver import ComponentResolver
+from .loader import ComponentLoader, ConfigClassLoader
+
+__all__ = [
+    "ConfigManager",
+    "ServiceConfig",
+    "ComponentResolver",
+    "ComponentLoader",
+    "ConfigClassLoader",
+]

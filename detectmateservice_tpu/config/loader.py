@@ -1,0 +1,88 @@
+"""Dynamic component / config-class loading with a pinned error taxonomy.
+
+Capability parity with the reference's ``ComponentLoader`` and
+``ConfigClassLoader`` (reference: src/service/features/component_loader.py:13-67,
+config_loader.py:17-80):
+
+* import the module path as given, then retry with the library-root prefix
+  (reference: component_loader.py:34-43),
+* instantiate ``cls(config=config)``, or no-arg when config is falsy
+  (reference: component_loader.py:47-50; pinned by
+  tests/test_component_loader/test_component_loader.py:90-139),
+* gate on ``isinstance(instance, CoreComponent)`` /
+  ``issubclass(cls, CoreConfig)`` (reference: component_loader.py:52-56,
+  config_loader.py:49-71),
+* error taxonomy: ImportError for missing modules, AttributeError for missing
+  classes, RuntimeError for contract violations
+  (reference: component_loader.py:58-67).
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import logging
+from typing import Any, Optional, Type
+
+from . import resolver as _resolver_mod
+
+
+def _import_with_fallback(path: str, root: str) -> tuple:
+    """Return (module, class_name); try ``path`` as-is then root-prefixed."""
+    module_path, cls_name = path.rsplit(".", 1)
+    last_exc: Optional[ImportError] = None
+    for candidate in (module_path, f"{root}.{module_path}"):
+        try:
+            return importlib.import_module(candidate), cls_name
+        except ImportError as exc:
+            last_exc = exc
+    raise ImportError(f"cannot import module for component path {path!r}: {last_exc}")
+
+
+class ComponentLoader:
+    def __init__(self, root: Optional[str] = None, logger: Optional[logging.Logger] = None):
+        self._root = root or _resolver_mod.DEFAULT_ROOT
+        self._logger = logger or logging.getLogger(__name__)
+
+    def load_component(self, path: str, config: Any = None) -> Any:
+        """Import, instantiate, and contract-check a component."""
+        from detectmateservice_tpu.library.common.core import CoreComponent
+
+        if "." not in path:
+            raise ImportError(
+                f"component path {path!r} must be dotted (module.ClassName); "
+                "use ComponentResolver for short names"
+            )
+        module, cls_name = _import_with_fallback(path, self._root)
+        cls = getattr(module, cls_name, None)
+        if cls is None:
+            raise AttributeError(f"module {module.__name__!r} has no class {cls_name!r}")
+        try:
+            instance = cls(config=config) if config else cls()
+        except TypeError as exc:
+            raise RuntimeError(f"cannot instantiate component {path!r}: {exc}") from exc
+        if not isinstance(instance, CoreComponent):
+            raise RuntimeError(
+                f"{path!r} resolved to {type(instance).__name__}, which is not a CoreComponent"
+            )
+        self._logger.info("loaded component %s", path)
+        return instance
+
+
+class ConfigClassLoader:
+    def __init__(self, root: Optional[str] = None, logger: Optional[logging.Logger] = None):
+        self._root = root or _resolver_mod.DEFAULT_ROOT
+        self._logger = logger or logging.getLogger(__name__)
+
+    def load_config_class(self, path: str) -> Type:
+        """Import and contract-check a config class (CoreConfig subclass)."""
+        from detectmateservice_tpu.library.common.core import CoreConfig
+
+        if "." not in path:
+            raise ImportError(f"config class path {path!r} must be dotted (module.ClassName)")
+        module, cls_name = _import_with_fallback(path, self._root)
+        cls = getattr(module, cls_name, None)
+        if cls is None:
+            raise AttributeError(f"module {module.__name__!r} has no class {cls_name!r}")
+        if not (inspect.isclass(cls) and issubclass(cls, CoreConfig)):
+            raise RuntimeError(f"{path!r} is not a CoreConfig subclass")
+        return cls

@@ -1,0 +1,124 @@
+"""Component-config lifecycle: load / save / update, lock-guarded.
+
+Capability parity with the reference's ``ConfigManager``
+(reference: src/service/features/config_manager.py:18-130):
+
+* on-disk component config is namespaced *category → ClassName → params*
+  (reference: config_manager.py:12-15, tests/config/detector_config.yaml:1-17),
+* ``load()`` creates-and-saves defaults when the file is missing
+  (reference: config_manager.py:34-46),
+* ``save()`` prefers the config object's ``to_dict()`` to strip defaults
+  (reference: config_manager.py:85-92),
+* ``update()`` re-validates (reference: config_manager.py:118-125),
+* all public methods are RLock-guarded (reference: config_manager.py:28).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from pathlib import Path
+from typing import Any, Dict, Optional, Type
+
+import yaml
+from pydantic import BaseModel, ConfigDict, ValidationError
+
+
+class ConfigError(Exception):
+    """Raised on config load/validate/save failures."""
+
+
+class ServiceConfig(BaseModel):
+    """Loose top-level shape of a component config file.
+
+    The service validates only the category namespacing; strict validation is
+    the component's job (reference: config_manager.py:12-15,53-60).
+    """
+
+    model_config = ConfigDict(extra="allow")
+
+    detectors: Optional[Dict[str, Any]] = None
+    parsers: Optional[Dict[str, Any]] = None
+    readers: Optional[Dict[str, Any]] = None
+
+
+class ConfigManager:
+    """Owns the component config file and its in-memory copy."""
+
+    def __init__(
+        self,
+        config_file: str,
+        config_schema: Optional[Type[BaseModel]] = None,
+        logger: Optional[logging.Logger] = None,
+    ) -> None:
+        self._path = Path(config_file)
+        self._schema = config_schema
+        self._logger = logger or logging.getLogger(__name__)
+        self._lock = threading.RLock()
+        self._config: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    def load(self) -> Dict[str, Any]:
+        """Read + validate the file; create it with defaults if missing."""
+        with self._lock:
+            if not self._path.exists():
+                self._logger.info("config file %s missing; writing defaults", self._path)
+                self._config = self._default_config()
+                self._write(self._config)
+                return dict(self._config)
+            try:
+                with open(self._path, "r", encoding="utf-8") as fh:
+                    data = yaml.safe_load(fh) or {}
+            except (OSError, yaml.YAMLError) as exc:
+                raise ConfigError(f"cannot read config file {self._path}: {exc}") from exc
+            self._config = self._validate(data)
+            return dict(self._config)
+
+    def get(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._config)
+
+    def update(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        """Replace the in-memory config after re-validation."""
+        with self._lock:
+            self._config = self._validate(data)
+            return dict(self._config)
+
+    def save(self, data: Optional[Dict[str, Any]] = None) -> None:
+        """Persist config to disk, stripping defaults where the object can."""
+        with self._lock:
+            payload = self._config if data is None else self._validate(data)
+            to_dict = getattr(payload, "to_dict", None)
+            if callable(to_dict):
+                payload = to_dict()
+            self._write(payload)
+            self._config = dict(payload)
+
+    # ------------------------------------------------------------------
+    def _validate(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        if not isinstance(data, dict):
+            raise ConfigError(f"component config must be a mapping, got {type(data).__name__}")
+        try:
+            ServiceConfig.model_validate(data)
+        except ValidationError as exc:
+            raise ConfigError(f"invalid component config: {exc}") from exc
+        return dict(data)
+
+    def _default_config(self) -> Dict[str, Any]:
+        if self._schema is not None:
+            try:
+                instance = self._schema()
+                to_dict = getattr(instance, "to_dict", None)
+                if callable(to_dict):
+                    return to_dict()
+                return instance.model_dump()
+            except Exception:
+                self._logger.warning("could not build defaults from %s", self._schema)
+        return {}
+
+    def _write(self, data: Dict[str, Any]) -> None:
+        try:
+            self._path.parent.mkdir(parents=True, exist_ok=True)
+            with open(self._path, "w", encoding="utf-8") as fh:
+                yaml.safe_dump(data, fh, sort_keys=False)
+        except OSError as exc:
+            raise ConfigError(f"cannot write config file {self._path}: {exc}") from exc

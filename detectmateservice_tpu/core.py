@@ -1,0 +1,272 @@
+"""Service: the control-plane core wrapping one Engine + one component.
+
+Capability parity with the reference's ``Service`` (reference:
+src/service/core.py:64-436) with one deliberate design change: the reference
+makes ``Service`` *inherit* Engine and pass itself as the Engine's processor
+(reference: core.py:64,155 — noted as a quirk in SURVEY.md §1); here the
+Service *owns* an Engine and hands it a ``LibraryComponentProcessor`` adapter.
+The observable contract is identical: metrics wrap ``process``, ``None``
+means the message is filtered, lifecycle verbs behave the same.
+
+Lifecycle (reference: core.py:213-351): ``run()`` starts the admin server,
+autostarts the engine, parks on an exit event; ``start``/``stop`` wrap the
+Engine and flip the ``engine_running`` metric; ``reconfigure`` updates the
+ConfigManager with optional persistence; ``shutdown`` unparks ``run``.
+Context-manager use calls ``setup_io()`` on enter (the documented
+load-models-here hook, reference: core.py:209-211,424-436) and ``shutdown()``
+on exit.
+
+Improvement over a reference gap (SURVEY.md §2.3): ``reconfigure`` *does*
+re-apply config to the loaded component when the component exposes a
+``reconfigure(dict)`` hook; components without the hook keep running on their
+old config, which is then only visible to new instances — the reference
+silently always did the latter.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from pathlib import Path
+from typing import Any, Dict, Optional, Type
+
+from .config import ComponentLoader, ComponentResolver, ConfigClassLoader, ConfigManager
+from .config.manager import ConfigError
+from .engine import Engine, EngineSocketFactory
+from .engine import metrics as m
+from .library.common.core import CoreComponent, CoreConfig
+from .settings import ServiceSettings
+from .web.server import WebServer
+
+
+class ServiceError(Exception):
+    pass
+
+
+class LibraryComponentProcessor:
+    """Adapter: wraps a CoreComponent with the service-level metrics
+    (reference behavior: core.py:176-206). With no component, echoes input
+    (passthrough, reference: core.py:201-205)."""
+
+    def __init__(self, component: Optional[CoreComponent], labels: Dict[str, str]):
+        self.component = component
+        self._processed_b = m.DATA_PROCESSED_BYTES().labels(**labels)
+        self._processed_l = m.DATA_PROCESSED_LINES().labels(**labels)
+        self._duration = m.PROCESSING_DURATION().labels(**labels)
+        self._batch_hist = m.BATCH_SIZE_HIST().labels(**labels)
+
+    def process(self, data: bytes) -> Optional[bytes]:
+        self._processed_b.inc(len(data))
+        self._processed_l.inc(max(1, data.count(b"\n") + (0 if data.endswith(b"\n") else 1)))
+        with self._duration.time():
+            if self.component is None:
+                return data
+            return self.component.process(data)
+
+    def process_batch(self, batch):
+        """Batched dispatch for accelerator-backed components; falls back to a
+        per-message loop so any component works under micro-batching."""
+        for data in batch:
+            self._processed_b.inc(len(data))
+            self._processed_l.inc(max(1, data.count(b"\n") + (0 if data.endswith(b"\n") else 1)))
+        self._batch_hist.observe(len(batch))
+        with self._duration.time():
+            if self.component is None:
+                return list(batch)
+            batch_fn = getattr(self.component, "process_batch", None)
+            if callable(batch_fn):
+                return batch_fn(batch)
+            return [self.component.process(data) for data in batch]
+
+
+class Service:
+    def __init__(
+        self,
+        settings: ServiceSettings,
+        component_config: Optional[Dict[str, Any]] = None,
+        socket_factory: Optional[EngineSocketFactory] = None,
+    ) -> None:
+        self.settings = settings
+        self.logger = self._setup_logging()
+        self._labels = dict(
+            component_type=settings.component_type,
+            component_id=settings.component_id or "unknown",
+        )
+        self._service_exit_event = threading.Event()
+
+        # admin server constructed here, started in run() (reference: core.py:81)
+        self.web_server = WebServer(self)
+
+        # component-type resolution for non-core types (reference: core.py:85-112)
+        self._component_path: Optional[str] = None
+        if settings.component_type and settings.component_type != "core":
+            resolver = ComponentResolver(logger=self.logger)
+            self._component_path, config_class_path = resolver.resolve(settings.component_type)
+            if not settings.component_config_class and config_class_path:
+                settings.component_config_class = config_class_path
+
+        # config manager (reference: core.py:119-133)
+        self.config_manager: Optional[ConfigManager] = None
+        if settings.config_file:
+            self.config_manager = ConfigManager(
+                settings.config_file, self.get_config_schema(), logger=self.logger
+            )
+            try:
+                component_config = self.config_manager.load()
+            except ConfigError as exc:
+                raise ServiceError(f"cannot load component config: {exc}") from exc
+
+        # component instantiation (reference: core.py:135-152)
+        self.library_component: Optional[CoreComponent] = None
+        if self._component_path:
+            loader = ComponentLoader(logger=self.logger)
+            self.library_component = loader.load_component(
+                self._component_path, component_config
+            )
+
+        self.processor = LibraryComponentProcessor(self.library_component, self._labels)
+        self.engine = Engine(settings, self.processor, socket_factory, self.logger)
+
+        self._running_metric = m.ENGINE_RUNNING().labels(**self._labels)
+        self._starts_metric = m.ENGINE_STARTS().labels(**self._labels)
+        self._running_metric.state("stopped")
+
+    # ------------------------------------------------------------------
+    def get_config_schema(self) -> Type[CoreConfig]:
+        """Dynamic config-class load with CoreConfig fallback
+        (reference: core.py:158-174)."""
+        path = self.settings.component_config_class
+        if path:
+            try:
+                return ConfigClassLoader(logger=self.logger).load_config_class(path)
+            except (ImportError, AttributeError, RuntimeError) as exc:
+                self.logger.warning("cannot load config class %s: %s", path, exc)
+        return CoreConfig
+
+    # -- lifecycle ------------------------------------------------------
+    def setup_io(self) -> None:
+        """Load models / pin params in HBM before traffic
+        (reference hook: core.py:209-211)."""
+        if self.library_component is not None:
+            self.library_component.setup_io()
+        self.logger.info("setup_io: ready to process messages")
+
+    def run(self) -> None:
+        """Blocking main: admin server up, engine (auto)started, park until
+        shutdown (reference: core.py:213-237)."""
+        self.web_server.start()
+        self.logger.info(
+            "HTTP Admin active at %s:%s", self.settings.http_host, self.settings.http_port
+        )
+        if self.settings.engine_autostart:
+            self.logger.info("Auto-starting engine...")
+            self.start()
+        try:
+            self._service_exit_event.wait()
+        finally:
+            self._teardown()
+
+    def start(self) -> str:
+        result = self.engine.start()
+        self._starts_metric.inc()
+        self._running_metric.state("running")
+        return result
+
+    def stop(self) -> None:
+        self.engine.stop()
+        self._running_metric.state("stopped")
+
+    def shutdown(self) -> None:
+        self._service_exit_event.set()
+
+    def _teardown(self) -> None:
+        try:
+            self.stop()
+        except Exception as exc:
+            self.logger.error("engine stop during teardown failed: %s", exc)
+        if self.library_component is not None:
+            try:
+                self.library_component.teardown()
+            except Exception as exc:
+                self.logger.error("component teardown failed: %s", exc)
+        self.web_server.stop()
+        self.logger.info("service shut down")
+
+    # -- admin verbs ----------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        return self._create_status_report()
+
+    def _create_status_report(self) -> Dict[str, Any]:
+        """Status JSON shape pinned by the reference
+        (reference: core.py:280-297,386-421)."""
+        return {
+            "status": {
+                "component_type": self.settings.component_type,
+                "component_id": self.settings.component_id,
+                "running": self.engine.running,
+            },
+            "settings": self.settings.model_dump(mode="json"),
+            "configs": self.config_manager.get() if self.config_manager else {},
+        }
+
+    def reconfigure(self, config_data: Dict[str, Any], persist: bool = False) -> Dict[str, Any]:
+        """Validate + apply new component config; optionally persist
+        (reference: core.py:299-345)."""
+        if self.config_manager is None:
+            raise ServiceError("no config manager: service was started without config_file")
+        if not config_data:
+            return self.config_manager.get()
+        updated = self.config_manager.update(config_data)
+        if persist:
+            self.config_manager.save()
+        hook = getattr(self.library_component, "reconfigure", None)
+        if callable(hook):
+            try:
+                hook(updated)
+                self.logger.info("component reconfigured in place")
+            except Exception as exc:
+                self.logger.error("component reconfigure hook failed: %s", exc)
+        else:
+            self.logger.warning(
+                "component has no reconfigure hook; running instance keeps its old config"
+            )
+        return updated
+
+    # -- context manager (reference: core.py:424-436) -------------------
+    def __enter__(self) -> "Service":
+        self.setup_io()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- logging (reference: core.py:355-384) ---------------------------
+    def _setup_logging(self) -> logging.Logger:
+        name = f"{self.settings.component_type}.{self.settings.component_id}"
+        logger = logging.getLogger(name)
+        logger.setLevel(self.settings.log_level.upper())
+        logger.propagate = False
+        have = {type(h).__name__ + getattr(h, "_dm_tag", "") for h in logger.handlers}
+        fmt = logging.Formatter(
+            "[%(asctime)s] %(levelname)s %(name)s: %(message)s"
+        )
+        if self.settings.log_to_console and "StreamHandlerconsole" not in have:
+            console = logging.StreamHandler(sys.__stdout__)
+            console.setFormatter(fmt)
+            console._dm_tag = "console"  # type: ignore[attr-defined]
+            logger.addHandler(console)
+        if self.settings.log_to_file and "FileHandlerfile" not in have:
+            log_dir = Path(self.settings.log_dir)
+            try:
+                log_dir.mkdir(parents=True, exist_ok=True)
+                file_handler = logging.FileHandler(
+                    log_dir
+                    / f"{self.settings.component_type.replace('.', '_')}_{self.settings.component_id}.log",
+                    delay=True,  # lazy open (reference: core.py:370-374)
+                )
+                file_handler.setFormatter(fmt)
+                file_handler._dm_tag = "file"  # type: ignore[attr-defined]
+                logger.addHandler(file_handler)
+            except OSError as exc:
+                logger.warning("cannot attach file handler: %s", exc)
+        return logger

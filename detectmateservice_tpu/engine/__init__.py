@@ -1,0 +1,26 @@
+from .engine import Engine, EngineException, Processor, BatchProcessor
+from .socket import (
+    EngineSocket,
+    EngineSocketFactory,
+    TransportAgain,
+    TransportClosed,
+    TransportError,
+    TransportTimeout,
+    ZmqPairSocketFactory,
+    InprocQueueSocketFactory,
+)
+
+__all__ = [
+    "Engine",
+    "EngineException",
+    "Processor",
+    "BatchProcessor",
+    "EngineSocket",
+    "EngineSocketFactory",
+    "TransportAgain",
+    "TransportClosed",
+    "TransportError",
+    "TransportTimeout",
+    "ZmqPairSocketFactory",
+    "InprocQueueSocketFactory",
+]

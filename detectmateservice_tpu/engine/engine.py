@@ -1,0 +1,306 @@
+"""Engine: the data-plane runtime.
+
+Capability parity with the reference's ``Engine``
+(reference: src/service/features/engine.py:73-342):
+
+* construction validates the processor, creates the input socket through the
+  factory seam, sets the receive timeout, and dials every output with
+  non-blocking background connects — one bad output logs and continues, a bad
+  *input* closes everything (reference: engine.py:93-129,133-179),
+* the loop is recv → count → process → fan-out; ``None`` from the processor
+  filters the message with no output at all (reference: engine.py:196-264),
+* fan-out retries a non-blocking send up to ``retry_count`` times with a 10 ms
+  sleep, then drops and counts; hard transport errors drop immediately
+  (reference: engine.py:266-302),
+* with no outputs configured, the reply goes back on the input socket
+  (reference: engine.py:249-259),
+* ``stop()`` flags the loop, joins ≤ 2 s, raises ``EngineException`` when the
+  thread will not die, then closes input and outputs; the thread is recreated
+  on restart (reference: engine.py:185-192,304-342).
+
+TPU-first redesign: when ``engine_batch_size > 1`` the loop becomes an
+*accumulate → dispatch* pipeline: up to B messages (or whatever arrived within
+``engine_batch_timeout_ms`` of the first) are handed to the processor's
+``process_batch`` as one list, so a jit-compiled scorer sees fixed-shape
+batches instead of one Python callback per message. Per-message semantics are
+preserved exactly: results come back in order, ``None`` entries are filtered
+per-message, and a lone message still flushes after the batch timeout.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Protocol, runtime_checkable
+
+from ..settings import ServiceSettings
+from . import metrics as m
+from .socket import (
+    EngineSocket,
+    EngineSocketFactory,
+    TransportAgain,
+    TransportError,
+    TransportTimeout,
+    ZmqPairSocketFactory,
+)
+
+
+class EngineException(Exception):
+    """Engine lifecycle failure (reference: engine.py:57)."""
+
+
+@runtime_checkable
+class Processor(Protocol):
+    """Per-message processing contract (reference: engine.py:61-70)."""
+
+    def process(self, data: bytes) -> Optional[bytes]: ...
+
+
+@runtime_checkable
+class BatchProcessor(Protocol):
+    """Batched contract for accelerator-backed processors (TPU addition)."""
+
+    def process_batch(self, data: List[bytes]) -> List[Optional[bytes]]: ...
+
+
+_RETRY_SLEEP_S = 0.01   # reference: engine.py:291
+_STOP_JOIN_S = 2.0      # reference: engine.py:320
+
+
+class Engine:
+    def __init__(
+        self,
+        settings: ServiceSettings,
+        processor: Processor,
+        socket_factory: Optional[EngineSocketFactory] = None,
+        logger: Optional[logging.Logger] = None,
+    ) -> None:
+        if processor is None or not callable(getattr(processor, "process", None)):
+            raise EngineException("processor must provide a callable process(bytes)")
+        self.settings = settings
+        self.processor = processor
+        self.logger = logger or logging.getLogger("engine")
+        self._factory = socket_factory or ZmqPairSocketFactory()
+        self._running = False
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sockets_closed = False
+        self._labels = dict(
+            component_type=settings.component_type,
+            component_id=settings.component_id or "unknown",
+        )
+
+        # input socket (close nothing else exists yet on failure)
+        self._pair_sock: EngineSocket = self._factory.create(
+            settings.engine_addr, self.logger, settings.tls_input
+        )
+        self._pair_sock.recv_timeout = settings.engine_recv_timeout
+
+        # output sockets: background dials; one bad address logs and continues,
+        # but a *setup* crash closes the input socket before re-raising
+        self._out_socks: List[EngineSocket] = []
+        try:
+            self._setup_output_sockets()
+        except Exception:
+            self._pair_sock.close()
+            raise
+
+    # ------------------------------------------------------------------
+    def _setup_output_sockets(self) -> None:
+        for addr in self.settings.out_addr:
+            try:
+                sock = self._factory.create_output(
+                    addr,
+                    self.logger,
+                    self.settings.tls_output if addr.startswith("tls+tcp://") else None,
+                    dial_timeout=self.settings.out_dial_timeout,
+                    buffer_size=self.settings.engine_buffer_size,
+                )
+                self._out_socks.append(sock)
+            except TransportError as exc:
+                self.logger.error("cannot dial output %s: %s (continuing)", addr, exc)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> str:
+        """Start (or restart) the engine loop thread; returns a status string.
+
+        ``stop()`` closes all sockets, so a restart rebuilds them before the
+        loop thread comes back up (the reference recreates only the thread,
+        engine.py:185-192, because its stop also closed the sockets — a
+        restart-after-stop there reads a dead socket; fixed here)."""
+        if self._running:
+            return "already running"
+        if self._sockets_closed:
+            self._pair_sock = self._factory.create(
+                self.settings.engine_addr, self.logger, self.settings.tls_input
+            )
+            self._pair_sock.recv_timeout = self.settings.engine_recv_timeout
+            self._out_socks = []
+            try:
+                self._setup_output_sockets()
+            except Exception:
+                self._pair_sock.close()
+                raise
+            self._sockets_closed = False
+        self._stop_event.clear()
+        self._running = True
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run_loop, name="EngineLoop", daemon=True
+            )
+        self._thread.start()
+        self.logger.info("engine started")
+        return "engine started"
+
+    def stop(self) -> None:
+        if not self._running and self._thread is None:
+            self._close_all()
+            return
+        self._running = False
+        self._stop_event.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=_STOP_JOIN_S)
+            if thread.is_alive():
+                raise EngineException("engine thread did not stop within deadline")
+        self._thread = None
+        self._close_all()
+        self.logger.info("engine stopped")
+
+    def _close_all(self) -> None:
+        self._sockets_closed = True
+        try:
+            self._pair_sock.close()
+        except TransportError:
+            pass
+        for sock in self._out_socks:
+            try:
+                sock.close()
+            except TransportError:
+                pass
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    # -- hot loop -------------------------------------------------------
+    def _run_loop(self) -> None:
+        read_b = m.DATA_READ_BYTES().labels(**self._labels)
+        read_l = m.DATA_READ_LINES().labels(**self._labels)
+        err_c = m.PROCESSING_ERRORS().labels(**self._labels)
+        batch_size = max(1, self.settings.engine_batch_size)
+        batch_fn = getattr(self.processor, "process_batch", None)
+        use_batches = batch_size > 1 and callable(batch_fn)
+        batch_timeout_s = self.settings.engine_batch_timeout_ms / 1000.0
+
+        while self._running and not self._stop_event.is_set():
+            try:
+                raw = self._pair_sock.recv()
+            except TransportTimeout:
+                continue
+            except TransportError as exc:
+                if not self._running:
+                    break
+                self.logger.error("engine recv failed: %s", exc)
+                time.sleep(0.05)  # don't busy-spin a persistently failing socket
+                continue
+            if not raw:
+                continue
+            read_b.inc(len(raw))
+            read_l.inc(max(1, raw.count(b"\n") + (0 if raw.endswith(b"\n") else 1)))
+
+            if not use_batches:
+                try:
+                    out = self.processor.process(raw)
+                except Exception as exc:
+                    err_c.inc()
+                    self.logger.error("process() raised: %s", exc)
+                    continue
+                if out is None:
+                    continue
+                self._send_to_outputs(out)
+                continue
+
+            # micro-batch mode: drain what arrived within the window
+            batch = [raw]
+            deadline = time.monotonic() + batch_timeout_s
+            saved_timeout = self._pair_sock.recv_timeout
+            while len(batch) < batch_size:
+                remaining_ms = (deadline - time.monotonic()) * 1000.0
+                if remaining_ms <= 0:
+                    break
+                self._pair_sock.recv_timeout = max(1, int(remaining_ms))
+                try:
+                    nxt = self._pair_sock.recv()
+                except TransportTimeout:
+                    break
+                except TransportError:
+                    break
+                if nxt:
+                    read_b.inc(len(nxt))
+                    read_l.inc(max(1, nxt.count(b"\n") + (0 if nxt.endswith(b"\n") else 1)))
+                    batch.append(nxt)
+            self._pair_sock.recv_timeout = saved_timeout
+            try:
+                outs = batch_fn(batch)
+            except Exception as exc:
+                err_c.inc(len(batch))
+                self.logger.error("process_batch() raised: %s", exc)
+                continue
+            if len(outs) != len(batch):
+                err_c.inc(len(batch))
+                self.logger.error(
+                    "process_batch() returned %d results for %d inputs", len(outs), len(batch)
+                )
+                continue
+            for out in outs:  # in-order, per-message None filtering
+                if out is not None:
+                    self._send_to_outputs(out)
+
+    # -- fan-out --------------------------------------------------------
+    def _send_to_outputs(self, data: bytes) -> bool:
+        written_b = m.DATA_WRITTEN_BYTES().labels(**self._labels)
+        written_l = m.DATA_WRITTEN_LINES().labels(**self._labels)
+        dropped_b = m.DATA_DROPPED_BYTES().labels(**self._labels)
+        dropped_l = m.DATA_DROPPED_LINES().labels(**self._labels)
+        lines = max(1, data.count(b"\n") + (0 if data.endswith(b"\n") else 1))
+
+        if not self._out_socks:
+            # no outputs: reply on the input pair socket (reference: engine.py:249-259)
+            try:
+                self._pair_sock.send(data)
+                written_b.inc(len(data))
+                written_l.inc(lines)
+                return True
+            except TransportError as exc:
+                self.logger.error("reply on input socket failed: %s", exc)
+                dropped_b.inc(len(data))
+                dropped_l.inc(lines)
+                return False
+
+        any_ok = False
+        wrote_once = False
+        for sock in self._out_socks:
+            sent = False
+            for _ in range(self.settings.engine_retry_count):
+                try:
+                    sock.send(data, block=False)
+                    sent = True
+                    break
+                except TransportAgain:
+                    time.sleep(_RETRY_SLEEP_S)
+                except TransportError as exc:
+                    self.logger.warning("output send failed hard: %s", exc)
+                    break
+            if sent:
+                any_ok = True
+                if not wrote_once:
+                    # written counted once per message, dropped once per
+                    # socket (reference: docs/prometheus.md:46-47)
+                    written_b.inc(len(data))
+                    written_l.inc(lines)
+                    wrote_once = True
+            else:
+                dropped_b.inc(len(data))
+                dropped_l.inc(lines)
+        return any_ok

@@ -1,0 +1,86 @@
+"""Prometheus metric registry helpers.
+
+The reference keeps one module-global registry and needs idempotent metric
+creation because tests build several services per process (reference:
+src/service/core.py:45-52 scans ``REGISTRY._collector_to_names``). We keep a
+private name → collector map instead of scanning private registry state.
+
+Metric names and label sets are the reference's observable contract
+(reference: src/service/core.py:24-61, src/service/features/engine.py:14-54,
+docs/prometheus.md:29-47) and must not change.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Sequence, Type
+
+from prometheus_client import REGISTRY, Counter, Enum, Gauge, Histogram
+
+_LOCK = threading.Lock()
+_CACHE: Dict[str, object] = {}
+
+
+def get_or_create(
+    metric_cls: Type,
+    name: str,
+    documentation: str,
+    labelnames: Sequence[str] = (),
+    **kwargs,
+):
+    """Return the process-wide collector for ``name``, creating it once."""
+    with _LOCK:
+        found = _CACHE.get(name)
+        if found is not None:
+            return found
+        try:
+            metric = metric_cls(name, documentation, labelnames=labelnames, **kwargs)
+        except ValueError:
+            # registered by someone else (e.g. an earlier non-cached path):
+            # locate it in the default registry
+            for collector, names in list(REGISTRY._collector_to_names.items()):
+                if name in names or any(n.startswith(name) for n in names):
+                    _CACHE[name] = collector
+                    return collector
+            raise
+        _CACHE[name] = metric
+        return metric
+
+
+# -- reference metric contract (labels: component_type, component_id) -------
+LABELS = ("component_type", "component_id")
+
+# engine-owned series (reference: engine.py:14-54)
+DATA_READ_BYTES = lambda: get_or_create(Counter, "data_read_bytes_total", "Bytes read from the engine socket", LABELS)
+DATA_READ_LINES = lambda: get_or_create(Counter, "data_read_lines_total", "Lines read from the engine socket", LABELS)
+DATA_WRITTEN_BYTES = lambda: get_or_create(Counter, "data_written_bytes_total", "Bytes written to outputs", LABELS)
+DATA_WRITTEN_LINES = lambda: get_or_create(Counter, "data_written_lines_total", "Lines written to outputs", LABELS)
+DATA_DROPPED_BYTES = lambda: get_or_create(Counter, "data_dropped_bytes_total", "Bytes dropped on slow/dead outputs", LABELS)
+DATA_DROPPED_LINES = lambda: get_or_create(Counter, "data_dropped_lines_total", "Lines dropped on slow/dead outputs", LABELS)
+PROCESSING_ERRORS = lambda: get_or_create(Counter, "processing_errors_total", "Exceptions raised by process()", LABELS)
+
+# service-owned series (reference: core.py:24-61)
+ENGINE_RUNNING = lambda: get_or_create(Enum, "engine_running", "Engine run state", LABELS, states=["running", "stopped"])
+ENGINE_STARTS = lambda: get_or_create(Counter, "engine_starts_total", "Engine starts", LABELS)
+PROCESSING_DURATION = lambda: get_or_create(
+    Histogram,
+    "processing_duration_seconds",
+    "End-to-end process() duration",
+    LABELS,
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+)
+DATA_PROCESSED_BYTES = lambda: get_or_create(Counter, "data_processed_bytes_total", "Bytes handed to process()", LABELS)
+DATA_PROCESSED_LINES = lambda: get_or_create(Counter, "data_processed_lines_total", "Lines handed to process()", LABELS)
+
+# TPU-build additions: per-chip throughput (BASELINE.json north star asks the
+# /metrics endpoint to report per-chip rates; new series, new 'device' label,
+# existing series untouched)
+DEVICE_LABELS = ("component_type", "component_id", "device")
+DEVICE_BATCHES = lambda: get_or_create(Counter, "detector_device_batches_total", "Scored batches per device", DEVICE_LABELS)
+DEVICE_LINES = lambda: get_or_create(Counter, "detector_device_lines_total", "Scored lines per device", DEVICE_LABELS)
+BATCH_SIZE_HIST = lambda: get_or_create(
+    Histogram,
+    "detector_batch_size",
+    "Dispatched micro-batch sizes",
+    LABELS,
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)

@@ -1,0 +1,617 @@
+"""Data-plane transport: pair-socket surface over multiple backends.
+
+The reference's data plane is NNG Pair0 via pynng (reference:
+src/service/features/engine_socket.py:35-78, engine.py:133-179). This build
+has no libnng; the same observable surface — ``listen/dial/send/recv`` with
+receive timeouts, non-blocking sends, background reconnect, drop-don't-block —
+is provided over:
+
+* **zmq DEALER** pairs for ``ipc:// tcp:// inproc://`` (libzmq does background
+  reconnect and bounded buffering natively; DEALER-DEALER is bidirectional 1:1
+  like Pair0),
+* a pure-Python **length-prefixed TLS/TCP** transport for ``tls+tcp://``
+  (real ssl: server cert/key, client CA + server-name verification — parity
+  with the reference's mbedTLS modes, engine_socket.py:60-71, engine.py:165-170),
+* an in-process queue transport for tests,
+* an optional in-tree **C++ transport** (native/transport) loaded when built,
+  with the same surface.
+
+Exception taxonomy maps 1:1 onto pynng's (Timeout / TryAgain / NNGException →
+TransportTimeout / TransportAgain / TransportError), because the engine's
+retry/drop logic is written against it (reference: engine.py:216-218,290-299).
+
+The factory protocol is the seam tests use to inject fakes — kept verbatim
+(reference: engine_socket.py:23-32).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import socket as _stdsocket
+import ssl
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Protocol, runtime_checkable
+
+import zmq
+
+
+class TransportError(Exception):
+    """Base transport failure (maps to pynng.NNGException)."""
+
+
+class TransportTimeout(TransportError):
+    """recv timed out (maps to pynng.Timeout)."""
+
+
+class TransportAgain(TransportError):
+    """Non-blocking send would block (maps to pynng.TryAgain)."""
+
+
+class TransportClosed(TransportError):
+    """Operation on a closed socket."""
+
+
+@runtime_checkable
+class EngineSocket(Protocol):
+    """Minimal socket surface the engine loop uses (reference: engine_socket.py:12-20)."""
+
+    def recv(self) -> bytes: ...
+    def send(self, data: bytes, block: bool = True) -> None: ...
+    def close(self) -> None: ...
+    @property
+    def recv_timeout(self) -> Optional[int]: ...
+    @recv_timeout.setter
+    def recv_timeout(self, ms: Optional[int]) -> None: ...
+
+
+@runtime_checkable
+class EngineSocketFactory(Protocol):
+    """Factory seam (reference: engine_socket.py:23-32). ``create`` returns a
+    listening socket bound to ``addr``; ``create_output`` returns a dialing
+    socket connected (possibly in the background) to ``addr``."""
+
+    def create(self, addr: str, logger: Optional[logging.Logger] = None,
+               tls_config: Optional[object] = None) -> EngineSocket: ...
+
+    def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
+                      tls_config: Optional[object] = None,
+                      dial_timeout: Optional[int] = None,
+                      buffer_size: int = 100) -> EngineSocket: ...
+
+
+def _split_scheme(addr: str) -> tuple:
+    if "://" not in addr:
+        raise TransportError(f"address {addr!r} has no scheme")
+    scheme, rest = addr.split("://", 1)
+    return scheme, rest
+
+
+# ---------------------------------------------------------------------------
+# zmq backend
+# ---------------------------------------------------------------------------
+
+_shared_ctx: Optional[zmq.Context] = None
+_ctx_lock = threading.Lock()
+
+
+def _context() -> zmq.Context:
+    # one process-wide context so inproc:// endpoints are visible everywhere
+    global _shared_ctx
+    with _ctx_lock:
+        if _shared_ctx is None or _shared_ctx.closed:
+            _shared_ctx = zmq.Context.instance()
+        return _shared_ctx
+
+
+class ZmqPairSocket:
+    """DEALER socket with the pair surface. 1:1 bidirectional, background
+    reconnect, bounded HWM buffering; ``send(block=False)`` raises
+    TransportAgain when buffers are full (drop handling is the engine's job,
+    reference: engine.py:286-296)."""
+
+    def __init__(self, sock: zmq.Socket, addr: str, unlink_on_close: Optional[str] = None):
+        self._sock = sock
+        self._addr = addr
+        self._closed = False
+        self._recv_timeout: Optional[int] = None
+        self._unlink_on_close = unlink_on_close
+        self._lock = threading.Lock()
+
+    @property
+    def recv_timeout(self) -> Optional[int]:
+        return self._recv_timeout
+
+    @recv_timeout.setter
+    def recv_timeout(self, ms: Optional[int]) -> None:
+        self._recv_timeout = ms
+        self._sock.setsockopt(zmq.RCVTIMEO, -1 if ms is None else int(ms))
+
+    def recv(self) -> bytes:
+        if self._closed:
+            raise TransportClosed(f"recv on closed socket {self._addr}")
+        try:
+            return self._sock.recv()
+        except zmq.Again as exc:
+            raise TransportTimeout(str(exc) or "recv timeout") from exc
+        except zmq.ZMQError as exc:
+            if self._closed:
+                raise TransportClosed(str(exc)) from exc
+            raise TransportError(str(exc)) from exc
+
+    def send(self, data: bytes, block: bool = True) -> None:
+        if self._closed:
+            raise TransportClosed(f"send on closed socket {self._addr}")
+        try:
+            self._sock.send(data, flags=0 if block else zmq.DONTWAIT)
+        except zmq.Again as exc:
+            raise TransportAgain(str(exc) or "send would block") from exc
+        except zmq.ZMQError as exc:
+            if self._closed:
+                raise TransportClosed(str(exc)) from exc
+            raise TransportError(str(exc)) from exc
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self._sock.close(linger=0)
+        finally:
+            if self._unlink_on_close:
+                try:
+                    os.unlink(self._unlink_on_close)
+                except OSError:
+                    pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ZmqPairSocketFactory:
+    """Default factory (role of the reference's NngPairSocketFactory,
+    engine_socket.py:35-78)."""
+
+    SCHEMES = ("ipc", "tcp", "inproc", "ws")
+
+    def create(self, addr: str, logger: Optional[logging.Logger] = None,
+               tls_config: Optional[object] = None) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme == "tls+tcp":
+            factory = TlsTcpSocketFactory()
+            return factory.create(addr, logger, tls_config)
+        if scheme not in self.SCHEMES:
+            raise TransportError(f"unsupported scheme {scheme!r} in {addr!r}")
+        unlink = None
+        if scheme == "ipc":
+            # unlink a stale ipc file before bind (reference: engine_socket.py:46-54)
+            path = rest
+            if os.path.exists(path):
+                try:
+                    os.unlink(path)
+                    logger.debug("unlinked stale ipc file %s", path)
+                except OSError as exc:
+                    raise TransportError(f"cannot unlink stale ipc file {path}: {exc}") from exc
+            unlink = path
+        if scheme == "tcp":
+            host_port = rest.split("/", 1)[0]
+            if ":" not in host_port:
+                raise TransportError(f"tcp address {addr!r} requires an explicit port")
+        sock = _context().socket(zmq.DEALER)
+        sock.setsockopt(zmq.LINGER, 0)
+        try:
+            sock.bind(addr)
+        except zmq.ZMQError as exc:
+            sock.close(linger=0)  # close on bind failure (reference: engine_socket.py:72-78)
+            raise TransportError(f"cannot listen on {addr}: {exc}") from exc
+        logger.debug("listening on %s", addr)
+        return ZmqPairSocket(sock, addr, unlink_on_close=unlink)
+
+    def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
+                      tls_config: Optional[object] = None,
+                      dial_timeout: Optional[int] = None,
+                      buffer_size: int = 100) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, _ = _split_scheme(addr)
+        if scheme == "tls+tcp":
+            factory = TlsTcpSocketFactory()
+            return factory.create_output(addr, logger, tls_config, dial_timeout, buffer_size)
+        if scheme not in self.SCHEMES:
+            raise TransportError(f"unsupported scheme {scheme!r} in {addr!r}")
+        sock = _context().socket(zmq.DEALER)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.setsockopt(zmq.SNDHWM, max(1, buffer_size))
+        sock.setsockopt(zmq.RCVHWM, max(1, buffer_size))
+        sock.setsockopt(zmq.RECONNECT_IVL, 100)
+        # ZMQ_IMMEDIATE: queue only to live connections so non-blocking sends
+        # to a dead peer raise Again instead of buffering forever — matches
+        # the reference's drop accounting (engine.py:286-296)
+        sock.setsockopt(zmq.IMMEDIATE, 1)
+        try:
+            sock.connect(addr)  # async connect, like nng dial(block=False)
+        except zmq.ZMQError as exc:
+            sock.close(linger=0)
+            raise TransportError(f"cannot dial {addr}: {exc}") from exc
+        logger.debug("dialing %s (background connect)", addr)
+        return ZmqPairSocket(sock, addr)
+
+
+# ---------------------------------------------------------------------------
+# tls+tcp backend: length-prefixed frames over ssl-wrapped TCP
+# ---------------------------------------------------------------------------
+
+_FRAME_HDR = struct.Struct("!I")
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+class _FramedConn:
+    """One established TLS connection with 4-byte length framing."""
+
+    def __init__(self, sock: _stdsocket.socket):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+
+    def send_frame(self, data: bytes) -> None:
+        with self.send_lock:
+            self.sock.sendall(_FRAME_HDR.pack(len(data)) + data)
+
+    def recv_frame(self) -> bytes:
+        hdr = self._recv_exact(_FRAME_HDR.size)
+        (length,) = _FRAME_HDR.unpack(hdr)
+        if length > _MAX_FRAME:
+            raise TransportError(f"oversized frame: {length} bytes")
+        return self._recv_exact(length)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TlsTcpListener:
+    """Server side of tls+tcp://. Accepts any number of dialers (fan-in, like
+    many NNG dialers to one listener) and merges their frames into one recv
+    queue. Replies go to the connection the last message arrived on."""
+
+    def __init__(self, host: str, port: int, ssl_ctx: ssl.SSLContext,
+                 logger: logging.Logger, buffer_size: int = 100):
+        self._logger = logger
+        self._ssl_ctx = ssl_ctx
+        self._rq: "queue.Queue" = queue.Queue(maxsize=max(1, buffer_size))
+        self._conns: List[_FramedConn] = []
+        self._conns_lock = threading.Lock()
+        self._last_conn: Optional[_FramedConn] = None
+        self._closed = threading.Event()
+        self._recv_timeout: Optional[int] = None
+        self._listener = _stdsocket.socket(_stdsocket.AF_INET, _stdsocket.SOCK_STREAM)
+        self._listener.setsockopt(_stdsocket.SOL_SOCKET, _stdsocket.SO_REUSEADDR, 1)
+        try:
+            self._listener.bind((host, port))
+            self._listener.listen(16)
+        except OSError as exc:
+            self._listener.close()
+            raise TransportError(f"cannot listen on tls+tcp://{host}:{port}: {exc}") from exc
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                               name="TlsAccept")
+        self._accept_thread.start()
+
+    @property
+    def recv_timeout(self) -> Optional[int]:
+        return self._recv_timeout
+
+    @recv_timeout.setter
+    def recv_timeout(self, ms: Optional[int]) -> None:
+        self._recv_timeout = ms
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                raw_conn, peer = self._listener.accept()
+            except OSError:
+                return
+            try:
+                tls_conn = self._ssl_ctx.wrap_socket(raw_conn, server_side=True)
+            except (ssl.SSLError, OSError) as exc:
+                self._logger.warning("TLS handshake failed from %s: %s", peer, exc)
+                raw_conn.close()
+                continue
+            conn = _FramedConn(tls_conn)
+            with self._conns_lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._reader_loop, args=(conn,), daemon=True,
+                             name="TlsReader").start()
+
+    def _reader_loop(self, conn: _FramedConn) -> None:
+        try:
+            while not self._closed.is_set():
+                frame = conn.recv_frame()
+                self._rq.put((conn, frame))
+        except (ConnectionError, OSError, TransportError):
+            pass
+        finally:
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            conn.close()
+
+    def recv(self) -> bytes:
+        if self._closed.is_set():
+            raise TransportClosed("recv on closed tls listener")
+        timeout = None if self._recv_timeout is None else self._recv_timeout / 1000.0
+        try:
+            conn, frame = self._rq.get(timeout=timeout)
+        except queue.Empty:
+            raise TransportTimeout("recv timeout")
+        self._last_conn = conn
+        return frame
+
+    def send(self, data: bytes, block: bool = True) -> None:
+        if self._closed.is_set():
+            raise TransportClosed("send on closed tls listener")
+        conn = self._last_conn
+        if conn is None:
+            with self._conns_lock:
+                conn = self._conns[0] if self._conns else None
+        if conn is None:
+            raise TransportAgain("no connected peer")
+        try:
+            conn.send_frame(data)
+        except (ConnectionError, OSError) as exc:
+            raise TransportError(str(exc)) from exc
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            for conn in self._conns:
+                conn.close()
+            self._conns.clear()
+
+
+class TlsTcpDialer:
+    """Client side of tls+tcp:// with background redial (parity with nng
+    dial(block=False) + reconnect, reference: engine.py:148,172-175)."""
+
+    def __init__(self, host: str, port: int, ssl_ctx: ssl.SSLContext,
+                 server_name: Optional[str], logger: logging.Logger,
+                 dial_timeout_ms: Optional[int], buffer_size: int = 100):
+        self._host, self._port = host, port
+        self._ssl_ctx = ssl_ctx
+        self._server_name = server_name or host
+        self._logger = logger
+        self._dial_timeout = (dial_timeout_ms or 1000) / 1000.0
+        self._conn: Optional[_FramedConn] = None
+        self._conn_lock = threading.Lock()
+        self._rq: "queue.Queue" = queue.Queue(maxsize=max(1, buffer_size))
+        self._closed = threading.Event()
+        self._recv_timeout: Optional[int] = None
+        self._dial_thread = threading.Thread(target=self._dial_loop, daemon=True,
+                                             name="TlsDialer")
+        self._dial_thread.start()
+
+    @property
+    def recv_timeout(self) -> Optional[int]:
+        return self._recv_timeout
+
+    @recv_timeout.setter
+    def recv_timeout(self, ms: Optional[int]) -> None:
+        self._recv_timeout = ms
+
+    def _dial_loop(self) -> None:
+        backoff = 0.05
+        while not self._closed.is_set():
+            with self._conn_lock:
+                have = self._conn is not None
+            if have:
+                time.sleep(0.1)
+                continue
+            try:
+                raw = _stdsocket.create_connection((self._host, self._port),
+                                                   timeout=self._dial_timeout)
+                tls = self._ssl_ctx.wrap_socket(raw, server_hostname=self._server_name)
+                conn = _FramedConn(tls)
+                with self._conn_lock:
+                    self._conn = conn
+                threading.Thread(target=self._reader_loop, args=(conn,), daemon=True,
+                                 name="TlsDialReader").start()
+                backoff = 0.05
+            except (OSError, ssl.SSLError):
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+
+    def _reader_loop(self, conn: _FramedConn) -> None:
+        try:
+            while not self._closed.is_set():
+                self._rq.put(conn.recv_frame())
+        except (ConnectionError, OSError, TransportError):
+            pass
+        finally:
+            with self._conn_lock:
+                if self._conn is conn:
+                    self._conn = None
+            conn.close()
+
+    def recv(self) -> bytes:
+        if self._closed.is_set():
+            raise TransportClosed("recv on closed tls dialer")
+        timeout = None if self._recv_timeout is None else self._recv_timeout / 1000.0
+        try:
+            return self._rq.get(timeout=timeout)
+        except queue.Empty:
+            raise TransportTimeout("recv timeout")
+
+    def send(self, data: bytes, block: bool = True) -> None:
+        if self._closed.is_set():
+            raise TransportClosed("send on closed tls dialer")
+        with self._conn_lock:
+            conn = self._conn
+        if conn is None:
+            raise TransportAgain("not connected")
+        try:
+            conn.send_frame(data)
+        except (ConnectionError, OSError) as exc:
+            with self._conn_lock:
+                if self._conn is conn:
+                    self._conn = None
+            raise TransportError(str(exc)) from exc
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        with self._conn_lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+
+def _host_port(rest: str, addr: str) -> tuple:
+    host_port = rest.split("/", 1)[0]
+    if ":" not in host_port:
+        raise TransportError(f"address {addr!r} requires an explicit port")
+    host, port_s = host_port.rsplit(":", 1)
+    try:
+        return host, int(port_s)
+    except ValueError as exc:
+        raise TransportError(f"bad port in {addr!r}") from exc
+
+
+class TlsTcpSocketFactory:
+    """tls+tcp:// factory. The TLS context is fully configured *before* the
+    listener binds / the dialer connects — the ordering the reference pins
+    (reference: tests/test_tls_transport.py:156-188)."""
+
+    def create(self, addr: str, logger: Optional[logging.Logger] = None,
+               tls_config: Optional[object] = None) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "tls+tcp":
+            raise TransportError(f"TlsTcpSocketFactory cannot handle scheme {scheme!r}")
+        if tls_config is None or not getattr(tls_config, "cert_key_file", None):
+            raise TransportError(f"tls+tcp listener {addr!r} requires tls_input.cert_key_file")
+        host, port = _host_port(rest, addr)
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        try:
+            ssl_ctx.load_cert_chain(tls_config.cert_key_file)
+        except (OSError, ssl.SSLError) as exc:
+            raise TransportError(f"cannot load TLS cert/key {tls_config.cert_key_file}: {exc}") from exc
+        return TlsTcpListener(host, port, ssl_ctx, logger)
+
+    def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
+                      tls_config: Optional[object] = None,
+                      dial_timeout: Optional[int] = None,
+                      buffer_size: int = 100) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "tls+tcp":
+            raise TransportError(f"TlsTcpSocketFactory cannot handle scheme {scheme!r}")
+        if tls_config is None or not getattr(tls_config, "ca_file", None):
+            raise TransportError(f"tls+tcp output {addr!r} requires tls_output.ca_file")
+        host, port = _host_port(rest, addr)
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        try:
+            ssl_ctx.load_verify_locations(tls_config.ca_file)
+        except (OSError, ssl.SSLError) as exc:
+            raise TransportError(f"cannot load TLS CA {tls_config.ca_file}: {exc}") from exc
+        server_name = getattr(tls_config, "server_name", None)
+        return TlsTcpDialer(host, port, ssl_ctx, server_name, logger, dial_timeout,
+                            buffer_size)
+
+
+# ---------------------------------------------------------------------------
+# in-process queue backend (test seam; also used by the process-free demo)
+# ---------------------------------------------------------------------------
+
+class _QueuePair:
+    def __init__(self, maxsize: int = 1024):
+        self.a_to_b: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self.b_to_a: "queue.Queue" = queue.Queue(maxsize=maxsize)
+
+
+_inproc_registry: Dict[str, _QueuePair] = {}
+_inproc_lock = threading.Lock()
+
+
+class InprocQueueSocket:
+    def __init__(self, addr: str, rq: "queue.Queue", sq: "queue.Queue"):
+        self._addr = addr
+        self._rq, self._sq = rq, sq
+        self._closed = False
+        self._recv_timeout: Optional[int] = None
+
+    @property
+    def recv_timeout(self) -> Optional[int]:
+        return self._recv_timeout
+
+    @recv_timeout.setter
+    def recv_timeout(self, ms: Optional[int]) -> None:
+        self._recv_timeout = ms
+
+    def recv(self) -> bytes:
+        if self._closed:
+            raise TransportClosed(f"recv on closed {self._addr}")
+        timeout = None if self._recv_timeout is None else self._recv_timeout / 1000.0
+        try:
+            return self._rq.get(timeout=timeout)
+        except queue.Empty:
+            raise TransportTimeout("recv timeout")
+
+    def send(self, data: bytes, block: bool = True) -> None:
+        if self._closed:
+            raise TransportClosed(f"send on closed {self._addr}")
+        try:
+            self._sq.put(data, block=block)
+        except queue.Full:
+            raise TransportAgain("send queue full")
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class InprocQueueSocketFactory:
+    """Queue-based factory for tests and single-process demos."""
+
+    def __init__(self, maxsize: int = 1024):
+        self._maxsize = maxsize
+
+    def _pair(self, addr: str) -> _QueuePair:
+        with _inproc_lock:
+            pair = _inproc_registry.get(addr)
+            if pair is None:
+                pair = _QueuePair(self._maxsize)
+                _inproc_registry[addr] = pair
+            return pair
+
+    def create(self, addr: str, logger: Optional[logging.Logger] = None,
+               tls_config: Optional[object] = None) -> EngineSocket:
+        pair = self._pair(addr)
+        return InprocQueueSocket(addr, rq=pair.a_to_b, sq=pair.b_to_a)
+
+    def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
+                      tls_config: Optional[object] = None,
+                      dial_timeout: Optional[int] = None,
+                      buffer_size: int = 100) -> EngineSocket:
+        pair = self._pair(addr)
+        return InprocQueueSocket(addr, rq=pair.b_to_a, sq=pair.a_to_b)

@@ -1,0 +1,2 @@
+"""In-tree component library (role of the out-of-tree ``detectmatelibrary``
+PyPI package in the reference, pyproject.toml:10; surface per SURVEY.md §2.9)."""

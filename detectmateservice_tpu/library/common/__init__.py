@@ -1,0 +1,7 @@
+from .core import CoreComponent, CoreConfig, LibraryError, AutoConfigError, MethodTypeError
+from .detector import CoreDetector, CoreDetectorConfig, InstanceConfig, Variable, HeaderVariable
+
+__all__ = [
+    "CoreComponent", "CoreConfig", "LibraryError", "AutoConfigError", "MethodTypeError",
+    "CoreDetector", "CoreDetectorConfig", "InstanceConfig", "Variable", "HeaderVariable",
+]

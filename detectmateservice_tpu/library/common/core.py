@@ -1,0 +1,170 @@
+"""Base component contract: ``CoreComponent`` + ``CoreConfig``.
+
+Capability parity with the reference library's base surface (the library is
+out-of-tree PyPI in the reference; its contract is reconstructed from
+docs/interfaces.md:5-83 and the service tests,
+tests/test_component_loader/test_detectmatelibrary_import.py:12-27):
+
+* ``CoreComponent(name=None, config=None)`` with ``process(bytes) -> bytes|None``,
+* ``CoreConfig`` is a pydantic model with a ``start_id`` field and
+  ``from_dict`` / ``to_dict``,
+* config normalization semantics (docs/interfaces.md:74-82): ``auto_config``
+  gate, ``method_type`` check, ``all_``-prefix parameter broadcast, and
+  flattening of the ``params`` sub-dict into the top level.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Type
+
+from pydantic import BaseModel, ConfigDict, ValidationError
+
+CATEGORIES = ("detectors", "parsers", "readers")
+
+
+class LibraryError(Exception):
+    """Base error for component-library failures."""
+
+
+class AutoConfigError(LibraryError):
+    """auto_config is disabled but no usable parameters were provided
+    (reference contract: docs/interfaces.md:74)."""
+
+
+class MethodTypeError(LibraryError):
+    """Configured method_type does not match the component
+    (reference contract: docs/interfaces.md:76)."""
+
+
+class CoreConfig(BaseModel):
+    """Base configuration model for all components."""
+
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+    method_type: str = "core"
+    auto_config: bool = True
+    start_id: int = 0
+    params: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any], name: Optional[str] = None) -> "CoreConfig":
+        """Build a config from the namespaced on-disk shape.
+
+        Accepts either the full *category → ClassName → params* document or
+        the already-extracted per-component mapping, then applies the
+        normalization pipeline (docs/interfaces.md:74-82): auto_config gate,
+        method_type check, ``all_`` broadcast, params flattening.
+        """
+        section = _extract_section(data, name)
+        section = normalize_config(dict(section), expected_method_type=_expected_method_type(cls))
+        try:
+            return cls.model_validate(section)
+        except ValidationError as exc:
+            raise LibraryError(f"invalid config for {name or cls.__name__}: {exc}") from exc
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Dump with defaults stripped (used by ConfigManager.save,
+        reference: src/service/features/config_manager.py:85-92)."""
+        return self.model_dump(exclude_defaults=True, by_alias=True)
+
+
+def _expected_method_type(cls: Type[CoreConfig]) -> Optional[str]:
+    field = cls.model_fields.get("method_type")
+    if field is not None and isinstance(field.default, str) and field.default != "core":
+        return field.default
+    return None
+
+
+def _extract_section(data: Dict[str, Any], name: Optional[str]) -> Dict[str, Any]:
+    if not isinstance(data, dict):
+        raise LibraryError(f"config must be a mapping, got {type(data).__name__}")
+    for category in CATEGORIES:
+        block = data.get(category)
+        if isinstance(block, dict):
+            if name and name in block:
+                return block[name]
+            if len(block) == 1:
+                return next(iter(block.values())) or {}
+    return data
+
+
+def normalize_config(section: Dict[str, Any], expected_method_type: Optional[str] = None) -> Dict[str, Any]:
+    """Apply the reference library's config normalization pipeline."""
+    method_type = section.get("method_type")
+    if expected_method_type and method_type and method_type != expected_method_type:
+        raise MethodTypeError(
+            f"method_type {method_type!r} does not match expected {expected_method_type!r}"
+        )
+    auto_config = section.get("auto_config", True)
+    params = section.get("params") or {}
+    has_structure = any(
+        section.get(k) for k in ("events", "global", "variables", "header_variables")
+    )
+    meaningful = {k for k in section if k not in ("method_type", "auto_config", "params")}
+    if not auto_config and not params and not has_structure and not meaningful:
+        raise AutoConfigError(
+            "auto_config is disabled but no parameters were provided"
+        )
+    # ``all_`` broadcast: all_<key> in params becomes <key>, pushed down into
+    # every variable/instance params block that does not already set it
+    broadcast = {k[len("all_"):]: v for k, v in params.items() if k.startswith("all_")}
+    params = {k: v for k, v in params.items() if not k.startswith("all_")}
+    if broadcast:
+        params.update({k: v for k, v in broadcast.items() if k not in params})
+        for events_key in ("events", "global"):
+            block = section.get(events_key)
+            if isinstance(block, dict):
+                _push_down_params(block, broadcast)
+    # flatten: top level absorbs params, params key removed
+    flattened = dict(section)
+    flattened.pop("params", None)
+    for key, value in params.items():
+        flattened.setdefault(key, value)
+    return flattened
+
+
+def _push_down_params(node: Any, broadcast: Dict[str, Any]) -> None:
+    """Recursively seed every variables/header_variables params block with the
+    broadcast values (without overriding explicit per-variable params)."""
+    if not isinstance(node, dict):
+        return
+    for var_key in ("variables", "header_variables"):
+        var_list = node.get(var_key)
+        if isinstance(var_list, list):
+            for var in var_list:
+                if isinstance(var, dict):
+                    var_params = var.setdefault("params", {})
+                    for k, v in broadcast.items():
+                        var_params.setdefault(k, v)
+    for value in node.values():
+        if isinstance(value, dict):
+            _push_down_params(value, broadcast)
+
+
+class CoreComponent:
+    """Base processing component (reference contract: docs/interfaces.md:5-44)."""
+
+    config_class: Type[CoreConfig] = CoreConfig
+    category: str = "core"
+
+    def __init__(self, name: Optional[str] = None, config: Any = None) -> None:
+        self.name = name or type(self).__name__
+        if isinstance(config, dict):
+            config = self.config_class.from_dict(config, self.name)
+        elif config is None:
+            config = self.config_class()
+        elif not isinstance(config, CoreConfig):
+            raise LibraryError(
+                f"config must be a dict or CoreConfig, got {type(config).__name__}"
+            )
+        self.config = config
+
+    def process(self, data: bytes) -> Optional[bytes]:
+        """Process one message; ``None`` filters it (no output is sent)."""
+        raise NotImplementedError
+
+    def setup_io(self) -> None:
+        """Hook for expensive IO/model loading (reference: core.py:209-211)."""
+
+    def teardown(self) -> None:
+        """Hook for releasing resources."""

@@ -1,0 +1,191 @@
+"""Detector base: train-then-detect streaming components.
+
+Capability parity with the reference library's
+``detectmatelibrary.common.detector`` surface (reconstructed from
+docs/interfaces.md:141-204, tests/test_reconfigure_params.py:10, and the demo
+semantics in docs/getting_started.md:420-434):
+
+* ``CoreDetector(name, buffer_mode, config)`` with overridable
+  ``train(input_)`` and ``detect(input_, output_) -> bool``,
+* config structure *events → EventID → instance → {params, variables
+  [{pos,name,params}], header_variables [{pos,params}]}* plus a ``global``
+  scope applying to every event
+  (reference: container/config/detector_config.yaml,
+  tests/config/detector_config.yaml),
+* the first ``data_use_training`` messages only train (and are filtered);
+  afterwards ``detect`` runs and a ``DetectorSchema`` alert is emitted only
+  when it returns True — "no detection" produces no output at all (pinned in
+  the reference by pynng.Timeout assertions,
+  tests/library_integration/test_detector_integration.py:85-87).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from ...schemas import DetectorSchema, ParserSchema, SchemaError
+from ..utils.data_buffer import BufferMode, DataBuffer
+from .core import CoreComponent, CoreConfig, LibraryError
+
+
+class Variable(BaseModel):
+    """A positional variable watched by a detector instance (``pos`` indexes
+    into ``ParserSchema.variables``)."""
+
+    model_config = ConfigDict(extra="allow")
+    pos: Union[int, str]
+    name: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+    @property
+    def label(self) -> str:
+        return self.name if self.name is not None else str(self.pos)
+
+
+class HeaderVariable(BaseModel):
+    """A named variable watched via ``ParserSchema.logFormatVariables``."""
+
+    model_config = ConfigDict(extra="allow")
+    pos: str
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+    @property
+    def label(self) -> str:
+        return self.pos
+
+
+class InstanceConfig(BaseModel):
+    """One named detector instance within an event (or global) scope."""
+
+    model_config = ConfigDict(extra="allow")
+    params: Dict[str, Any] = Field(default_factory=dict)
+    variables: List[Variable] = Field(default_factory=list)
+    header_variables: List[HeaderVariable] = Field(default_factory=list)
+
+    def get_all(self) -> Dict[str, Union[Variable, HeaderVariable]]:
+        """All watched fields keyed by label (reference usage:
+        docs/interfaces.md:187)."""
+        out: Dict[str, Union[Variable, HeaderVariable]] = {}
+        for var in self.variables:
+            out[var.label] = var
+        for hvar in self.header_variables:
+            out[hvar.label] = hvar
+        return out
+
+
+class CoreDetectorConfig(CoreConfig):
+    method_type: str = "core_detector"
+    data_use_training: int = 0
+    events: Dict[Union[int, str], Dict[str, InstanceConfig]] = Field(default_factory=dict)
+    global_: Dict[str, InstanceConfig] = Field(default_factory=dict, alias="global")
+
+    def event_instances(self, event_id: Any) -> Dict[str, InstanceConfig]:
+        """Instances for one event id (int/str keys both accepted)."""
+        for key in (event_id, str(event_id)):
+            if key in self.events:
+                return self.events[key]
+        try:
+            as_int = int(event_id)
+        except (TypeError, ValueError):
+            return {}
+        return self.events.get(as_int, {})
+
+
+class CoreDetector(CoreComponent):
+    """Streaming detector: deserialize → (train | detect) → alert | None."""
+
+    config_class = CoreDetectorConfig
+    category = "detectors"
+    description = "CoreDetector base class."
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        buffer_mode: BufferMode = BufferMode.NO_BUF,
+        config: Any = None,
+    ) -> None:
+        super().__init__(name=name, config=config)
+        self.config: CoreDetectorConfig
+        self.buffer_mode = buffer_mode
+        self._buffer = DataBuffer() if buffer_mode == BufferMode.FIXED else None
+        self._trained = 0
+        self._alert_ids = itertools.count(int(getattr(self.config, "start_id", 0)))
+
+    # -- overridables ---------------------------------------------------
+    def train(self, input_: Union[ParserSchema, List[ParserSchema]]) -> None:
+        """Consume training messages (first ``data_use_training`` messages)."""
+
+    def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
+        """Populate ``output_`` and return True to emit an alert."""
+        raise NotImplementedError
+
+    # -- engine contract ------------------------------------------------
+    def process(self, data: bytes) -> Optional[bytes]:
+        try:
+            input_ = ParserSchema.from_bytes(data)
+        except SchemaError as exc:
+            raise LibraryError(f"{self.name}: cannot deserialize ParserSchema: {exc}") from exc
+        return self.process_parsed(input_)
+
+    def process_parsed(self, input_: ParserSchema) -> Optional[bytes]:
+        if self._trained < self.config.data_use_training:
+            self.train(input_)
+            self._trained += 1
+            return None
+        output_ = self.make_output(input_)
+        if self.detect(input_, output_):
+            return output_.serialize()
+        return None
+
+    def make_output(self, input_: ParserSchema) -> DetectorSchema:
+        """Prefill a DetectorSchema alert skeleton (field semantics per the
+        demo record in the reference, docs/getting_started.md:505-510)."""
+        now = int(time.time())
+        output_ = DetectorSchema()
+        output_["detectorID"] = self.name
+        output_["detectorType"] = self.config.method_type
+        output_["alertID"] = str(next(self._alert_ids))
+        output_["detectionTimestamp"] = now
+        output_["receivedTimestamp"] = now
+        if input_.get("logID"):
+            output_["logIDs"] = [input_["logID"]]
+        ts = self.extract_timestamp(input_)
+        output_["extractedTimestamps"] = [ts if ts is not None else now]
+        output_["description"] = self.description
+        return output_
+
+    @staticmethod
+    def extract_timestamp(input_: ParserSchema) -> Optional[int]:
+        for key in ("Time", "time", "timestamp"):
+            value = dict(input_["logFormatVariables"]).get(key)
+            if value:
+                try:
+                    return int(float(value))
+                except ValueError:
+                    return None
+        if input_.get("receivedTimestamp"):
+            return int(input_["receivedTimestamp"])
+        return None
+
+    # -- shared helpers for concrete detectors --------------------------
+    def iter_scopes(self, input_: ParserSchema):
+        """Yield (scope_label, instance_name, InstanceConfig) for the global
+        scope and the event scope matching ``input_.EventID``."""
+        for inst_name, inst in self.config.global_.items():
+            yield "Global", inst_name, inst
+        event_id = input_.get("EventID")
+        for inst_name, inst in self.config.event_instances(event_id).items():
+            yield f"Event {event_id}", inst_name, inst
+
+    @staticmethod
+    def field_value(input_: ParserSchema, var: Union[Variable, HeaderVariable]) -> Optional[str]:
+        """Resolve a watched field's value from a parsed message."""
+        if isinstance(var, HeaderVariable) or isinstance(var.pos, str):
+            return dict(input_["logFormatVariables"]).get(str(var.pos))
+        variables = list(input_["variables"])
+        if 0 <= var.pos < len(variables):
+            return variables[var.pos]
+        return None

@@ -1,0 +1,13 @@
+from .new_value_detector import (
+    NewValueDetector,
+    NewValueDetectorConfig,
+    NewValueComboDetector,
+    NewValueComboDetectorConfig,
+)
+from .random_detector import RandomDetector, RandomDetectorConfig
+
+__all__ = [
+    "NewValueDetector", "NewValueDetectorConfig",
+    "NewValueComboDetector", "NewValueComboDetectorConfig",
+    "RandomDetector", "RandomDetectorConfig",
+]

@@ -1,0 +1,146 @@
+"""NewValueDetector: flag values never seen during training.
+
+Capability parity with the reference library's
+``detectors.new_value_detector.NewValueDetector`` (+``NewValueComboDetector``,
+referenced at src/service/features/component_loader.py:22). Semantics from
+docs/getting_started.md:420-434 and the demo alert record at
+docs/getting_started.md:505-510:
+
+* during the first ``data_use_training`` messages every watched field's value
+  is learned; afterwards an unseen value raises an alert,
+* watched fields come from per-event ``variables`` (positional into
+  ``ParserSchema.variables``) and ``header_variables`` (named from
+  ``logFormatVariables``), plus a ``global`` scope applying to all events
+  (reference: container/config/detector_config.yaml),
+* alert entries are keyed ``"{scope} - {label}"`` with value
+  ``"Unknown value: '<v>'"`` and score 1.0 per unseen value, matching the
+  demo's fluentd output record.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Set, Tuple
+
+from ...schemas import DetectorSchema, ParserSchema
+from ..common.detector import BufferMode, CoreDetector, CoreDetectorConfig
+
+
+class NewValueDetectorConfig(CoreDetectorConfig):
+    method_type: str = "new_value_detector"
+    # alert only the first time a given unknown value is observed
+    alert_once: bool = False
+
+
+class NewValueDetector(CoreDetector):
+    config_class = NewValueDetectorConfig
+    description = "NewValueDetector detects values not encountered in training as anomalies."
+
+    def __init__(self, name: Optional[str] = None, config: Any = None,
+                 buffer_mode: BufferMode = BufferMode.NO_BUF) -> None:
+        super().__init__(name=name or "NewValueDetector", buffer_mode=buffer_mode,
+                         config=config)
+        self.config: NewValueDetectorConfig
+        # (scope, instance, label) -> set of seen values
+        self._seen: Dict[Tuple[str, str, str], Set[str]] = {}
+
+    # ------------------------------------------------------------------
+    def _watched(self, input_: ParserSchema):
+        for scope, inst_name, inst in self.iter_scopes(input_):
+            for label, var in inst.get_all().items():
+                value = self.field_value(input_, var)
+                yield (scope, inst_name, label), scope, label, value
+
+    def train(self, input_: ParserSchema) -> None:
+        for key, _scope, _label, value in self._watched(input_):
+            if value is not None:
+                self._seen.setdefault(key, set()).add(value)
+
+    def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
+        score = 0.0
+        alerts: Dict[str, str] = {}
+        for key, scope, label, value in self._watched(input_):
+            if value is None:
+                continue
+            seen = self._seen.setdefault(key, set())
+            if value not in seen:
+                score += 1.0
+                alerts[f"{scope} - {label}"] = f"Unknown value: '{value}'"
+                if self.config.alert_once:
+                    seen.add(value)
+        if score > 0:
+            output_["score"] = score
+            output_["alertsObtain"].update(alerts)
+            return True
+        return False
+
+    # -- state checkpointing (TPU-build addition, closes SURVEY §5.4) ----
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "trained": self._trained,
+            "seen": {"|".join(k): sorted(v) for k, v in self._seen.items()},
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._trained = int(state.get("trained", 0))
+        self._seen = {
+            tuple(k.split("|", 2)): set(v) for k, v in state.get("seen", {}).items()
+        }
+
+
+class NewValueComboDetectorConfig(CoreDetectorConfig):
+    method_type: str = "new_value_combo_detector"
+    alert_once: bool = False
+
+
+class NewValueComboDetector(CoreDetector):
+    """Flags unseen *combinations* of the watched fields per instance."""
+
+    config_class = NewValueComboDetectorConfig
+    description = "NewValueComboDetector detects combinations of values not encountered in training as anomalies."
+
+    def __init__(self, name: Optional[str] = None, config: Any = None,
+                 buffer_mode: BufferMode = BufferMode.NO_BUF) -> None:
+        super().__init__(name=name or "NewValueComboDetector", buffer_mode=buffer_mode,
+                         config=config)
+        self.config: NewValueComboDetectorConfig
+        self._seen: Dict[Tuple[str, str], Set[Tuple]] = {}
+
+    def _combos(self, input_: ParserSchema):
+        for scope, inst_name, inst in self.iter_scopes(input_):
+            combo = tuple(
+                self.field_value(input_, var) for var in inst.get_all().values()
+            )
+            if combo and any(v is not None for v in combo):
+                yield (scope, inst_name), scope, inst_name, combo
+
+    def train(self, input_: ParserSchema) -> None:
+        for key, _scope, _inst, combo in self._combos(input_):
+            self._seen.setdefault(key, set()).add(combo)
+
+    def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
+        score = 0.0
+        alerts: Dict[str, str] = {}
+        for key, scope, inst_name, combo in self._combos(input_):
+            seen = self._seen.setdefault(key, set())
+            if combo not in seen:
+                score += 1.0
+                alerts[f"{scope} - {inst_name}"] = f"Unknown combination: {combo!r}"
+                if self.config.alert_once:
+                    seen.add(combo)
+        if score > 0:
+            output_["score"] = score
+            output_["alertsObtain"].update(alerts)
+            return True
+        return False
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "trained": self._trained,
+            "seen": {"|".join(k): sorted(map(list, v)) for k, v in self._seen.items()},
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._trained = int(state.get("trained", 0))
+        self._seen = {
+            tuple(k.split("|", 1)): {tuple(c) for c in v}
+            for k, v in state.get("seen", {}).items()
+        }

@@ -1,0 +1,50 @@
+"""RandomDetector: the reference's example detector.
+
+Parity with the example in the reference docs (docs/interfaces.md:152-204,
+examples/service_settings.yaml:1-3): flags anomalies independent of the input
+by drawing a uniform sample per watched variable and alerting when it exceeds
+the variable's ``threshold`` param.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ...schemas import DetectorSchema, ParserSchema
+from ..common.detector import BufferMode, CoreDetector, CoreDetectorConfig
+
+
+class RandomDetectorConfig(CoreDetectorConfig):
+    method_type: str = "random_detector"
+
+
+class RandomDetector(CoreDetector):
+    """Detects anomalies randomly in logs, independent of input data."""
+
+    config_class = RandomDetectorConfig
+    description = "RandomDetector flags anomalies at random for testing."
+
+    def __init__(self, name: str = "RandomDetector", config: Any = None,
+                 buffer_mode: BufferMode = BufferMode.NO_BUF) -> None:
+        super().__init__(name=name, buffer_mode=buffer_mode, config=config)
+        self.config: RandomDetectorConfig
+        self._rng = np.random.default_rng()
+
+    def train(self, input_: ParserSchema) -> None:
+        return
+
+    def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
+        overall = 0.0
+        alerts: Dict[str, str] = {}
+        for scope, _inst_name, inst in self.iter_scopes(input_):
+            for label, var in inst.get_all().items():
+                threshold = float(var.params.get("threshold", 1.0))
+                if self._rng.random() > threshold:
+                    overall += 1.0
+                    alerts[f"{scope} - {label}"] = "1.0"
+        if overall > 0:
+            output_["score"] = overall
+            output_["alertsObtain"].update(alerts)
+            return True
+        return False

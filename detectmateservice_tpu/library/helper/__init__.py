@@ -1,0 +1,3 @@
+from .from_to import From
+
+__all__ = ["From"]

@@ -1,0 +1,47 @@
+"""File-ingestion helper.
+
+Parity with the reference library's ``detectmatelibrary.helper.from_to.From``
+(usage evidence: tests/library_integration/test_one_pipe_to_rule_them_all.py:22,136
+— ``From.log(parser, path, do_process=True)`` yields LogSchema objects, with
+``None`` entries for filtered lines).
+"""
+from __future__ import annotations
+
+import socket
+import uuid
+from pathlib import Path
+from typing import Iterator, Optional
+
+from ...schemas import LogSchema
+
+
+class From:
+    @staticmethod
+    def log(component, path, do_process: bool = True) -> Iterator[Optional[LogSchema]]:
+        """Yield one LogSchema per line of ``path``; blank/unparseable lines
+        yield None so callers can filter (matching the reference idiom
+        ``[log for log in From.log(...) if log is not None]``).
+
+        ``component`` may veto lines via an ``accepts_line(str) -> bool`` hook;
+        with ``do_process=False`` the raw line strings are yielded instead.
+        """
+        hostname = socket.gethostname()
+        accepts = getattr(component, "accepts_line", None)
+        with open(Path(path), "r", encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                line = line.rstrip("\n")
+                if not line.strip():
+                    yield None
+                    continue
+                if callable(accepts) and not accepts(line):
+                    yield None
+                    continue
+                if not do_process:
+                    yield line  # type: ignore[misc]
+                    continue
+                yield LogSchema(
+                    logID=str(uuid.uuid4()),
+                    log=line,
+                    logSource=str(path),
+                    hostname=hostname,
+                )

@@ -1,0 +1,3 @@
+from .template_matcher import MatcherParser, MatcherParserConfig
+
+__all__ = ["MatcherParser", "MatcherParserConfig"]

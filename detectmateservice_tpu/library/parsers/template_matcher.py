@@ -1,0 +1,181 @@
+"""MatcherParser: log-format tokenization + template matching.
+
+Capability parity with the reference library's
+``detectmatelibrary.parsers.template_matcher.MatcherParser`` (surface
+reconstructed from container/config/parser_config.yaml, the audit-log
+integration config in
+tests/library_integration/test_pipe_filereader_matcher_nvd.py:50-65, and
+docs/getting_started.md:388-418):
+
+* ``log_format`` is a token template like
+  ``<IP> - - [<Time>] "<Method> <URL> <Protocol>" <Status> <Bytes> ...``;
+  each ``<Name>`` captures one field into ``logFormatVariables``,
+* the ``<Content>`` capture (or, absent one, the whole line) is normalized
+  (``remove_spaces`` / ``remove_punctuation`` / ``lowercase``) and matched
+  against the drain-style template file at ``path_templates`` (``<*>``
+  wildcards); the matched template's 1-based index becomes ``EventID`` and the
+  wildcard captures become ``variables``,
+* quirk preserved: the output's ``log`` field is set to the parser name, not
+  the input line (pinned in the reference by
+  tests/library_integration/test_pipe_filereader_matcher_nvd.py:158-160).
+
+The per-line template-matching hot path can run through the optional in-tree
+C++ kernel (native/matchkern) when built; the Python path is the fallback.
+"""
+from __future__ import annotations
+
+import re
+import string
+import time
+import uuid
+from pathlib import Path
+from typing import Any, List, Optional, Pattern, Tuple
+
+from pydantic import Field
+
+from ...schemas import LogSchema, ParserSchema, SchemaError
+from ..common.core import CoreComponent, CoreConfig, LibraryError
+
+_TOKEN_RE = re.compile(r"<([A-Za-z_][A-Za-z0-9_]*)>")
+_PUNCT_TABLE = str.maketrans("", "", string.punctuation)
+
+
+class MatcherParserConfig(CoreConfig):
+    method_type: str = "matcher_parser"
+    log_format: Optional[str] = None
+    time_format: Optional[str] = None
+    # flattened from params by CoreConfig.from_dict
+    remove_spaces: bool = False
+    remove_punctuation: bool = False
+    lowercase: bool = False
+    path_templates: Optional[str] = None
+
+
+def compile_log_format(log_format: str) -> Tuple[Pattern, List[str]]:
+    """Turn a ``<Name>`` token template into a regex + capture-name list."""
+    names: List[str] = []
+    pattern_parts: List[str] = ["^"]
+    pos = 0
+    for match in _TOKEN_RE.finditer(log_format):
+        literal = log_format[pos:match.start()]
+        pattern_parts.append(re.escape(literal))
+        names.append(match.group(1))
+        pattern_parts.append("(.*?)" if match.end() != len(log_format) else "(.*)")
+        pos = match.end()
+    pattern_parts.append(re.escape(log_format[pos:]))
+    pattern_parts.append("$")
+    return re.compile("".join(pattern_parts)), names
+
+
+def compile_template(template: str) -> Pattern:
+    """Turn a drain-style ``<*>`` template into a matching regex."""
+    parts = [re.escape(piece) for piece in template.split("<*>")]
+    return re.compile("^" + "(.*?)".join(parts[:-1]) + ("(.*)" if len(parts) > 1 else "") + parts[-1] + "$")
+
+
+class MatcherParser(CoreComponent):
+    config_class = MatcherParserConfig
+    category = "parsers"
+
+    def __init__(self, name: Optional[str] = None, config: Any = None) -> None:
+        super().__init__(name=name, config=config)
+        self.config: MatcherParserConfig
+        self._format_re: Optional[Pattern] = None
+        self._format_names: List[str] = []
+        if self.config.log_format:
+            self._format_re, self._format_names = compile_log_format(self.config.log_format)
+        self._templates: List[str] = []
+        self._template_res: List[Pattern] = []
+        if self.config.path_templates:
+            self._load_templates(self.config.path_templates)
+        self._native = None
+        try:  # optional C++ matching kernel
+            from ...utils import matchkern
+
+            if self._templates:
+                self._native = matchkern.TemplateMatcher(
+                    [self._normalize(t) for t in self._templates]
+                )
+        except Exception:
+            self._native = None
+
+    def _load_templates(self, path: str) -> None:
+        try:
+            text = Path(path).read_text(encoding="utf-8")
+        except OSError as exc:
+            raise LibraryError(f"{self.name}: cannot read templates file {path}: {exc}") from exc
+        self._templates = [line.rstrip("\n") for line in text.splitlines() if line.strip()]
+        self._template_res = [compile_template(self._normalize(t)) for t in self._templates]
+
+    # ------------------------------------------------------------------
+    def _normalize(self, text: str) -> str:
+        if self.config.lowercase:
+            text = text.lower()
+        if self.config.remove_punctuation:
+            # keep the <*> wildcard intact while stripping punctuation
+            text = "\x00*\x00".join(
+                piece.translate(_PUNCT_TABLE) for piece in text.split("<*>")
+            ).replace("\x00*\x00", "<*>")
+        if self.config.remove_spaces:
+            text = "<*>".join(piece.replace(" ", "") for piece in text.split("<*>"))
+        return text
+
+    def match_templates(self, content: str) -> Tuple[int, str, List[str]]:
+        """Return (EventID, template, variables); EventID is the 1-based index
+        of the first matching template, -1 when nothing matches."""
+        normalized = self._normalize(content)
+        if self._native is not None:
+            idx, variables = self._native.match(normalized)
+            if idx >= 0:
+                return idx + 1, self._templates[idx], variables
+            return -1, "", []
+        for idx, template_re in enumerate(self._template_res):
+            found = template_re.match(normalized)
+            if found:
+                return idx + 1, self._templates[idx], [g for g in found.groups() if g is not None]
+        return -1, "", []
+
+    def parse_line(self, log_line: str, log_id: str = "",
+                   received_ts: Optional[int] = None) -> Optional[ParserSchema]:
+        """Parse one raw line into a ParserSchema (None = unparseable/filtered)."""
+        if not log_line.strip():
+            return None
+        header_vars = {}
+        content = log_line
+        if self._format_re is not None:
+            found = self._format_re.match(log_line)
+            if found:
+                header_vars = dict(zip(self._format_names, found.groups()))
+                content = header_vars.get("Content", log_line)
+        if self.config.time_format and "Time" in header_vars:
+            try:
+                parsed = time.strptime(header_vars["Time"], self.config.time_format)
+                header_vars["Time"] = str(int(time.mktime(parsed)))
+            except ValueError:
+                pass
+        event_id, template, variables = (
+            self.match_templates(content) if self._templates else (-1, "", [])
+        )
+        now = int(time.time())
+        out = ParserSchema()
+        out["parserType"] = self.config.method_type
+        out["parserID"] = self.name
+        out["EventID"] = event_id
+        out["template"] = template
+        out["variables"] = variables
+        out["parsedLogID"] = uuid.uuid4().hex
+        out["logID"] = log_id
+        # reference quirk: MatcherParser writes its own name into `log`
+        out["log"] = self.name
+        out["logFormatVariables"] = header_vars
+        out["receivedTimestamp"] = received_ts if received_ts is not None else now
+        out["parsedTimestamp"] = now
+        return out
+
+    def process(self, data: bytes) -> Optional[bytes]:
+        try:
+            input_ = LogSchema.from_bytes(data)
+        except SchemaError as exc:
+            raise LibraryError(f"{self.name}: cannot deserialize LogSchema: {exc}") from exc
+        parsed = self.parse_line(input_.get("log") or "", log_id=input_.get("logID") or "")
+        return parsed.serialize() if parsed is not None else None

@@ -1,0 +1,3 @@
+from .log_file import LogFileReader, LogFileConfig
+
+__all__ = ["LogFileReader", "LogFileConfig"]

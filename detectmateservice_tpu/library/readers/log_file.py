@@ -1,0 +1,70 @@
+"""File reader: raw text lines → LogSchema messages.
+
+Parity with the reference library's ``readers`` category
+(reference: src/service/features/config_manager.py:15, config_loader.py:23
+name the ``readers.log_file.LogFileConfig`` shape). Two modes:
+
+* as a pipeline component, ``process`` wraps incoming raw text (one or more
+  newline-separated lines) into LogSchema bytes — the ingress adapter role
+  fluentd plays in the reference demo stack,
+* ``read()`` iterates a configured file and yields LogSchema messages, the
+  in-process equivalent of the file-tailing reader.
+"""
+from __future__ import annotations
+
+import socket
+import uuid
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+from ...schemas import LogSchema
+from ..common.core import CoreComponent, CoreConfig, LibraryError
+
+
+class LogFileConfig(CoreConfig):
+    method_type: str = "log_file"
+    path: Optional[str] = None
+    log_source: Optional[str] = None
+
+
+class LogFileReader(CoreComponent):
+    config_class = LogFileConfig
+    category = "readers"
+
+    def __init__(self, name: Optional[str] = None, config: Any = None) -> None:
+        super().__init__(name=name, config=config)
+        self.config: LogFileConfig
+        self._hostname = socket.gethostname()
+
+    def make_log(self, line: str) -> LogSchema:
+        return LogSchema(
+            logID=str(uuid.uuid4()),
+            log=line,
+            logSource=self.config.log_source or self.config.path or self.name,
+            hostname=self._hostname,
+        )
+
+    def process(self, data: bytes) -> Optional[bytes]:
+        """Wrap raw text into a LogSchema (first non-empty line)."""
+        try:
+            text = data.decode("utf-8", errors="replace")
+        except Exception as exc:  # pragma: no cover - decode never raises here
+            raise LibraryError(f"{self.name}: cannot decode input: {exc}") from exc
+        for line in text.splitlines():
+            if line.strip():
+                return self.make_log(line).serialize()
+        return None
+
+    def read(self, path: Optional[str] = None) -> Iterator[LogSchema]:
+        """Yield a LogSchema per non-empty line of the file."""
+        target = path or self.config.path
+        if not target:
+            raise LibraryError(f"{self.name}: no file path configured")
+        try:
+            with open(Path(target), "r", encoding="utf-8", errors="replace") as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    if line.strip():
+                        yield self.make_log(line)
+        except OSError as exc:
+            raise LibraryError(f"{self.name}: cannot read {target}: {exc}") from exc

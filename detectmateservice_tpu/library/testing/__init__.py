@@ -1,0 +1,4 @@
+from .dummy_parser import DummyParser, DummyParserConfig
+from .dummy_detector import DummyDetector, DummyDetectorConfig
+
+__all__ = ["DummyParser", "DummyParserConfig", "DummyDetector", "DummyDetectorConfig"]

@@ -1,0 +1,43 @@
+"""Deterministic detector double for pipeline tests.
+
+Role of the reference's published test double
+``detectmatelibrary_tests.test_detectors.dummy_detector.DummyDetector``
+(usage: tests/library_integration/test_detector_integration.py:25-27,92-115 —
+detects in a fixed False/True/False alternation so tests can assert exactly
+which messages produce alerts and which produce *no output at all*).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ...schemas import DetectorSchema, ParserSchema
+from ..common.detector import BufferMode, CoreDetector, CoreDetectorConfig
+
+
+class DummyDetectorConfig(CoreDetectorConfig):
+    method_type: str = "dummy_detector"
+    pattern: list = [False, True, False]
+
+
+class DummyDetector(CoreDetector):
+    config_class = DummyDetectorConfig
+    description = "DummyDetector alternates detections deterministically."
+
+    def __init__(self, name: Optional[str] = None, config: Any = None,
+                 buffer_mode: BufferMode = BufferMode.NO_BUF) -> None:
+        super().__init__(name=name or "DummyDetector", buffer_mode=buffer_mode,
+                         config=config)
+        self.config: DummyDetectorConfig
+        self._calls = 0
+
+    def train(self, input_: ParserSchema) -> None:
+        return
+
+    def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
+        pattern = self.config.pattern or [False]
+        hit = bool(pattern[self._calls % len(pattern)])
+        self._calls += 1
+        if hit:
+            output_["score"] = 1.0
+            output_["alertsObtain"].update({"Dummy": "deterministic detection"})
+        return hit

@@ -1,0 +1,46 @@
+"""Deterministic parser double for pipeline tests.
+
+Role of the reference's published test double
+``detectmatelibrary_tests.test_parsers.dummy_parser.DummyParser`` (usage:
+tests/library_integration/test_one_pipe_to_rule_them_all.py:10,35-62 — returns
+a fixed template/variables for any input so tests can assert exact pipelines).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ...schemas import LogSchema, ParserSchema
+from ..common.core import CoreComponent, CoreConfig
+
+
+class DummyParserConfig(CoreConfig):
+    method_type: str = "dummy_parser"
+    template: str = "User <*> logged in from <*>"
+    variables: list = ["john", "192.168.1.100"]
+    event_id: int = 1
+
+
+class DummyParser(CoreComponent):
+    config_class = DummyParserConfig
+    category = "parsers"
+
+    def __init__(self, name: Optional[str] = None, config: Any = None) -> None:
+        super().__init__(name=name or "DummyParser", config=config)
+        self.config: DummyParserConfig
+
+    def process(self, data: bytes) -> Optional[bytes]:
+        input_ = LogSchema.from_bytes(data)
+        now = int(time.time())
+        out = ParserSchema(
+            parserType=self.config.method_type,
+            parserID=self.name,
+            EventID=self.config.event_id,
+            template=self.config.template,
+            variables=list(self.config.variables),
+            logID=input_.get("logID") or "",
+            log=input_.get("log") or "",
+            receivedTimestamp=now,
+            parsedTimestamp=now,
+        )
+        return out.serialize()

@@ -1,0 +1,3 @@
+from .data_buffer import BufferMode, DataBuffer
+
+__all__ = ["BufferMode", "DataBuffer"]

@@ -1,0 +1,188 @@
+"""Pipeline message schemas with dict-style and attribute access.
+
+Capability parity with the reference library's ``detectmatelibrary.schemas``
+surface (reference: docs/interfaces.md:120-130, evidence of the wrapper API at
+tests/library_integration/library_integration_base_fixtures.py:81-83 — kwargs /
+dict construction, ``.serialize()`` / ``.deserialize()``, ``obj["field"]``
+access as in docs/interfaces.md:199-200).
+
+Wire format is proto3 and field-number compatible with the reference's
+``schemas.proto`` (decoded from container/fluentout/schemas_pb.rb:8).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Mapping, Optional
+
+from . import schemas_pb2 as _pb
+
+SCHEMA_VERSION = "1.0.0"
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "SchemaError",
+    "BaseSchema",
+    "LogSchema",
+    "ParserSchema",
+    "DetectorSchema",
+    "OutputSchema",
+]
+
+
+class SchemaError(Exception):
+    """Raised on invalid schema field access or failed (de)serialization."""
+
+
+def _is_repeated(desc: Any) -> bool:
+    flag = getattr(desc, "is_repeated", None)
+    if flag is not None:
+        return bool(flag() if callable(flag) else flag)
+    return desc.label == desc.LABEL_REPEATED
+
+
+class BaseSchema:
+    """Wraps a generated protobuf message with dict + attribute access.
+
+    ``obj["field"]`` and ``obj.field`` both work; repeated and map fields
+    return the live protobuf containers so ``obj["alertsObtain"].update(...)``
+    mutates the message in place (matching the reference library's usage,
+    docs/interfaces.md:199-200).
+    """
+
+    _PB = None  # type: ignore[assignment]
+
+    def __init__(self, data: Optional[Mapping[str, Any]] = None, **kwargs: Any):
+        self._msg = self._PB()  # type: ignore[misc]
+        setattr(self._msg, "__version__", SCHEMA_VERSION)
+        if data is not None:
+            if not isinstance(data, Mapping):
+                raise SchemaError(
+                    f"{type(self).__name__} expects a mapping, got {type(data).__name__}"
+                )
+            self.update(data)
+        if kwargs:
+            self.update(kwargs)
+
+    # -- field access ------------------------------------------------------
+    def _field_names(self) -> set:
+        return {f.name for f in self._PB.DESCRIPTOR.fields}
+
+    def __getitem__(self, key: str) -> Any:
+        if key not in self._field_names():
+            raise SchemaError(f"{type(self).__name__} has no field {key!r}")
+        return getattr(self._msg, key)
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        self._set_field(key, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails; delegate to the message
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return getattr(self.__dict__["_msg"], name)
+        except AttributeError as exc:
+            raise AttributeError(f"{type(self).__name__} has no field {name!r}") from exc
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._set_field(name, value)
+
+    def _set_field(self, key: str, value: Any) -> None:
+        desc = self._PB.DESCRIPTOR.fields_by_name.get(key)
+        if desc is None:
+            raise SchemaError(f"{type(self).__name__} has no field {key!r}")
+        try:
+            if _is_repeated(desc):
+                if desc.message_type is not None and desc.message_type.GetOptions().map_entry:
+                    field = getattr(self._msg, key)
+                    field.clear()
+                    field.update(value)
+                else:
+                    field = getattr(self._msg, key)
+                    del field[:]
+                    field.extend(value)
+            else:
+                setattr(self._msg, key, value)
+        except (TypeError, ValueError) as exc:
+            raise SchemaError(f"cannot set {type(self).__name__}.{key}: {exc}") from exc
+
+    def update(self, data: Mapping[str, Any]) -> None:
+        for key, value in data.items():
+            self._set_field(key, value)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            return self[key]
+        except SchemaError:
+            return default
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._field_names()
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(sorted(self._field_names()))
+
+    def keys(self):
+        return sorted(self._field_names())
+
+    # -- (de)serialization -------------------------------------------------
+    def serialize(self) -> bytes:
+        return self._msg.SerializeToString()
+
+    def deserialize(self, raw: bytes) -> "BaseSchema":
+        try:
+            self._msg.ParseFromString(raw)
+        except Exception as exc:  # DecodeError
+            raise SchemaError(f"cannot deserialize {type(self).__name__}: {exc}") from exc
+        return self
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "BaseSchema":
+        return cls().deserialize(raw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in self._PB.DESCRIPTOR.fields:
+            value = getattr(self._msg, f.name)
+            if _is_repeated(f):
+                if f.message_type is not None and f.message_type.GetOptions().map_entry:
+                    out[f.name] = dict(value)
+                else:
+                    out[f.name] = list(value)
+            else:
+                out[f.name] = value
+        return out
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, BaseSchema):
+            return self._msg == other._msg
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.to_dict()!r})"
+
+
+class LogSchema(BaseSchema):
+    """Reader output: one raw log line + provenance."""
+
+    _PB = _pb.LogSchema
+
+
+class ParserSchema(BaseSchema):
+    """Parser output: template + extracted variables for one log line."""
+
+    _PB = _pb.ParserSchema
+
+
+class DetectorSchema(BaseSchema):
+    """Detector output: one alert (only emitted when an anomaly is found)."""
+
+    _PB = _pb.DetectorSchema
+
+
+class OutputSchema(BaseSchema):
+    """Aggregated output record."""
+
+    _PB = _pb.OutputSchema

@@ -1,0 +1,221 @@
+"""Service settings: YAML + environment configuration with typed addresses.
+
+Capability parity with the reference's ``ServiceSettings``
+(reference: src/service/settings.py:40-173):
+
+* typed transport URLs restricted to the schemes the data plane supports
+  (reference: settings.py:31-37),
+* ``DETECTMATE_``-prefixed environment overrides with ``__`` nesting, env
+  winning over YAML per-field (reference: settings.py:80-84,134-173),
+* deterministic UUIDv5 component identity, stable across restarts
+  (reference: settings.py:93-114),
+* TLS cross-field validation failing at startup (reference: settings.py:116-132).
+
+This build has no ``pydantic_settings`` dependency; the env layer is a small
+explicit merge, which is what the reference's ``from_yaml`` does anyway.
+
+TPU-build additions (not in the reference): micro-batching knobs
+(``engine_batch_size``, ``engine_batch_timeout_ms``), accelerator backend
+selection, and mesh shape for multi-chip scale-out.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import uuid
+from typing import Annotated, Any, Dict, List, Mapping, Optional
+
+import yaml
+from pydantic import (
+    AfterValidator,
+    BaseModel,
+    ConfigDict,
+    Field,
+    ValidationError,
+    model_validator,
+)
+
+ENV_PREFIX = "DETECTMATE_"
+ENV_NESTED_DELIMITER = "__"
+
+SUPPORTED_SCHEMES = ("ipc", "tcp", "tls+tcp", "ws", "inproc")
+
+
+class SettingsError(Exception):
+    """Raised for invalid service settings."""
+
+
+def _validate_addr(addr: str) -> str:
+    """Validate a transport address against the supported scheme set.
+
+    Mirrors the reference's NngAddr union constraints (settings.py:31-37):
+    unknown schemes are rejected at validation time
+    (pinned by tests/test_engine_multi_output.py:328-342 in the reference).
+    """
+    if "://" not in addr:
+        raise ValueError(f"address {addr!r} has no scheme; expected one of {SUPPORTED_SCHEMES}")
+    scheme, rest = addr.split("://", 1)
+    if scheme not in SUPPORTED_SCHEMES:
+        raise ValueError(f"unsupported scheme {scheme!r} in {addr!r}; expected one of {SUPPORTED_SCHEMES}")
+    if not rest:
+        raise ValueError(f"address {addr!r} has an empty target")
+    if scheme in ("tcp", "tls+tcp", "ws"):
+        host_port = rest.split("/", 1)[0]
+        if ":" not in host_port:
+            raise ValueError(f"address {addr!r} requires an explicit port")
+    return addr
+
+
+TransportAddr = Annotated[str, AfterValidator(_validate_addr)]
+
+
+class TlsInputConfig(BaseModel):
+    """Server-side TLS material for the engine listener (reference: settings.py:11-17)."""
+
+    model_config = ConfigDict(extra="forbid")
+    cert_key_file: str
+
+
+class TlsOutputConfig(BaseModel):
+    """Client-side TLS material for output dialers (reference: settings.py:20-27)."""
+
+    model_config = ConfigDict(extra="forbid")
+    ca_file: str
+    server_name: Optional[str] = None
+
+
+class ServiceSettings(BaseModel):
+    """All per-process service configuration (reference: settings.py:40-173)."""
+
+    model_config = ConfigDict(extra="forbid", validate_assignment=True)
+
+    # -- identity (reference: settings.py:49-52) --------------------------
+    component_name: Optional[str] = None
+    component_id: Optional[str] = None
+    component_type: str = "core"
+    component_config_class: Optional[str] = None
+
+    # -- logging (reference: settings.py:55-58) ---------------------------
+    log_level: str = "INFO"
+    log_dir: str = "./logs"
+    log_to_console: bool = True
+    log_to_file: bool = True
+
+    # -- engine data channel (reference: settings.py:61-65) ---------------
+    engine_addr: TransportAddr = "ipc:///tmp/detectmate.engine.ipc"
+    engine_autostart: bool = True
+    engine_recv_timeout: int = Field(default=100, ge=1)  # ms
+    engine_retry_count: int = Field(default=10, ge=1)
+    engine_buffer_size: int = Field(default=100, ge=0, le=8192)
+
+    # -- outputs (reference: settings.py:68-70) ---------------------------
+    out_addr: List[TransportAddr] = Field(default_factory=list)
+    out_dial_timeout: int = Field(default=1000, ge=0)  # ms
+
+    # -- TLS (reference: settings.py:73-74) -------------------------------
+    tls_input: Optional[TlsInputConfig] = None
+    tls_output: Optional[TlsOutputConfig] = None
+
+    # -- admin HTTP (reference: settings.py:77-78) ------------------------
+    http_host: str = "127.0.0.1"
+    http_port: int = Field(default=8000, ge=0, le=65535)
+
+    # -- component config file (reference: settings.py:86) ----------------
+    config_file: Optional[str] = None
+
+    # -- TPU-build additions ----------------------------------------------
+    # engine_batch_size == 1 keeps the reference's strict per-message
+    # contract; > 1 enables micro-batched dispatch to the accelerator.
+    engine_batch_size: int = Field(default=1, ge=1, le=4096)
+    engine_batch_timeout_ms: float = Field(default=2.0, ge=0.0)
+    backend: str = Field(default="auto", pattern="^(auto|cpu|tpu)$")
+    mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 8}
+    checkpoint_dir: Optional[str] = None
+    profile_dir: Optional[str] = None
+
+    # -- derived identity (reference: settings.py:93-114) -----------------
+    @model_validator(mode="after")
+    def _ensure_component_id(self) -> "ServiceSettings":
+        if not self.component_id:
+            if self.component_name:
+                seed = f"detectmate/{self.component_type}/{self.component_name}"
+            else:
+                seed = f"detectmate/{self.component_type}|{self.engine_addr}"
+            object.__setattr__(
+                self, "component_id", uuid.uuid5(uuid.NAMESPACE_URL, seed).hex
+            )
+        return self
+
+    # -- TLS cross-validation (reference: settings.py:116-132) ------------
+    @model_validator(mode="after")
+    def _check_tls(self) -> "ServiceSettings":
+        if self.engine_addr.startswith("tls+tcp://") and self.tls_input is None:
+            raise ValueError("engine_addr uses tls+tcp:// but tls_input is not configured")
+        if any(a.startswith("tls+tcp://") for a in self.out_addr) and self.tls_output is None:
+            raise ValueError("an out_addr uses tls+tcp:// but tls_output is not configured")
+        return self
+
+    # -- loading -----------------------------------------------------------
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServiceSettings":
+        """Load from YAML, apply env overrides (env wins), validate.
+
+        Exits the process on validation failure, like the reference CLI
+        (reference: settings.py:134-173; precedence pinned by
+        tests/test_config_reading.py:122-145).
+        """
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = yaml.safe_load(fh) or {}
+            if not isinstance(data, dict):
+                raise SettingsError(f"settings file {path} must contain a mapping")
+            merged = _deep_merge(data, _env_overrides())
+            return cls.model_validate(merged)
+        except (OSError, yaml.YAMLError, ValidationError, SettingsError) as exc:
+            print(f"Invalid service settings ({path}): {exc}", file=sys.stderr)
+            raise SystemExit(1)
+
+    @classmethod
+    def from_env(cls) -> "ServiceSettings":
+        return cls.model_validate(_env_overrides())
+
+
+def _env_overrides(environ: Optional[Mapping[str, str]] = None) -> Dict[str, Any]:
+    """Collect ``DETECTMATE_*`` environment variables into a nested dict.
+
+    ``__`` nests into sub-models (reference: settings.py:80-84). List- and
+    dict-typed fields accept JSON values.
+    """
+    environ = environ if environ is not None else os.environ
+    out: Dict[str, Any] = {}
+    for key, value in environ.items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        path = key[len(ENV_PREFIX):].lower().split(ENV_NESTED_DELIMITER)
+        parsed: Any = value
+        stripped = value.strip()
+        if stripped and stripped[0] in "[{":
+            try:
+                parsed = json.loads(stripped)
+            except json.JSONDecodeError:
+                parsed = value
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+            if not isinstance(node, dict):
+                break
+        else:
+            node[path[-1]] = parsed
+    return out
+
+
+def _deep_merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge ``override`` onto ``base``, override winning per-field."""
+    merged = dict(base)
+    for key, value in override.items():
+        if key in merged and isinstance(merged[key], dict) and isinstance(value, dict):
+            merged[key] = _deep_merge(merged[key], value)
+        else:
+            merged[key] = value
+    return merged

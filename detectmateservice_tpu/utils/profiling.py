@@ -1,0 +1,24 @@
+"""jax.profiler integration (closes the tracing gap noted in SURVEY.md §5.1:
+the reference has no profiling subsystem at all)."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+
+def capture_trace(out_dir: str, duration_ms: int = 1000) -> Dict[str, Any]:
+    """Record a jax.profiler trace for ``duration_ms`` into ``out_dir``.
+
+    Runs on a background thread so the admin HTTP call returns immediately.
+    """
+    import jax
+
+    def _run() -> None:
+        jax.profiler.start_trace(out_dir)
+        time.sleep(duration_ms / 1000.0)
+        jax.profiler.stop_trace()
+
+    thread = threading.Thread(target=_run, name="ProfileTrace", daemon=True)
+    thread.start()
+    return {"detail": "trace started", "out_dir": out_dir, "duration_ms": duration_ms}

@@ -1,0 +1,3 @@
+from .server import WebServer
+
+__all__ = ["WebServer"]

@@ -1,0 +1,148 @@
+"""Admin plane: HTTP server exposing lifecycle verbs + Prometheus metrics.
+
+Route parity with the reference (reference:
+src/service/features/web/router.py:18-46, server.py:22-27):
+
+* ``POST /admin/start`` / ``POST /admin/stop`` / ``POST /admin/shutdown``
+* ``GET  /admin/status``
+* ``POST /admin/reconfigure`` with JSON ``{"config": {...}, "persist": bool}``
+* ``GET  /metrics`` → ``prometheus_client.generate_latest()``
+
+The reference runs FastAPI/uvicorn on a thread with signal handlers disabled
+(reference: server.py:40-42); this environment has neither, so the server is a
+stdlib ``ThreadingHTTPServer`` on a daemon thread — same observable surface,
+zero extra dependencies. The TPU build adds ``POST /admin/profile`` to capture
+a jax.profiler trace (closes the tracing gap noted in SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+
+class WebServer:
+    def __init__(self, service) -> None:
+        self.service = service
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (useful when settings request port 0)."""
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return self.service.settings.http_port
+
+    def start(self) -> None:
+        with self._lock:
+            if self._httpd is not None:
+                return
+            handler = _make_handler(self.service)
+            self._httpd = ThreadingHTTPServer(
+                (self.service.settings.http_host, self.service.settings.http_port),
+                handler,
+            )
+            self._httpd.daemon_threads = True
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="WebServerThread",
+                daemon=True,
+                kwargs={"poll_interval": 0.1},
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._httpd is None:
+                return
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=2.0)
+                self._thread = None
+
+
+def _make_handler(service):
+    class AdminHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt: str, *args) -> None:
+            logging.getLogger("web").debug("%s " + fmt, self.client_address[0], *args)
+
+        # -- helpers ---------------------------------------------------
+        def _send(self, code: int, body: bytes, content_type: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, payload: Any) -> None:
+            self._send(code, json.dumps(payload).encode("utf-8"))
+
+        def _read_json(self) -> Tuple[Optional[Dict[str, Any]], Optional[str]]:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length == 0:
+                return {}, None
+            try:
+                return json.loads(self.rfile.read(length) or b"{}"), None
+            except json.JSONDecodeError as exc:
+                return None, str(exc)
+
+        # -- routes ----------------------------------------------------
+        def do_GET(self) -> None:
+            if self.path == "/metrics":
+                self._send(200, generate_latest(), CONTENT_TYPE_LATEST)
+            elif self.path == "/admin/status":
+                self._send_json(200, service._create_status_report())
+            else:
+                self._send_json(404, {"detail": "not found"})
+
+        def do_POST(self) -> None:
+            try:
+                if self.path == "/admin/start":
+                    self._send_json(200, {"detail": service.start()})
+                elif self.path == "/admin/stop":
+                    service.stop()
+                    self._send_json(200, {"detail": "engine stopped"})
+                elif self.path == "/admin/shutdown":
+                    self._send_json(200, {"detail": "service shutting down"})
+                    service.shutdown()
+                elif self.path == "/admin/reconfigure":
+                    payload, err = self._read_json()
+                    if err is not None:
+                        self._send_json(400, {"detail": f"invalid JSON: {err}"})
+                        return
+                    config = (payload or {}).get("config") or {}
+                    persist = bool((payload or {}).get("persist", False))
+                    updated = service.reconfigure(config, persist=persist)
+                    self._send_json(200, {"detail": "reconfigured", "config": updated})
+                elif self.path == "/admin/profile":
+                    payload, _ = self._read_json()
+                    result = _capture_profile(service, payload or {})
+                    self._send_json(200, result)
+                else:
+                    self._send_json(404, {"detail": "not found"})
+            except Exception as exc:  # admin errors surface as HTTP 500s
+                try:
+                    self._send_json(500, {"detail": str(exc)})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+    return AdminHandler
+
+
+def _capture_profile(service, payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Capture a jax.profiler trace for ``duration_ms`` (TPU-build addition)."""
+    from ..utils.profiling import capture_trace
+
+    duration_ms = int(payload.get("duration_ms", 1000))
+    out_dir = payload.get("out_dir") or service.settings.profile_dir or "/tmp/detectmate_profile"
+    return capture_trace(out_dir, duration_ms)

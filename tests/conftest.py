@@ -1,0 +1,72 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding is exercised
+without TPU hardware (the driver separately dry-runs the multi-chip path);
+the env vars must be set before jax is first imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+
+
+@pytest.fixture()
+def free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def inproc_factory() -> InprocQueueSocketFactory:
+    return InprocQueueSocketFactory()
+
+
+@pytest.fixture()
+def ipc_addr(tmp_path: Path) -> str:
+    return f"ipc://{tmp_path}/engine.ipc"
+
+
+def wait_until(predicate, timeout: float = 5.0, interval: float = 0.02) -> bool:
+    """Poll ``predicate`` until truthy or timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def run_service():
+    """Run a Service.run() on a daemon thread; always shut down at teardown."""
+    from detectmateservice_tpu.core import Service
+
+    started = []
+
+    def _run(service: Service) -> Service:
+        thread = threading.Thread(target=service.run, daemon=True)
+        thread.start()
+        started.append((service, thread))
+        assert wait_until(lambda: service.web_server.port not in (None,), 5.0)
+        return service
+
+    yield _run
+
+    for service, thread in started:
+        try:
+            service.shutdown()
+        except Exception:
+            pass
+        thread.join(timeout=5.0)

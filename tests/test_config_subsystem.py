@@ -1,0 +1,206 @@
+"""Tier-1 config subsystem tests: manager, loaders, resolver, normalization
+(model of the reference's tests/test_component_loader/*,
+tests/test_reconfigure_params.py shapes)."""
+import sys
+import types
+
+import pytest
+import yaml
+
+from detectmateservice_tpu.config import (
+    ComponentLoader,
+    ComponentResolver,
+    ConfigClassLoader,
+    ConfigManager,
+)
+from detectmateservice_tpu.config.manager import ConfigError
+from detectmateservice_tpu.library.common.core import (
+    AutoConfigError,
+    CoreComponent,
+    CoreConfig,
+    MethodTypeError,
+    normalize_config,
+)
+
+
+class TestConfigManager:
+    def test_missing_file_creates_defaults(self, tmp_path):
+        path = tmp_path / "config.yaml"
+        mgr = ConfigManager(str(path))
+        data = mgr.load()
+        assert data == {}
+        assert path.exists()
+
+    def test_load_and_get(self, tmp_path):
+        path = tmp_path / "config.yaml"
+        payload = {"detectors": {"NewValueDetector": {"method_type": "new_value_detector"}}}
+        path.write_text(yaml.safe_dump(payload))
+        mgr = ConfigManager(str(path))
+        assert mgr.load() == payload
+        assert mgr.get() == payload
+
+    def test_update_validates(self, tmp_path):
+        mgr = ConfigManager(str(tmp_path / "c.yaml"))
+        mgr.load()
+        updated = mgr.update({"detectors": {"X": {"a": 1}}})
+        assert updated["detectors"]["X"]["a"] == 1
+        with pytest.raises(ConfigError):
+            mgr.update("not-a-dict")  # type: ignore[arg-type]
+
+    def test_save_persists(self, tmp_path):
+        path = tmp_path / "c.yaml"
+        mgr = ConfigManager(str(path))
+        mgr.load()
+        mgr.update({"parsers": {"P": {"x": 2}}})
+        mgr.save()
+        assert yaml.safe_load(path.read_text())["parsers"]["P"]["x"] == 2
+
+    def test_broken_yaml_raises(self, tmp_path):
+        path = tmp_path / "c.yaml"
+        path.write_text(": {{ not yaml")
+        with pytest.raises(ConfigError):
+            ConfigManager(str(path)).load()
+
+
+@pytest.fixture()
+def fake_library(monkeypatch):
+    """Build a fake component-library package directly in sys.modules
+    (the reference idiom, tests/test_component_loader/test_component_loader.py:21-53)."""
+    from detectmateservice_tpu.library.common import core as core_mod
+
+    pkg = types.ModuleType("fakelib")
+    pkg.__path__ = []  # mark as package
+    sub = types.ModuleType("fakelib.things")
+
+    class GoodConfig(CoreConfig):
+        method_type: str = "good"
+        knob: int = 1
+
+    class Good(CoreComponent):
+        config_class = GoodConfig
+
+        def __init__(self, name=None, config=None):
+            super().__init__(name=name, config=config)
+            self.got_config = config
+
+        def process(self, data):
+            return data
+
+    class NotAComponent:
+        def __init__(self, config=None):
+            pass
+
+    sub.Good = Good
+    sub.GoodConfig = GoodConfig
+    sub.NotAComponent = NotAComponent
+    pkg.things = sub
+    monkeypatch.setitem(sys.modules, "fakelib", pkg)
+    monkeypatch.setitem(sys.modules, "fakelib.things", sub)
+    monkeypatch.setattr(
+        "detectmateservice_tpu.config.resolver.DEFAULT_ROOT", "fakelib"
+    )
+    return pkg
+
+
+class TestComponentLoader:
+    def test_load_by_full_path(self, fake_library):
+        inst = ComponentLoader(root="fakelib").load_component("fakelib.things.Good")
+        assert type(inst).__name__ == "Good"
+
+    def test_load_by_root_relative_path(self, fake_library):
+        inst = ComponentLoader(root="fakelib").load_component("things.Good")
+        assert type(inst).__name__ == "Good"
+
+    def test_no_arg_instantiation_when_config_falsy(self, fake_library):
+        # pinned in the reference (test_component_loader.py:90-139)
+        inst = ComponentLoader(root="fakelib").load_component("things.Good", config={})
+        assert inst.got_config is None  # falsy config -> no-arg constructor
+
+    def test_config_passed_through(self, fake_library):
+        inst = ComponentLoader(root="fakelib").load_component(
+            "things.Good", config={"method_type": "good", "knob": 5}
+        )
+        assert inst.got_config == {"method_type": "good", "knob": 5}
+
+    def test_missing_module_import_error(self, fake_library):
+        with pytest.raises(ImportError):
+            ComponentLoader(root="fakelib").load_component("nosuch.Thing")
+
+    def test_missing_class_attribute_error(self, fake_library):
+        with pytest.raises(AttributeError):
+            ComponentLoader(root="fakelib").load_component("things.Missing")
+
+    def test_not_component_runtime_error(self, fake_library):
+        with pytest.raises(RuntimeError):
+            ComponentLoader(root="fakelib").load_component("things.NotAComponent")
+
+
+class TestConfigClassLoader:
+    def test_load_config_class(self, fake_library):
+        cls = ConfigClassLoader(root="fakelib").load_config_class("things.GoodConfig")
+        assert cls.__name__ == "GoodConfig"
+
+    def test_not_config_runtime_error(self, fake_library):
+        with pytest.raises(RuntimeError):
+            ConfigClassLoader(root="fakelib").load_config_class("things.NotAComponent")
+
+
+class TestComponentResolver:
+    def test_dotted_path_passthrough(self):
+        path, config = ComponentResolver().resolve("a.b.Thing")
+        assert path == "a.b.Thing"
+        assert config == "a.b.ThingConfig"
+
+    def test_short_name_walk_real_library(self):
+        path, config = ComponentResolver().resolve("NewValueDetector")
+        assert path.endswith(".NewValueDetector")
+        assert config.endswith("NewValueDetectorConfig")
+
+    def test_short_name_matcher_parser(self):
+        path, _ = ComponentResolver().resolve("MatcherParser")
+        assert path.endswith(".MatcherParser")
+
+    def test_unknown_short_name(self):
+        from detectmateservice_tpu.config.resolver import ResolverError
+
+        with pytest.raises(ResolverError):
+            ComponentResolver().resolve("NoSuchComponent")
+
+
+class TestConfigNormalization:
+    """The reference library's documented pipeline (docs/interfaces.md:74-82)."""
+
+    def test_params_flattened(self):
+        out = normalize_config({
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": "<A>", "params": {"lowercase": True},
+        })
+        assert out["lowercase"] is True
+        assert "params" not in out
+
+    def test_all_prefix_broadcast(self):
+        out = normalize_config({
+            "method_type": "x", "auto_config": False,
+            "params": {"all_threshold": 0.5},
+            "events": {1: {"inst": {"variables": [{"pos": 0, "name": "v"}]}}},
+        })
+        var = out["events"][1]["inst"]["variables"][0]
+        assert var["params"]["threshold"] == 0.5
+        assert out["threshold"] == 0.5  # stripped prefix also lands top-level
+
+    def test_all_prefix_does_not_override_explicit(self):
+        out = normalize_config({
+            "auto_config": False,
+            "params": {"all_threshold": 0.5},
+            "events": {1: {"inst": {"variables": [{"pos": 0, "params": {"threshold": 0.9}}]}}},
+        })
+        assert out["events"][1]["inst"]["variables"][0]["params"]["threshold"] == 0.9
+
+    def test_auto_config_gate(self):
+        with pytest.raises(AutoConfigError):
+            normalize_config({"method_type": "x", "auto_config": False})
+
+    def test_method_type_mismatch(self):
+        with pytest.raises(MethodTypeError):
+            normalize_config({"method_type": "wrong", "auto_config": True},
+                             expected_method_type="right")

@@ -1,0 +1,251 @@
+"""In-tree component-library tests: parser, detectors, readers, doubles."""
+import pytest
+
+from detectmateservice_tpu.library.common.core import CoreConfig, LibraryError
+from detectmateservice_tpu.library.common.detector import CoreDetector, CoreDetectorConfig
+from detectmateservice_tpu.library.detectors import (
+    NewValueComboDetector,
+    NewValueDetector,
+    RandomDetector,
+)
+from detectmateservice_tpu.library.helper import From
+from detectmateservice_tpu.library.parsers import MatcherParser
+from detectmateservice_tpu.library.readers import LogFileReader
+from detectmateservice_tpu.library.testing import DummyDetector, DummyParser
+from detectmateservice_tpu.schemas import DetectorSchema, LogSchema, ParserSchema
+
+NGINX_FORMAT = '<IP> - - [<Time>] "<Method> <URL> <Protocol>" <Status> <Bytes> "<Referer>" "<UserAgent>"'
+
+
+def nginx_line(url="/hello", ip="::1"):
+    return f'{ip} - - [18/Mar/2026:11:43:30 +0000] "GET {url} HTTP/1.1" 404 615 "-" "curl/8.5.0"'
+
+
+def parser_config(templates_path=None, **params):
+    base = {"remove_spaces": False, "remove_punctuation": False, "lowercase": False}
+    base.update(params)
+    base["path_templates"] = str(templates_path) if templates_path else None
+    return {"parsers": {"MatcherParser": {
+        "method_type": "matcher_parser", "auto_config": False,
+        "log_format": NGINX_FORMAT, "time_format": None, "params": base,
+    }}}
+
+
+class TestMatcherParser:
+    def test_header_variable_extraction(self):
+        parser = MatcherParser(config=parser_config())
+        out = parser.process(LogSchema(logID="1", log=nginx_line("/x")).serialize())
+        ps = ParserSchema.from_bytes(out)
+        hv = dict(ps.logFormatVariables)
+        assert hv["URL"] == "/x"
+        assert hv["Method"] == "GET"
+        assert hv["Status"] == "404"
+        assert ps.logID == "1"
+
+    def test_log_field_quirk_preserved(self):
+        # the reference's MatcherParser writes its own name into `log`
+        # (pinned by test_pipe_filereader_matcher_nvd.py:158-160)
+        parser = MatcherParser(config=parser_config())
+        ps = ParserSchema.from_bytes(
+            parser.process(LogSchema(log=nginx_line()).serialize())
+        )
+        assert ps.log == "MatcherParser"
+
+    def test_template_matching(self, tmp_path):
+        templates = tmp_path / "templates.txt"
+        templates.write_text("user <*> logged in from <*>\nquery failed: <*>\n")
+        config = {"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": None, "time_format": None,
+            "params": {"lowercase": True, "remove_spaces": False,
+                       "remove_punctuation": False, "path_templates": str(templates)},
+        }}}
+        parser = MatcherParser(config=config)
+        event_id, template, variables = parser.match_templates("User john logged in from 1.2.3.4")
+        assert event_id == 1
+        assert variables == ["john", "1.2.3.4"]
+        event_id2, _, vars2 = parser.match_templates("Query failed: timeout")
+        assert event_id2 == 2
+        assert vars2 == ["timeout"]
+        assert parser.match_templates("no such line")[0] == -1
+
+    def test_empty_line_filtered(self):
+        parser = MatcherParser(config=parser_config())
+        assert parser.process(LogSchema(log="").serialize()) is None
+
+    def test_method_type_mismatch_rejected(self):
+        bad = {"parsers": {"MatcherParser": {"method_type": "wrong_parser",
+                                             "auto_config": True}}}
+        with pytest.raises(Exception):
+            MatcherParser(config=bad)
+
+
+def nvd_config(training=2, alert_once=False):
+    return {"detectors": {"NewValueDetector": {
+        "method_type": "new_value_detector", "data_use_training": training,
+        "auto_config": False, "alert_once": alert_once,
+        "global": {"global_instance": {"header_variables": [{"pos": "URL"}]}},
+    }}}
+
+
+def parsed(url, log_id="1"):
+    return ParserSchema(
+        EventID=1, logID=log_id, logFormatVariables={"URL": url, "Time": "1700000000"},
+    ).serialize()
+
+
+class TestNewValueDetector:
+    def test_train_then_detect(self):
+        det = NewValueDetector(config=nvd_config(training=2))
+        assert det.process(parsed("/a")) is None   # training
+        assert det.process(parsed("/b")) is None   # training
+        assert det.process(parsed("/a")) is None   # known value
+        out = det.process(parsed("/evil"))
+        alert = DetectorSchema.from_bytes(out)
+        assert dict(alert.alertsObtain) == {"Global - URL": "Unknown value: '/evil'"}
+        assert alert.score == pytest.approx(1.0)
+        assert alert.detectorID == "NewValueDetector"
+        assert alert.detectorType == "new_value_detector"
+        assert list(alert.logIDs) == ["1"]
+        assert list(alert.extractedTimestamps) == [1700000000]
+
+    def test_alert_every_occurrence_by_default(self):
+        det = NewValueDetector(config=nvd_config(training=1))
+        det.process(parsed("/a"))
+        assert det.process(parsed("/evil")) is not None
+        assert det.process(parsed("/evil")) is not None
+
+    def test_alert_once(self):
+        det = NewValueDetector(config=nvd_config(training=1, alert_once=True))
+        det.process(parsed("/a"))
+        assert det.process(parsed("/evil")) is not None
+        assert det.process(parsed("/evil")) is None
+
+    def test_event_scoped_variables(self):
+        config = {"detectors": {"NewValueDetector": {
+            "method_type": "new_value_detector", "data_use_training": 1,
+            "auto_config": False,
+            "events": {1: {"inst": {"variables": [{"pos": 0, "name": "user"}]}}},
+        }}}
+        det = NewValueDetector(config=config)
+        msg = lambda user: ParserSchema(EventID=1, variables=[user]).serialize()
+        assert det.process(msg("alice")) is None  # training
+        assert det.process(msg("alice")) is None
+        alert = DetectorSchema.from_bytes(det.process(msg("mallory")))
+        assert dict(alert.alertsObtain) == {"Event 1 - user": "Unknown value: 'mallory'"}
+
+    def test_state_roundtrip(self):
+        det = NewValueDetector(config=nvd_config(training=1))
+        det.process(parsed("/a"))
+        state = det.state_dict()
+        det2 = NewValueDetector(config=nvd_config(training=1))
+        det2.load_state_dict(state)
+        assert det2.process(parsed("/a")) is None       # knows /a, no training
+        assert det2.process(parsed("/new")) is not None
+
+    def test_empty_config_never_alerts(self):
+        det = NewValueDetector()
+        assert det.process(parsed("/anything")) is None
+
+
+class TestNewValueComboDetector:
+    def test_combo_detection(self):
+        config = {"detectors": {"NewValueComboDetector": {
+            "method_type": "new_value_combo_detector", "data_use_training": 1,
+            "auto_config": False,
+            "global": {"combo": {"header_variables": [{"pos": "URL"}, {"pos": "Method"}]}},
+        }}}
+        det = NewValueComboDetector(config=config)
+        msg = lambda url, method: ParserSchema(
+            EventID=1, logFormatVariables={"URL": url, "Method": method}
+        ).serialize()
+        assert det.process(msg("/a", "GET")) is None     # training
+        assert det.process(msg("/a", "GET")) is None     # known combo
+        assert det.process(msg("/a", "POST")) is not None  # new combination
+
+
+class TestRandomDetector:
+    def test_threshold_zero_always_detects(self):
+        config = {"detectors": {"RandomDetector": {
+            "method_type": "random_detector", "auto_config": False,
+            "events": {1: {"test": {"variables": [
+                {"pos": 0, "name": "var1", "params": {"threshold": -0.1}}]}}},
+        }}}
+        det = RandomDetector(config=config)
+        out = det.process(ParserSchema(EventID=1, variables=["x"]).serialize())
+        assert out is not None
+
+    def test_threshold_one_never_detects(self):
+        config = {"detectors": {"RandomDetector": {
+            "method_type": "random_detector", "auto_config": False,
+            "events": {1: {"test": {"variables": [
+                {"pos": 0, "name": "var1", "params": {"threshold": 1.1}}]}}},
+        }}}
+        det = RandomDetector(config=config)
+        assert det.process(ParserSchema(EventID=1, variables=["x"]).serialize()) is None
+
+
+class TestDoubles:
+    def test_dummy_parser_fixed_output(self):
+        parser = DummyParser()
+        out = ParserSchema.from_bytes(parser.process(LogSchema(logID="9", log="x").serialize()))
+        assert out.template == "User <*> logged in from <*>"
+        assert list(out.variables) == ["john", "192.168.1.100"]
+        assert out.logID == "9"
+
+    def test_dummy_detector_false_true_false(self):
+        det = DummyDetector()
+        results = [det.process(parsed(f"/{i}")) for i in range(6)]
+        pattern = [r is not None for r in results]
+        assert pattern == [False, True, False, False, True, False]
+
+
+class TestReaderAndFrom:
+    def test_log_file_reader_process(self):
+        reader = LogFileReader()
+        out = LogSchema.from_bytes(reader.process(b"a log line\n"))
+        assert out.log == "a log line"
+        assert out.logID
+
+    def test_log_file_reader_read(self, tmp_path):
+        f = tmp_path / "x.log"
+        f.write_text("one\n\ntwo\n")
+        reader = LogFileReader(config={"readers": {"LogFileReader": {
+            "method_type": "log_file", "auto_config": False, "path": str(f)}}})
+        logs = list(reader.read())
+        assert [l.log for l in logs] == ["one", "two"]
+
+    def test_from_log_yields_schemas_and_nones(self, tmp_path):
+        f = tmp_path / "x.log"
+        f.write_text("alpha\n\nbeta\n")
+        parser = MatcherParser(config=parser_config())
+        items = list(From.log(parser, f, do_process=True))
+        assert items[1] is None
+        kept = [i for i in items if i is not None]
+        assert [i.log for i in kept] == ["alpha", "beta"]
+        assert all(hasattr(i, "logID") for i in kept)
+
+
+class TestCoreDetectorContract:
+    def test_subclass_must_implement_detect(self):
+        class Incomplete(CoreDetector):
+            pass
+
+        det = Incomplete(config=None)
+        with pytest.raises(NotImplementedError):
+            det.process(parsed("/x"))
+
+    def test_bad_bytes_raise_library_error(self):
+        det = NewValueDetector()
+        with pytest.raises(LibraryError):
+            det.process(b"\xff\xfe garbage")
+
+    def test_alert_ids_increment_from_start_id(self):
+        config = {"detectors": {"DummyDetector": {
+            "method_type": "dummy_detector", "auto_config": False,
+            "start_id": 10, "pattern": [True],
+        }}}
+        det = DummyDetector(config=config)
+        a1 = DetectorSchema.from_bytes(det.process(parsed("/a")))
+        a2 = DetectorSchema.from_bytes(det.process(parsed("/b")))
+        assert (a1.alertID, a2.alertID) == ("10", "11")

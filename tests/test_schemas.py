@@ -1,0 +1,113 @@
+"""Schema wrapper + wire-format tests."""
+import pytest
+
+from detectmateservice_tpu.schemas import (
+    DetectorSchema,
+    LogSchema,
+    OutputSchema,
+    ParserSchema,
+    SchemaError,
+)
+from detectmateservice_tpu.schemas import schemas_pb2 as pb
+
+
+class TestRoundTrip:
+    def test_log_schema(self):
+        msg = LogSchema({"logID": "1", "log": "hello", "logSource": "s", "hostname": "h"})
+        back = LogSchema.from_bytes(msg.serialize())
+        assert back.logID == "1" and back.log == "hello"
+        assert back["logSource"] == "s"
+
+    def test_parser_schema_full(self):
+        msg = ParserSchema(
+            parserType="LogParser", parserID="p1", EventID=7,
+            template="User <*> logged in", variables=["john"],
+            parsedLogID="x", logID="1", log="raw",
+            logFormatVariables={"ip": "1.2.3.4"},
+            receivedTimestamp=123, parsedTimestamp=124,
+        )
+        back = ParserSchema.from_bytes(msg.serialize())
+        assert back.EventID == 7
+        assert list(back.variables) == ["john"]
+        assert dict(back.logFormatVariables) == {"ip": "1.2.3.4"}
+
+    def test_detector_schema(self):
+        msg = DetectorSchema(score=2.5, logIDs=["a", "b"], extractedTimestamps=[1, 2])
+        msg["alertsObtain"].update({"Global - URL": "x"})
+        back = DetectorSchema.from_bytes(msg.serialize())
+        assert back.score == pytest.approx(2.5)
+        assert list(back.logIDs) == ["a", "b"]
+
+    def test_output_schema(self):
+        msg = OutputSchema(detectorIDs=["d"], alertIDs=["1"], outputTimestamp=5)
+        back = OutputSchema.from_bytes(msg.serialize())
+        assert list(back.detectorIDs) == ["d"]
+
+    def test_version_auto_set(self):
+        assert LogSchema().get("__version__") == "1.0.0"
+
+
+class TestDictAccess:
+    def test_setitem_getitem(self):
+        msg = ParserSchema()
+        msg["EventID"] = 3
+        assert msg["EventID"] == 3
+
+    def test_unknown_field_raises(self):
+        with pytest.raises(SchemaError):
+            ParserSchema()["nope"]
+        with pytest.raises(SchemaError):
+            ParserSchema()["nope"] = 1
+
+    def test_attribute_set(self):
+        msg = LogSchema()
+        msg.log = "x"
+        assert msg.log == "x"
+
+    def test_construct_from_dict_mirror_of_reference_fixture(self):
+        # shape from the reference's fixtures
+        # (tests/library_integration/library_integration_base_fixtures.py:26-43)
+        config = {
+            "parserType": "LogParser",
+            "parserID": "parser_001",
+            "EventID": 1,
+            "template": "User <*> logged in from <*>",
+            "variables": ["john", "192.168.1.100"],
+            "parsedLogID": "101",
+            "logID": "1",
+            "log": "User john logged in from 192.168.1.100",
+            "logFormatVariables": {"username": "john", "ip": "192.168.1.100", "Time": "1634567890"},
+            "receivedTimestamp": 1634567890,
+            "parsedTimestamp": 1634567891,
+        }
+        msg = ParserSchema(config)
+        back = ParserSchema.from_bytes(msg.serialize())
+        assert back.to_dict()["parserID"] == "parser_001"
+
+    def test_deserialize_garbage_raises(self):
+        with pytest.raises(SchemaError):
+            ParserSchema().deserialize(b"\xff\xff\xff\xff\xff")
+
+
+class TestWireParity:
+    """Field numbers must match the reference descriptor
+    (container/fluentout/schemas_pb.rb:8)."""
+
+    def test_field_numbers(self):
+        ps = pb.ParserSchema.DESCRIPTOR.fields_by_name
+        assert ps["EventID"].number == 4
+        assert ps["variables"].number == 6
+        assert ps["logFormatVariables"].number == 10
+        ds = pb.DetectorSchema.DESCRIPTOR.fields_by_name
+        assert ds["score"].number == 8          # note the 7-gap
+        assert ds["extractedTimestamps"].number == 9
+        assert ds["alertsObtain"].number == 12  # note the gaps
+        os_ = pb.OutputSchema.DESCRIPTOR.fields_by_name
+        assert os_["extractedTimestamps"].number == 9
+
+    def test_raw_pb_interop(self):
+        raw = pb.DetectorSchema()
+        raw.score = 1.5
+        raw.logIDs.append("z")
+        wrapped = DetectorSchema.from_bytes(raw.SerializeToString())
+        assert wrapped.score == pytest.approx(1.5)

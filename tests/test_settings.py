@@ -1,0 +1,129 @@
+"""Tier-1 settings tests (model of the reference's test_component_id.py,
+test_config_reading.py, test_tls_settings.py)."""
+import pytest
+import yaml
+
+from detectmateservice_tpu.settings import ServiceSettings, TlsInputConfig
+
+
+class TestComponentIdentity:
+    def test_named_identity_stable(self):
+        a = ServiceSettings(component_type="detectors.X", component_name="alpha")
+        b = ServiceSettings(component_type="detectors.X", component_name="alpha")
+        assert a.component_id == b.component_id
+        assert len(a.component_id) == 32
+
+    def test_nameless_identity_uses_engine_addr(self):
+        a = ServiceSettings(component_type="core", engine_addr="ipc:///tmp/a.ipc")
+        b = ServiceSettings(component_type="core", engine_addr="ipc:///tmp/a.ipc")
+        c = ServiceSettings(component_type="core", engine_addr="ipc:///tmp/c.ipc")
+        assert a.component_id == b.component_id
+        assert a.component_id != c.component_id
+
+    def test_name_changes_identity(self):
+        a = ServiceSettings(component_type="core", component_name="x")
+        b = ServiceSettings(component_type="core", component_name="y")
+        assert a.component_id != b.component_id
+
+    def test_explicit_id_wins(self):
+        s = ServiceSettings(component_id="deadbeef")
+        assert s.component_id == "deadbeef"
+
+
+class TestAddressValidation:
+    @pytest.mark.parametrize("addr", [
+        "ipc:///tmp/x.ipc",
+        "tcp://127.0.0.1:5555",
+        "inproc://x",
+        "ws://127.0.0.1:8080",
+    ])
+    def test_valid(self, addr):
+        assert ServiceSettings(engine_addr=addr).engine_addr == addr
+
+    @pytest.mark.parametrize("addr", [
+        "http://127.0.0.1:80",   # unknown scheme
+        "bogus:///x",
+        "tcp://127.0.0.1",       # missing port
+        "noscheme",
+    ])
+    def test_invalid(self, addr):
+        with pytest.raises(Exception):
+            ServiceSettings(engine_addr=addr)
+
+    def test_invalid_out_addr(self):
+        with pytest.raises(Exception):
+            ServiceSettings(out_addr=["ftp://x:1"])
+
+
+class TestTlsCrossValidation:
+    def test_tls_engine_requires_tls_input(self):
+        with pytest.raises(Exception):
+            ServiceSettings(engine_addr="tls+tcp://127.0.0.1:5555")
+
+    def test_tls_engine_with_input_ok(self):
+        s = ServiceSettings(
+            engine_addr="tls+tcp://127.0.0.1:5555",
+            tls_input=TlsInputConfig(cert_key_file="/tmp/cert.pem"),
+        )
+        assert s.tls_input.cert_key_file == "/tmp/cert.pem"
+
+    def test_tls_out_requires_tls_output(self):
+        with pytest.raises(Exception):
+            ServiceSettings(out_addr=["tls+tcp://127.0.0.1:5555"])
+
+
+class TestBounds:
+    def test_retry_count_min(self):
+        with pytest.raises(Exception):
+            ServiceSettings(engine_retry_count=0)
+
+    def test_buffer_size_max(self):
+        with pytest.raises(Exception):
+            ServiceSettings(engine_buffer_size=8193)
+
+    def test_extra_forbidden(self):
+        with pytest.raises(Exception):
+            ServiceSettings(not_a_field=1)
+
+
+class TestYamlAndEnv:
+    def test_from_yaml(self, tmp_path):
+        path = tmp_path / "s.yaml"
+        path.write_text(yaml.safe_dump({
+            "component_type": "core",
+            "engine_addr": "ipc:///tmp/y.ipc",
+            "http_port": 9001,
+        }))
+        s = ServiceSettings.from_yaml(str(path))
+        assert s.http_port == 9001
+
+    def test_env_overrides_yaml(self, tmp_path, monkeypatch):
+        path = tmp_path / "s.yaml"
+        path.write_text(yaml.safe_dump({"http_port": 9001, "component_name": "from-yaml"}))
+        monkeypatch.setenv("DETECTMATE_HTTP_PORT", "9002")
+        s = ServiceSettings.from_yaml(str(path))
+        assert s.http_port == 9002
+        assert s.component_name == "from-yaml"  # non-overridden fields survive
+
+    def test_env_nested_delimiter(self, tmp_path, monkeypatch):
+        path = tmp_path / "s.yaml"
+        path.write_text(yaml.safe_dump({
+            "engine_addr": "tls+tcp://127.0.0.1:5555",
+            "tls_input": {"cert_key_file": "/old.pem"},
+        }))
+        monkeypatch.setenv("DETECTMATE_TLS_INPUT__CERT_KEY_FILE", "/new.pem")
+        s = ServiceSettings.from_yaml(str(path))
+        assert s.tls_input.cert_key_file == "/new.pem"
+
+    def test_env_json_list(self, tmp_path, monkeypatch):
+        path = tmp_path / "s.yaml"
+        path.write_text(yaml.safe_dump({}))
+        monkeypatch.setenv("DETECTMATE_OUT_ADDR", '["tcp://127.0.0.1:1111"]')
+        s = ServiceSettings.from_yaml(str(path))
+        assert s.out_addr == ["tcp://127.0.0.1:1111"]
+
+    def test_bad_yaml_exits(self, tmp_path):
+        path = tmp_path / "s.yaml"
+        path.write_text(yaml.safe_dump({"engine_addr": "bogus://x"}))
+        with pytest.raises(SystemExit):
+            ServiceSettings.from_yaml(str(path))

@@ -1,0 +1,129 @@
+"""Transport-layer tests: schemes, timeouts, stale ipc cleanup, real TLS
+(model of the reference's tests/test_tls_transport.py:52-258 and
+tests/test_engine_socket_factory_error_handling.py:74-125)."""
+import subprocess
+import time
+
+import pytest
+
+from detectmateservice_tpu.engine.socket import (
+    TlsTcpSocketFactory,
+    TransportError,
+    TransportTimeout,
+    ZmqPairSocketFactory,
+)
+from detectmateservice_tpu.settings import TlsInputConfig, TlsOutputConfig
+
+
+class TestZmqFactory:
+    def test_recv_timeout(self, tmp_path):
+        factory = ZmqPairSocketFactory()
+        sock = factory.create(f"ipc://{tmp_path}/t.ipc")
+        sock.recv_timeout = 50
+        with pytest.raises(TransportTimeout):
+            sock.recv()
+        sock.close()
+
+    def test_stale_ipc_file_unlinked(self, tmp_path):
+        path = tmp_path / "stale.ipc"
+        path.write_text("stale")
+        factory = ZmqPairSocketFactory()
+        sock = factory.create(f"ipc://{path}")
+        sock.close()
+
+    def test_bad_scheme_rejected(self):
+        with pytest.raises(TransportError):
+            ZmqPairSocketFactory().create("bogus://x")
+
+    def test_tcp_requires_port(self):
+        with pytest.raises(TransportError):
+            ZmqPairSocketFactory().create("tcp://127.0.0.1")
+
+    def test_port_in_use(self, free_port):
+        factory = ZmqPairSocketFactory()
+        first = factory.create(f"tcp://127.0.0.1:{free_port}")
+        with pytest.raises(TransportError):
+            factory.create(f"tcp://127.0.0.1:{free_port}")
+        first.close()
+
+    def test_inproc_pair(self):
+        factory = ZmqPairSocketFactory()
+        server = factory.create("inproc://tp1")
+        client = factory.create_output("inproc://tp1")
+        client.send(b"ping")
+        server.recv_timeout = 2000
+        assert server.recv() == b"ping"
+        server.send(b"pong")
+        client.recv_timeout = 2000
+        assert client.recv() == b"pong"
+        client.close()
+        server.close()
+
+
+@pytest.fixture(scope="module")
+def tls_material(tmp_path_factory):
+    """Throwaway CA + server cert via the openssl CLI (the reference's
+    approach, tests/test_tls_transport.py:52-99)."""
+    d = tmp_path_factory.mktemp("tls")
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    srv_key, srv_csr, srv_crt = d / "srv.key", d / "srv.csr", d / "srv.crt"
+    cert_key = d / "server_bundle.pem"
+    run = lambda *cmd: subprocess.run(cmd, check=True, capture_output=True)
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+        "-subj", "/CN=testca")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(srv_key), "-out", str(srv_csr), "-subj", "/CN=localhost")
+    run("openssl", "x509", "-req", "-in", str(srv_csr), "-CA", str(ca_crt),
+        "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(srv_crt),
+        "-days", "1")
+    cert_key.write_text(srv_crt.read_text() + srv_key.read_text())
+    return {"ca_file": str(ca_crt), "cert_key_file": str(cert_key)}
+
+
+class TestTlsTransport:
+    def test_happy_path_roundtrip(self, tls_material, free_port):
+        factory = TlsTcpSocketFactory()
+        addr = f"tls+tcp://127.0.0.1:{free_port}"
+        server = factory.create(
+            addr, tls_config=TlsInputConfig(cert_key_file=tls_material["cert_key_file"])
+        )
+        client = factory.create_output(
+            addr,
+            tls_config=TlsOutputConfig(
+                ca_file=tls_material["ca_file"], server_name="localhost"
+            ),
+        )
+        deadline = time.monotonic() + 5.0
+        sent = False
+        while time.monotonic() < deadline and not sent:
+            try:
+                client.send(b"secret")
+                sent = True
+            except TransportError:
+                time.sleep(0.05)
+        assert sent, "client never connected"
+        server.recv_timeout = 5000
+        assert server.recv() == b"secret"
+        server.send(b"reply")
+        client.recv_timeout = 5000
+        assert client.recv() == b"reply"
+        client.close()
+        server.close()
+
+    def test_listener_requires_cert(self, free_port):
+        with pytest.raises(TransportError):
+            TlsTcpSocketFactory().create(f"tls+tcp://127.0.0.1:{free_port}", tls_config=None)
+
+    def test_dialer_requires_ca(self, free_port):
+        with pytest.raises(TransportError):
+            TlsTcpSocketFactory().create_output(
+                f"tls+tcp://127.0.0.1:{free_port}", tls_config=None
+            )
+
+    def test_bad_cert_path_errors(self, free_port):
+        with pytest.raises(TransportError):
+            TlsTcpSocketFactory().create(
+                f"tls+tcp://127.0.0.1:{free_port}",
+                tls_config=TlsInputConfig(cert_key_file="/nonexistent.pem"),
+            )
