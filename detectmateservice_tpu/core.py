@@ -78,6 +78,13 @@ class LibraryComponentProcessor:
                 return batch_fn(batch)
             return [self.component.process(data) for data in batch]
 
+    def flush(self):
+        """Drain a pipelined component (engine calls this on idle and stop)."""
+        if self.component is None:
+            return []
+        flush_fn = getattr(self.component, "flush", None)
+        return flush_fn() if callable(flush_fn) else []
+
 
 class Service:
     def __init__(

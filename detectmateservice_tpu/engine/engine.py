@@ -58,7 +58,14 @@ class Processor(Protocol):
 
 @runtime_checkable
 class BatchProcessor(Protocol):
-    """Batched contract for accelerator-backed processors (TPU addition)."""
+    """Batched contract for accelerator-backed processors (TPU addition).
+
+    ``process_batch`` returns the in-order outputs that are *ready* — a
+    pipelined processor may defer a batch's results to a later call to
+    overlap device compute/readback with host-side work; ordering across
+    calls must be preserved. ``flush()`` (optional) drains anything pending
+    and is called by the engine when the input goes idle and at stop.
+    """
 
     def process_batch(self, data: List[bytes]) -> List[Optional[bytes]]: ...
 
@@ -193,10 +200,21 @@ class Engine:
         use_batches = batch_size > 1 and callable(batch_fn)
         batch_timeout_s = self.settings.engine_batch_timeout_ms / 1000.0
 
+        flush_fn = getattr(self.processor, "flush", None) if use_batches else None
         while self._running and not self._stop_event.is_set():
             try:
                 raw = self._pair_sock.recv()
             except TransportTimeout:
+                # input went idle: drain any pipelined results so a quiet
+                # stream still gets bounded latency
+                if callable(flush_fn):
+                    try:
+                        for out in flush_fn():
+                            if out is not None:
+                                self._send_to_outputs(out)
+                    except Exception as exc:
+                        err_c.inc()
+                        self.logger.error("flush() raised: %s", exc)
                 continue
             except TransportError as exc:
                 if not self._running:
@@ -247,15 +265,18 @@ class Engine:
                 err_c.inc(len(batch))
                 self.logger.error("process_batch() raised: %s", exc)
                 continue
-            if len(outs) != len(batch):
-                err_c.inc(len(batch))
-                self.logger.error(
-                    "process_batch() returned %d results for %d inputs", len(outs), len(batch)
-                )
-                continue
             for out in outs:  # in-order, per-message None filtering
                 if out is not None:
                     self._send_to_outputs(out)
+
+        # loop exiting (stop requested): drain the pipeline before sockets close
+        if callable(flush_fn):
+            try:
+                for out in flush_fn():
+                    if out is not None:
+                        self._send_to_outputs(out)
+            except Exception as exc:
+                self.logger.error("flush() at stop raised: %s", exc)
 
     # -- fan-out --------------------------------------------------------
     def _send_to_outputs(self, data: bytes) -> bool:

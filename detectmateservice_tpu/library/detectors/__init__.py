@@ -11,3 +11,7 @@ __all__ = [
     "NewValueComboDetector", "NewValueComboDetectorConfig",
     "RandomDetector", "RandomDetectorConfig",
 ]
+
+from .jax_scorer import JaxScorerDetector, JaxScorerDetectorConfig
+
+__all__ += ["JaxScorerDetector", "JaxScorerDetectorConfig"]

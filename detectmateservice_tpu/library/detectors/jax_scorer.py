@@ -1,0 +1,389 @@
+"""JaxScorerDetector: TPU-batched neural anomaly scoring.
+
+This is the component the BASELINE.json north star describes: the engine
+micro-batches incoming messages and dispatches them to a jax.jit-compiled
+anomaly scorer instead of the per-message callback; params live in device HBM
+from ``setup_io`` on. The reference has no accelerator path at all (SURVEY.md
+§0 "no training, no GPU/accelerator code") — this detector is the TPU-native
+capability the rebuild adds, wrapped in the same CoreDetector contract
+(train-then-detect, alert-or-None per message).
+
+Phases:
+1. **train** — the first ``data_use_training`` messages are tokenized and
+   buffered (filtered from the output, like every detector's training phase),
+2. **fit** — at the phase boundary the scorer trains for ``train_epochs``
+   over the buffer on-device, then calibrates the alert threshold as
+   ``mean + threshold_sigma * std`` of the training scores,
+3. **detect** — batches are tokenized on CPU, padded to a power-of-two bucket
+   (few compiled shapes → no recompile storms, SURVEY.md §7 hard part #2), and
+   scored in one jit call; scores above threshold become DetectorSchema alerts.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...schemas import DetectorSchema, ParserSchema, SchemaError
+from ..common.core import LibraryError
+from ..common.detector import BufferMode, CoreDetector, CoreDetectorConfig
+
+
+class JaxScorerDetectorConfig(CoreDetectorConfig):
+    method_type: str = "jax_scorer"
+    model: str = "mlp"                # "mlp" | "logbert"
+    vocab_size: int = 32768
+    seq_len: int = 32
+    dim: int = 128
+    depth: int = 2                    # logbert only
+    heads: int = 4                    # logbert only
+    data_use_training: int = 256
+    train_epochs: int = 3
+    # small training buffers still get enough optimizer steps to converge
+    min_train_steps: int = 100
+    train_batch_size: int = 32
+    threshold_sigma: float = 4.0
+    score_threshold: Optional[float] = None  # explicit override wins
+    max_batch: int = 1024
+    # how many scored batches may be in flight before results are forced
+    # back to the host; hides device→host readback latency behind the next
+    # batch's CPU featurization (jax dispatch is async)
+    pipeline_depth: int = 4
+    device: Optional[str] = None      # e.g. "tpu:0"; default = first device
+    seed: int = 0
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    """Round a ragged batch size up to a power of two (≤ max_batch)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch)
+
+
+class JaxScorerDetector(CoreDetector):
+    config_class = JaxScorerDetectorConfig
+    description = "JaxScorerDetector flags log lines the TPU scorer finds improbable."
+
+    def __init__(self, name: Optional[str] = None, config: Any = None,
+                 buffer_mode: BufferMode = BufferMode.MICRO_BATCH) -> None:
+        super().__init__(name=name or "JaxScorerDetector", buffer_mode=buffer_mode,
+                         config=config)
+        self.config: JaxScorerDetectorConfig
+        from ...models.tokenizer import HashTokenizer
+
+        self._tokenizer = HashTokenizer(
+            vocab_size=self.config.vocab_size, seq_len=self.config.seq_len
+        )
+        self._scorer = None
+        self._params = None
+        self._opt_state = None
+        self._rng = None
+        self._device = None
+        self._threshold: Optional[float] = self.config.score_threshold
+        self._train_buffer: List[np.ndarray] = []
+        self._fitted = False
+        self._metrics_labels = None
+        # in-flight scored batches: (scores_device_array, parsed_msgs, n_real)
+        from collections import deque
+
+        self._inflight = deque()
+
+    # -- lifecycle ------------------------------------------------------
+    def setup_io(self) -> None:
+        """Build the model, init params, pin them on the device, and warm up
+        the compile cache for every batch bucket (reference hook role:
+        core.py:209-211 'load models here')."""
+        self._ensure_scorer()
+        import jax
+
+        warm = np.zeros((1, self.config.seq_len), np.int32)
+        for b in (1, 8, self.config.train_batch_size, self.config.max_batch):
+            bucket = _bucket(b, self.config.max_batch)
+            tokens = np.zeros((bucket, self.config.seq_len), np.int32)
+            jax.block_until_ready(self._scorer.score(self._params, self._put(tokens)))
+        del warm
+
+    def _ensure_scorer(self) -> None:
+        if self._scorer is not None:
+            return
+        import jax
+
+        cfg = self.config
+        if cfg.model == "logbert":
+            from ...models.logbert import LogBERTConfig, LogBERTScorer
+
+            self._scorer = LogBERTScorer(LogBERTConfig(
+                vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
+                heads=cfg.heads, seq_len=cfg.seq_len,
+            ))
+        elif cfg.model == "mlp":
+            from ...models.mlp import MLPScorer, MLPScorerConfig
+
+            self._scorer = MLPScorer(MLPScorerConfig(
+                vocab_size=cfg.vocab_size, dim=cfg.dim, seq_len=cfg.seq_len,
+            ))
+        else:
+            raise LibraryError(f"unknown scorer model {cfg.model!r}")
+        devices = jax.devices()
+        self._device = devices[0]
+        if cfg.device:
+            for d in devices:
+                if str(d).lower().startswith(cfg.device.lower()):
+                    self._device = d
+                    break
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        params, opt_state = self._scorer.init(self._rng)
+        # params pinned in device memory once (HBM residency; north-star item)
+        self._params = jax.device_put(params, self._device)
+        self._opt_state = jax.device_put(opt_state, self._device)
+
+    def _put(self, array: np.ndarray):
+        import jax
+
+        return jax.device_put(array, self._device)
+
+    # -- featurization (CPU side) ---------------------------------------
+    def featurize(self, input_: ParserSchema) -> np.ndarray:
+        return self._tokenizer.encode_parsed(
+            input_.get("template") or "",
+            list(input_["variables"]),
+            dict(input_["logFormatVariables"]),
+        )
+
+    # -- training -------------------------------------------------------
+    def fit(self) -> Dict[str, float]:
+        """Train on the buffered normal traffic, calibrate the threshold."""
+        self._ensure_scorer()
+        import jax
+
+        cfg = self.config
+        if not self._train_buffer:
+            self._fitted = True
+            if self._threshold is None:
+                self._threshold = float("inf")
+            return {"loss": float("nan"), "threshold": self._threshold}
+        data = np.stack(self._train_buffer)
+        self._train_buffer = []
+        bs = min(cfg.train_batch_size, len(data))
+        loss = float("nan")
+        rng = np.random.default_rng(cfg.seed)
+        steps_per_epoch = max(1, len(data) // bs)
+        epochs = max(cfg.train_epochs,
+                     -(-cfg.min_train_steps // steps_per_epoch))  # ceil division
+        for _ in range(epochs):
+            order = rng.permutation(len(data))
+            for start in range(0, len(data) - bs + 1, bs):
+                batch = data[order[start:start + bs]]
+                self._rng, step_rng = jax.random.split(self._rng)
+                self._params, self._opt_state, loss_arr = self._scorer.train_step(
+                    self._params, self._opt_state, step_rng, self._put(batch)
+                )
+                loss = float(loss_arr)
+        if self._threshold is None:
+            scores = np.concatenate([
+                np.asarray(self._scorer.score(self._params, self._put(data[i:i + bs])))
+                for i in range(0, len(data), bs)
+            ])[: len(data)]
+            self._threshold = float(scores.mean() + cfg.threshold_sigma * scores.std())
+        self._fitted = True
+        return {"loss": loss, "threshold": self._threshold}
+
+    # -- scoring --------------------------------------------------------
+    def score_tokens(self, tokens: np.ndarray) -> np.ndarray:
+        """[N, S] → [N] fp32 scores, padded up to a compile bucket."""
+        self._ensure_scorer()
+        n = len(tokens)
+        bucket = _bucket(n, self.config.max_batch)
+        out = np.empty((n,), np.float32)
+        for start in range(0, n, bucket):
+            chunk = tokens[start:start + bucket]
+            if len(chunk) < bucket:
+                pad = np.zeros((bucket - len(chunk), tokens.shape[1]), np.int32)
+                chunk = np.concatenate([chunk, pad])
+            scores = np.asarray(self._scorer.score(self._params, self._put(chunk)))
+            out[start:start + min(bucket, n - start)] = scores[: min(bucket, n - start)]
+        return out
+
+    # -- engine contract ------------------------------------------------
+    def _featurize_pb_into(self, msg, out_row: np.ndarray) -> None:
+        """Featurize a decoded pb2 ParserSchema into a zeroed token row.
+
+        Hot-path twin of ``featurize`` that skips the wrapper layer (dict
+        copies of map fields dominated the profile)."""
+        parts = [msg.template]
+        parts.extend(msg.variables)
+        lfv = msg.logFormatVariables
+        if lfv:
+            parts.extend(f"{k}={lfv[k]}" for k in sorted(lfv))
+        self._tokenizer.encode_into(" ".join(parts), out_row)
+
+    def _featurize_raw_batch(self, batch: List[bytes]):
+        """Serialized ParserSchema bytes → ([N, S] int32 tokens, [N] ok bool).
+
+        Native kernel when built (protobuf wire parse + tokenize + hash in C,
+        ~20× the Python path); Python fallback otherwise — both produce
+        identical rows (pinned by tests/test_native_kernels.py)."""
+        try:
+            from ...utils import matchkern
+
+            return matchkern.featurize_batch(
+                batch, self.config.seq_len, self.config.vocab_size
+            )
+        except ImportError:
+            pass
+        from ...schemas import schemas_pb2 as _pb
+
+        tokens = np.zeros((len(batch), self.config.seq_len), np.int32)
+        ok = np.zeros(len(batch), dtype=bool)
+        for i, raw in enumerate(batch):
+            msg = _pb.ParserSchema()
+            try:
+                msg.ParseFromString(raw)
+            except Exception:
+                continue
+            self._featurize_pb_into(msg, tokens[i])
+            ok[i] = True
+        return tokens, ok
+
+    def process_batch(self, batch: List[bytes]) -> List[Optional[bytes]]:
+        """Batched hot path: one featurize kernel + one jit call per
+        micro-batch, preserving the per-message in-order None-filtering
+        contract. Raw bytes are decoded into schema objects only for the
+        (rare) anomalous messages, at alert-construction time."""
+        tokens, ok = self._featurize_raw_batch(batch)
+
+        # split the batch across the train/detect phase boundary
+        detect_idx: List[int] = []
+        for i in range(len(batch)):
+            if not ok[i]:
+                continue
+            if self._trained < self.config.data_use_training:
+                self._train_buffer.append(tokens[i])
+                self._trained += 1
+                if self._trained == self.config.data_use_training:
+                    self.fit()
+            else:
+                if not self._fitted:
+                    self.fit()
+                detect_idx.append(i)
+        ready: List[Optional[bytes]] = []  # outputs from drained older batches
+        if detect_idx:
+            n = len(detect_idx)
+            self._dispatch(tokens[detect_idx], [batch[i] for i in detect_idx])
+            self._count_device_lines(n)
+        while len(self._inflight) > self.config.pipeline_depth:
+            ready.extend(self._drain_one())
+        # training/filtered messages of THIS batch produced no output; the
+        # drained outputs (older batches) are already in order
+        return ready
+
+    def _dispatch(self, tokens: np.ndarray, msgs: List[Any]) -> None:
+        """Asynchronously score [n, S] tokens, padded to a compile bucket."""
+        self._ensure_scorer()
+        n = len(tokens)
+        bucket = _bucket(n, self.config.max_batch)
+        for start in range(0, n, bucket):
+            chunk = tokens[start:start + bucket]
+            real = len(chunk)
+            if real < bucket:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((bucket - real, tokens.shape[1]), np.int32)]
+                )
+            scores = self._scorer.score(self._params, self._put(chunk))
+            try:
+                scores.copy_to_host_async()
+            except AttributeError:
+                pass
+            self._inflight.append((scores, msgs[start:start + real], real))
+
+    def _drain_one(self) -> List[Optional[bytes]]:
+        scores_dev, raws, real = self._inflight.popleft()
+        scores = np.asarray(scores_dev)[:real]
+        threshold = self._threshold if self._threshold is not None else float("inf")
+        out: List[Optional[bytes]] = []
+        if not (scores > threshold).any():
+            return out
+        from ...schemas import schemas_pb2 as _pb
+
+        for raw, score in zip(raws, scores):
+            if score > threshold:
+                msg = _pb.ParserSchema()
+                msg.ParseFromString(raw)
+                out.append(self._make_alert_pb(msg, float(score)))
+        return out
+
+    def flush(self) -> List[Optional[bytes]]:
+        """Drain every in-flight batch (engine calls on idle/stop)."""
+        out: List[Optional[bytes]] = []
+        while self._inflight:
+            out.extend(self._drain_one())
+        return out
+
+    def _make_alert_pb(self, msg, score: float) -> bytes:
+        """Alert construction from a decoded pb2 message (anomalies only —
+        ~1% of traffic — so this path can afford the wrapper)."""
+        input_ = ParserSchema()
+        input_._msg.CopyFrom(msg)
+        return self._make_alert(input_, score)
+
+    def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
+        """Single-message path (parity mode / tests): batch of one."""
+        if not self._fitted:
+            self.fit()
+        score = float(self.score_tokens(self.featurize(input_)[None])[0])
+        if score > self._threshold:
+            output_["score"] = score
+            output_["alertsObtain"].update(
+                {f"{self.name} - score": f"anomaly score {score:.4f} > {self._threshold:.4f}"}
+            )
+            self._count_device_lines(1)
+            return True
+        self._count_device_lines(1)
+        return False
+
+    def _make_alert(self, input_: ParserSchema, score: float) -> bytes:
+        output_ = self.make_output(input_)
+        output_["score"] = score
+        output_["alertsObtain"].update(
+            {f"{self.name} - score": f"anomaly score {score:.4f} > {self._threshold:.4f}"}
+        )
+        return output_.serialize()
+
+    def _count_device_lines(self, n: int) -> None:
+        from ...engine import metrics as m
+
+        if self._metrics_labels is None:
+            self._metrics_labels = dict(
+                component_type=self.config.method_type,
+                component_id=self.name,
+                device=str(self._device),
+            )
+        m.DEVICE_LINES().labels(**self._metrics_labels).inc(n)
+        m.DEVICE_BATCHES().labels(**self._metrics_labels).inc()
+
+    # -- state checkpointing (orbax; closes SURVEY §5.4) -----------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "trained": self._trained,
+            "threshold": self._threshold,
+            "fitted": self._fitted,
+        }
+
+    def save_checkpoint(self, directory: str) -> None:
+        from ...utils.checkpoint import save_scorer_state
+
+        save_scorer_state(directory, self._params, self._opt_state, self.state_dict())
+
+    def load_checkpoint(self, directory: str) -> None:
+        from ...utils.checkpoint import load_scorer_state
+
+        self._ensure_scorer()
+        params, opt_state, meta = load_scorer_state(
+            directory, self._params, self._opt_state
+        )
+        self._params, self._opt_state = params, opt_state
+        self._trained = int(meta.get("trained", 0))
+        self._threshold = meta.get("threshold")
+        self._fitted = bool(meta.get("fitted", False))

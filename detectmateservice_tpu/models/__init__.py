@@ -1,0 +1,9 @@
+from .tokenizer import HashTokenizer, PAD_ID, MASK_ID, CLS_ID
+from .mlp import MLPScorer, MLPScorerConfig, EmbedMLPModel
+from .logbert import LogBERTScorer, LogBERTConfig, LogBERT
+
+__all__ = [
+    "HashTokenizer", "PAD_ID", "MASK_ID", "CLS_ID",
+    "MLPScorer", "MLPScorerConfig", "EmbedMLPModel",
+    "LogBERTScorer", "LogBERTConfig", "LogBERT",
+]

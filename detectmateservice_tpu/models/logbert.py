@@ -1,0 +1,149 @@
+"""LogBERT-style Transformer anomaly scorer (flax).
+
+The neural scorer the reference lacks (its ML is classical; SURVEY.md §2.9
+"the TPU build adds the neural scorer") and the BASELINE.json config #3
+("detector w/ LogBERT-style Transformer anomaly scorer (jit, batch=32)").
+
+Design, TPU-first:
+* fixed [B, S] int32 inputs from the hashing tokenizer — no dynamic shapes,
+* bfloat16 activations with fp32 logits/softmax accumulation (MXU-friendly),
+* masked-token training on normal traffic (optax adamw); anomaly score at
+  inference is the pseudo-negative-log-likelihood of the observed tokens, so
+  one forward pass scores a whole micro-batch,
+* attention goes through ops/attention so the blockwise/ring/pallas variants
+  can be swapped in.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.attention import dot_product_attention
+from .tokenizer import MASK_ID, PAD_ID
+
+
+@dataclasses.dataclass(frozen=True)
+class LogBERTConfig:
+    vocab_size: int = 32768
+    dim: int = 256
+    depth: int = 4
+    heads: int = 4
+    mlp_ratio: int = 4
+    seq_len: int = 32
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    mask_prob: float = 0.15
+    learning_rate: float = 1e-3
+
+
+class Block(nn.Module):
+    config: LogBERTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, pad_mask: jax.Array) -> jax.Array:
+        cfg = self.config
+        head_dim = cfg.dim // cfg.heads
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        qkv = nn.Dense(3 * cfg.dim, dtype=cfg.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s, _ = q.shape
+        reshape = lambda t: t.reshape(b, s, cfg.heads, head_dim).transpose(0, 2, 1, 3)
+        attn_mask = pad_mask[:, None, None, :]  # [B,1,1,S]: keys at PAD are masked
+        out = dot_product_attention(reshape(q), reshape(k), reshape(v), attn_mask)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
+        x = x + nn.Dense(cfg.dim, dtype=cfg.dtype, name="proj")(out)
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        y = nn.Dense(cfg.dim * cfg.mlp_ratio, dtype=cfg.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.dim, dtype=cfg.dtype, name="mlp_out")(y)
+        return x + y
+
+
+class LogBERT(nn.Module):
+    config: LogBERTConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, S, V] fp32 logits."""
+        cfg = self.config
+        pad_mask = tokens != PAD_ID
+        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (cfg.seq_len, cfg.dim)
+        )
+        x = embed(tokens) + pos[None, : tokens.shape[1]].astype(cfg.dtype)
+        for i in range(cfg.depth):
+            x = Block(cfg, name=f"block_{i}")(x, pad_mask)
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        logits = embed.attend(x.astype(jnp.float32))  # weight-tied output head
+        return logits
+
+
+def token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Per-sequence mean NLL of the observed non-PAD tokens → [B] fp32.
+
+    This is the anomaly score: a model trained on normal traffic assigns high
+    NLL (= surprise) to unseen token patterns.
+    """
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
+    mask = (tokens != PAD_ID).astype(jnp.float32)
+    return -(tok_lp * mask).sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+
+
+def masked_lm_loss(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    tok_lp = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    mask = mask.astype(jnp.float32)
+    return -(tok_lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+class LogBERTScorer:
+    """Bundles model/optimizer with jit-compiled score and train steps."""
+
+    name = "logbert"
+
+    def __init__(self, config: Optional[LogBERTConfig] = None):
+        self.config = config or LogBERTConfig()
+        self.model = LogBERT(self.config)
+        self.optimizer = optax.adamw(self.config.learning_rate)
+        self._score = jax.jit(self._score_impl)
+        self._train = jax.jit(self._train_impl)
+
+    def init(self, rng: jax.Array) -> Tuple[Any, Any]:
+        dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
+        params = self.model.init(rng, dummy)
+        return params, self.optimizer.init(params)
+
+    # -- jitted impls ---------------------------------------------------
+    def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
+        return token_nll(self.model.apply(params, tokens), tokens)
+
+    def _train_impl(self, params, opt_state, rng, tokens):
+        cfg = self.config
+
+        def loss_fn(p):
+            mask_rng, _ = jax.random.split(rng)
+            maskable = tokens != PAD_ID
+            mask = (
+                jax.random.uniform(mask_rng, tokens.shape) < cfg.mask_prob
+            ) & maskable
+            corrupted = jnp.where(mask, MASK_ID, tokens)
+            logits = self.model.apply(p, corrupted)
+            return masked_lm_loss(logits, tokens, mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # -- public API -----------------------------------------------------
+    def score(self, params, tokens) -> jax.Array:
+        return self._score(params, tokens)
+
+    def train_step(self, params, opt_state, rng, tokens):
+        return self._train(params, opt_state, rng, tokens)

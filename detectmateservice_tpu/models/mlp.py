@@ -1,0 +1,95 @@
+"""Embedding + MLP bag-of-tokens NLL scorer — the lightweight TPU scorer.
+
+First-rung model of the scorer ladder (SURVEY.md §7 step 5: "First scorer:
+embedding+MLP; then the LogBERT-style Transformer"). A CBOW-style log-linear
+language model: masked mean-pool of token embeddings → small MLP → weight-tied
+logits over the vocab; the anomaly score is the mean NLL of the sequence's
+observed tokens. Tokens never seen in training keep unaligned random
+embeddings and draw low probability, so novelty shows up directly as surprise
+— the same signal LogBERT's pseudo-NLL gives, at a fraction of the FLOPs
+(one [B,D]×[D,V] matmul per batch, MXU-friendly).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from .tokenizer import PAD_ID
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPScorerConfig:
+    vocab_size: int = 32768
+    dim: int = 128
+    hidden: int = 256
+    seq_len: int = 32
+    dtype: Any = jnp.bfloat16
+    learning_rate: float = 3e-3
+
+
+class EmbedMLPModel(nn.Module):
+    config: MLPScorerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, V] fp32 logits (context token distribution)."""
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")
+        emb = embed(tokens)
+        mask = (tokens != PAD_ID).astype(cfg.dtype)[..., None]
+        pooled = (emb * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+        h = nn.Dense(cfg.hidden, dtype=cfg.dtype)(pooled)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype)(h)
+        return embed.attend(h.astype(jnp.float32))  # weight-tied output head
+
+
+def bag_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean NLL of each sequence's non-PAD tokens under its single context
+    distribution → [B] fp32."""
+    logprobs = jax.nn.log_softmax(logits, axis=-1)           # [B, V]
+    tok_lp = jnp.take_along_axis(logprobs, tokens, axis=-1)  # [B, S]
+    mask = (tokens != PAD_ID).astype(jnp.float32)
+    return -(tok_lp * mask).sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+
+
+class MLPScorer:
+    """Same score/train surface as LogBERTScorer — the detector is agnostic."""
+
+    name = "mlp"
+
+    def __init__(self, config: Optional[MLPScorerConfig] = None):
+        self.config = config or MLPScorerConfig()
+        self.model = EmbedMLPModel(self.config)
+        self.optimizer = optax.adamw(self.config.learning_rate)
+        self._score = jax.jit(self._score_impl)
+        self._train = jax.jit(self._train_impl)
+
+    def init(self, rng: jax.Array) -> Tuple[Any, Any]:
+        dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
+        params = self.model.init(rng, dummy)
+        return params, self.optimizer.init(params)
+
+    def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
+        return bag_nll(self.model.apply(params, tokens), tokens)
+
+    def _train_impl(self, params, opt_state, rng, tokens):
+        del rng  # no stochastic corruption in the bag model
+
+        def loss_fn(p):
+            return bag_nll(self.model.apply(p, tokens), tokens).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def score(self, params, tokens) -> jax.Array:
+        return self._score(params, tokens)
+
+    def train_step(self, params, opt_state, rng, tokens):
+        return self._train(params, opt_state, rng, tokens)

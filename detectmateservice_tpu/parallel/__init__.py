@@ -1,0 +1,19 @@
+from .mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    LOGBERT_RULES,
+    REPLICATED_RULES,
+    batch_sharding,
+    make_mesh,
+    tree_shardings,
+)
+from .ring import ring_attention
+from .sharded import ShardedScorer
+
+__all__ = [
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ",
+    "LOGBERT_RULES", "REPLICATED_RULES",
+    "batch_sharding", "make_mesh", "tree_shardings",
+    "ring_attention", "ShardedScorer",
+]

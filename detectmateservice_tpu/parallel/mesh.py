@@ -1,0 +1,107 @@
+"""Device-mesh construction and parameter-sharding rules.
+
+The reference's only distribution mechanism is process-level scaling over
+sockets (SURVEY.md §2.10); the TPU build's chip plane is a
+``jax.sharding.Mesh`` with XLA collectives over ICI. Axes:
+
+* ``data``  — batch (replica) parallelism for the scorer hot path,
+* ``model`` — tensor parallelism for scorers that outgrow one chip,
+* ``seq``   — sequence/context parallelism (ring attention, parallel/ring.py).
+
+Everything goes through ``NamedSharding``/``PartitionSpec`` + ``jit`` so XLA
+inserts the collectives (psum/all-gather/reduce-scatter) — never hand-rolled
+point-to-point like the reference's NNG plane.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+
+def make_mesh(shape: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a mesh; default = all devices on the ``data`` axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not shape:
+        shape = {AXIS_DATA: len(devices)}
+    names = tuple(shape.keys())
+    dims = tuple(shape.values())
+    total = int(np.prod(dims))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {total} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices).reshape(dims), names)
+
+
+# -- parameter partition rules ---------------------------------------------
+# (path regex, PartitionSpec); first match wins. Megatron-style TP for the
+# transformer: qkv/mlp_in shard the output feature dim, proj/mlp_out shard the
+# input feature dim so XLA inserts one psum per block.
+LOGBERT_RULES: List[Tuple[str, P]] = [
+    (r"tok_embed/embedding$", P(None, AXIS_MODEL)),
+    (r"pos_embed$", P()),
+    (r"(qkv|mlp_in)/kernel$", P(None, AXIS_MODEL)),
+    (r"(qkv|mlp_in)/bias$", P(AXIS_MODEL)),
+    (r"(proj|mlp_out)/kernel$", P(AXIS_MODEL, None)),
+    (r"(proj|mlp_out)/bias$", P()),
+    (r".*", P()),
+]
+
+REPLICATED_RULES: List[Tuple[str, P]] = [(r".*", P())]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def partition_spec_for(path: str, rules: Sequence[Tuple[str, P]]) -> P:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return P()
+
+
+def tree_shardings(mesh: Mesh, tree: Any,
+                   rules: Sequence[Tuple[str, P]]) -> Any:
+    """Map a param pytree to NamedShardings via the rule table. Axes that do
+    not divide the param dim fall back to replication (safe default)."""
+
+    def _one(path, leaf):
+        spec = partition_spec_for(_path_str(path), rules)
+        # validate divisibility; replicate on mismatch rather than crash
+        if hasattr(leaf, "shape"):
+            for dim, axis in zip(leaf.shape, tuple(spec) + (None,) * 8):
+                if axis is None:
+                    continue
+                if dim % mesh.shape[axis] != 0:
+                    spec = P()
+                    break
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(_one, tree)
+
+
+def batch_sharding(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:
+    """Leading-dim batch sharding for activations/inputs."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

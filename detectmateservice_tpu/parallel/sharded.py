@@ -1,0 +1,90 @@
+"""ShardedScorer: DP×TP execution of a scorer over a device mesh.
+
+Multi-chip scale-out for the detector hot path (SURVEY.md §7 step 6,
+BASELINE.json config #5 "8× detector replicas across v5e-8"). Instead of the
+reference's N independent processes, one process drives all chips: the batch
+is sharded over the ``data`` axis, params are sharded over ``model`` per the
+Megatron-style rules (parallel/mesh.py), and ``jit`` + GSPMD insert the ICI
+collectives. Training steps psum gradients across ``data`` automatically
+(they fall out of jit's partitioning — no hand-written NCCL/MPI analog).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .mesh import (
+    AXIS_DATA,
+    LOGBERT_RULES,
+    REPLICATED_RULES,
+    batch_sharding,
+    make_mesh,
+    tree_shardings,
+)
+
+
+class ShardedScorer:
+    """Wraps a scorer (LogBERTScorer / MLPScorer surface) with mesh placement.
+
+    ``score(tokens)`` and ``train_step(rng, tokens)`` own the params/opt-state
+    internally (sharded once at construction) so callers just stream batches.
+    """
+
+    def __init__(
+        self,
+        scorer,
+        mesh=None,
+        rules: Optional[Sequence] = None,
+        rng: Optional[jax.Array] = None,
+    ):
+        self.scorer = scorer
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if rules is None:
+            rules = LOGBERT_RULES if getattr(scorer, "name", "") == "logbert" else REPLICATED_RULES
+        params, opt_state = scorer.init(rng if rng is not None else jax.random.PRNGKey(0))
+        self._param_sharding = tree_shardings(self.mesh, params, rules)
+        self._opt_sharding = tree_shardings(self.mesh, opt_state, rules)
+        self.params = jax.device_put(params, self._param_sharding)
+        self.opt_state = jax.device_put(opt_state, self._opt_sharding)
+        self._batch_sharding = batch_sharding(self.mesh, AXIS_DATA)
+
+        self._score = jax.jit(
+            scorer._score_impl,
+            in_shardings=(self._param_sharding, self._batch_sharding),
+        )
+        self._train = jax.jit(
+            scorer._train_impl,
+            in_shardings=(self._param_sharding, self._opt_sharding, None,
+                          self._batch_sharding),
+            out_shardings=(self._param_sharding, self._opt_sharding, None),
+            donate_argnums=(0, 1),
+        )
+
+    @property
+    def data_parallelism(self) -> int:
+        return int(self.mesh.shape.get(AXIS_DATA, 1))
+
+    def _pad_batch(self, tokens: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Pad the batch to a multiple of the data-axis size."""
+        n = len(tokens)
+        dp = self.data_parallelism
+        padded = ((n + dp - 1) // dp) * dp
+        if padded != n:
+            pad = np.zeros((padded - n,) + tokens.shape[1:], tokens.dtype)
+            tokens = np.concatenate([tokens, pad])
+        return tokens, n
+
+    def score(self, tokens: np.ndarray) -> np.ndarray:
+        tokens, n = self._pad_batch(np.asarray(tokens))
+        tokens = jax.device_put(tokens, self._batch_sharding)
+        return np.asarray(self._score(self.params, tokens))[:n]
+
+    def train_step(self, rng: jax.Array, tokens: np.ndarray) -> float:
+        tokens, _ = self._pad_batch(np.asarray(tokens))
+        tokens = jax.device_put(tokens, self._batch_sharding)
+        self.params, self.opt_state, loss = self._train(
+            self.params, self.opt_state, rng, tokens
+        )
+        return float(loss)

@@ -1,0 +1,34 @@
+"""Orbax-backed checkpoint/restore for scorer params + detector state.
+
+Closes the reference's checkpoint gap (SURVEY.md §5.4: detector state is
+in-memory only there; "add real model-state checkpoint (orbax-style)").
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Tuple
+
+import orbax.checkpoint as ocp
+
+_META = "meta.json"
+
+
+def save_scorer_state(directory: str, params: Any, opt_state: Any,
+                      meta: Dict[str, Any]) -> None:
+    path = Path(directory).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path / "params", params, force=True)
+        ckptr.save(path / "opt_state", opt_state, force=True)
+    (path / _META).write_text(json.dumps(meta))
+
+
+def load_scorer_state(directory: str, params_template: Any,
+                      opt_state_template: Any) -> Tuple[Any, Any, Dict[str, Any]]:
+    path = Path(directory).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(path / "params", params_template)
+        opt_state = ckptr.restore(path / "opt_state", opt_state_template)
+    meta = json.loads((path / _META).read_text())
+    return params, opt_state, meta

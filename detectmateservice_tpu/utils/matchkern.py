@@ -1,0 +1,138 @@
+"""ctypes bindings for the native hot-path kernels (native/matchkern/dmkern.c).
+
+Role of the reference's ``detectmateperformance`` pybind11 package
+(reference: uv.lock:278,301-310); this image has no pybind11, so the binding
+layer is ctypes over a plain C shared library. Auto-builds from source on
+first import when the library is missing and a C compiler is present;
+importers fall back to the pure-Python paths on any failure.
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+_PKG_DIR = Path(__file__).resolve().parent.parent
+_LIB_PATH = _PKG_DIR / "_native" / "libdmkern.so"
+_SRC_PATH = _PKG_DIR.parent / "native" / "matchkern" / "dmkern.c"
+
+
+def _load() -> ctypes.CDLL:
+    if not _LIB_PATH.exists():
+        if not _SRC_PATH.exists():
+            raise ImportError(f"native kernel source not found at {_SRC_PATH}")
+        _LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
+        cmd = ["cc", "-O3", "-shared", "-fPIC", "-o", str(_LIB_PATH),
+               str(_SRC_PATH), "-lz"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError) as exc:
+            raise ImportError(f"cannot build native kernel: {exc}")
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.dm_featurize_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int, ctypes.c_int32,
+    ]
+    lib.dm_encode_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int32,
+    ]
+    lib.dm_match_templates.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+    ]
+    lib.dm_match_templates.restype = ctypes.c_int
+    return lib
+
+
+_lib = _load()
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _pack(chunks: Sequence[bytes]) -> Tuple[bytes, np.ndarray]:
+    offsets = np.zeros(len(chunks) + 1, dtype=np.int64)
+    np.cumsum([len(c) for c in chunks], out=offsets[1:])
+    return b"".join(chunks), offsets
+
+
+def featurize_batch(msgs: Sequence[bytes], seq_len: int,
+                    vocab_size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Serialized ParserSchema bytes → ([N, seq_len] int32 tokens, [N] ok)."""
+    blob, offsets = _pack(msgs)
+    out = np.zeros((len(msgs), seq_len), dtype=np.int32)
+    ok = np.zeros(len(msgs), dtype=np.uint8)
+    _lib.dm_featurize_batch(
+        blob, offsets.ctypes.data_as(_I64P), len(msgs),
+        out.ctypes.data_as(_I32P), ok.ctypes.data_as(_U8P),
+        seq_len, vocab_size,
+    )
+    return out, ok.astype(bool)
+
+
+def encode_batch(texts: Sequence[str], seq_len: int, vocab_size: int) -> np.ndarray:
+    """Raw text lines → [N, seq_len] int32 token rows."""
+    blob, offsets = _pack([t.encode("utf-8") for t in texts])
+    out = np.zeros((len(texts), seq_len), dtype=np.int32)
+    _lib.dm_encode_batch(
+        blob, offsets.ctypes.data_as(_I64P), len(texts),
+        out.ctypes.data_as(_I32P), seq_len, vocab_size,
+    )
+    return out
+
+
+class TemplateMatcher:
+    """Native first-match template scan; Python regex extracts the wildcard
+    captures only for the one template the scan selected."""
+
+    def __init__(self, templates: List[str]):
+        import re
+
+        self._templates = templates
+        segments: List[bytes] = []
+        counts = np.zeros(len(templates), dtype=np.int32)
+        starts = np.zeros(len(templates), dtype=np.uint8)
+        ends = np.zeros(len(templates), dtype=np.uint8)
+        self._extract_res = []
+        for i, template in enumerate(templates):
+            parts = template.split("<*>")
+            segments.extend(p.encode("utf-8") for p in parts)
+            counts[i] = len(parts)
+            starts[i] = 1 if template.startswith("<*>") else 0
+            ends[i] = 1 if template.endswith("<*>") else 0
+            escaped = [re.escape(p) for p in parts]
+            if len(escaped) > 1:
+                pattern = ("^" + "(.*?)".join(escaped[:-1]) + "(.*)" + escaped[-1] + "$")
+            else:
+                pattern = "^" + escaped[0] + "$"
+            self._extract_res.append(re.compile(pattern))
+        self._seg_blob, self._seg_offsets = _pack(segments)
+        self._counts = counts
+        self._starts = starts
+        self._ends = ends
+
+    def match(self, line: str) -> Tuple[int, List[str]]:
+        """Return (0-based template index, wildcard captures) or (-1, [])."""
+        raw = line.encode("utf-8")
+        idx = _lib.dm_match_templates(
+            raw, len(raw),
+            self._seg_blob, self._seg_offsets.ctypes.data_as(_I64P),
+            self._counts.ctypes.data_as(_I32P),
+            self._starts.ctypes.data_as(_U8P),
+            self._ends.ctypes.data_as(_U8P),
+            len(self._templates),
+        )
+        if idx < 0:
+            return -1, []
+        found = self._extract_res[idx].match(line)
+        if found is None:  # byte-level scan matched but char-level regex differs
+            return -1, []
+        return idx, [g for g in found.groups() if g is not None]

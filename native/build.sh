@@ -1,0 +1,14 @@
+#!/bin/sh
+# Build the native kernels into the Python package.
+set -e
+cd "$(dirname "$0")"
+mkdir -p ../detectmateservice_tpu/_native
+CC="${CC:-cc}"
+$CC -O3 -shared -fPIC -o ../detectmateservice_tpu/_native/libdmkern.so matchkern/dmkern.c -lz
+echo "built detectmateservice_tpu/_native/libdmkern.so"
+if [ -f transport/dmtransport.cpp ]; then
+    CXX="${CXX:-c++}"
+    $CXX -O2 -std=c++17 -shared -fPIC -o ../detectmateservice_tpu/_native/libdmtransport.so \
+        transport/dmtransport.cpp -lzmq -lpthread
+    echo "built detectmateservice_tpu/_native/libdmtransport.so"
+fi

@@ -1,0 +1,251 @@
+/* dmkern: native hot-path kernels for detectmateservice_tpu.
+ *
+ * Role of the reference's pybind11 C++ package `detectmateperformance`
+ * (reference: uv.lock:278,301-310 — accelerated kernels for the library's
+ * parsing/template-matching hot path). Exposed to Python via ctypes
+ * (detectmateservice_tpu/utils/matchkern.py); no pybind11 in this image.
+ *
+ * Kernels:
+ *   dm_featurize_batch — serialized ParserSchema bytes -> token-id rows.
+ *     Parses the protobuf wire format directly (fields: template=5,
+ *     variables=6, logFormatVariables=10 map<str,str>), tokenizes on
+ *     non-alphanumeric boundaries, lowercases, and hashes tokens with
+ *     crc32 into the hashing-tokenizer id space (PAD=0, MASK=1, CLS=2,
+ *     ids >= 3). Token stream matches models/tokenizer.py exactly:
+ *     template tokens, variable tokens, then "key=value" pairs of the
+ *     header map sorted by key.
+ *   dm_encode_batch — raw text lines -> token-id rows (same tokenizer).
+ *   dm_match_templates — normalized line vs <*> wildcard templates
+ *     (first match wins, literal segments matched in order, anchored
+ *     prefix/suffix) -> template index.
+ */
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <zlib.h>
+
+#define RESERVED 3
+#define CLS_ID 2
+
+/* ---------------- tokenizer ---------------- */
+
+static inline int is_alnum(unsigned char c) {
+    return (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z');
+}
+
+/* Tokenize one byte span into out[]; returns new fill position. Lowercases
+ * ASCII and feeds crc32 incrementally, so tokens of any length hash
+ * identically to the Python path (zlib.crc32 of the whole lowercased token). */
+static int tokenize_span(const uint8_t *s, int len, int32_t *out, int pos,
+                         int seq_len, uint32_t vocab) {
+    uint32_t h = 0;
+    int in_token = 0;
+    for (int i = 0; i <= len; i++) {
+        unsigned char c = (i < len) ? s[i] : 0;
+        if (i < len && is_alnum(c)) {
+            if (c >= 'A' && c <= 'Z') c += 32;
+            h = (uint32_t)crc32(h, &c, 1);
+            in_token = 1;
+        } else if (in_token) {
+            if (pos < seq_len) out[pos++] = RESERVED + (int32_t)(h % (vocab - RESERVED));
+            h = 0;
+            in_token = 0;
+            if (pos >= seq_len) return pos;
+        }
+    }
+    return pos;
+}
+
+/* ---------------- protobuf wire parsing ---------------- */
+
+typedef struct { const uint8_t *p, *end; } cursor_t;
+
+static int read_varint(cursor_t *c, uint64_t *out) {
+    uint64_t v = 0; int shift = 0;
+    while (c->p < c->end && shift < 64) {
+        uint8_t b = *c->p++;
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) { *out = v; return 1; }
+        shift += 7;
+    }
+    return 0;
+}
+
+static int skip_field(cursor_t *c, uint32_t wire_type) {
+    uint64_t tmp;
+    switch (wire_type) {
+        case 0: return read_varint(c, &tmp);
+        case 1: if (c->end - c->p < 8) return 0; c->p += 8; return 1;
+        case 2:
+            if (!read_varint(c, &tmp) || (uint64_t)(c->end - c->p) < tmp) return 0;
+            c->p += tmp; return 1;
+        case 5: if (c->end - c->p < 4) return 0; c->p += 4; return 1;
+        default: return 0;
+    }
+}
+
+typedef struct { const uint8_t *key; int key_len; const uint8_t *val; int val_len; } map_entry_t;
+
+static int parse_map_entry(const uint8_t *p, int len, map_entry_t *e) {
+    cursor_t c = { p, p + len };
+    e->key = NULL; e->key_len = 0; e->val = NULL; e->val_len = 0;
+    while (c.p < c.end) {
+        uint64_t tag;
+        if (!read_varint(&c, &tag)) return 0;
+        uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+        if (wt == 2 && (field == 1 || field == 2)) {
+            uint64_t l;
+            if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) return 0;
+            if (field == 1) { e->key = c.p; e->key_len = (int)l; }
+            else            { e->val = c.p; e->val_len = (int)l; }
+            c.p += l;
+        } else if (!skip_field(&c, wt)) {
+            return 0;
+        }
+    }
+    return 1;
+}
+
+static int cmp_map_entry(const void *a, const void *b) {
+    const map_entry_t *x = (const map_entry_t *)a, *y = (const map_entry_t *)b;
+    int n = x->key_len < y->key_len ? x->key_len : y->key_len;
+    int r = memcmp(x->key, y->key, (size_t)n);
+    return r ? r : x->key_len - y->key_len;
+}
+
+#define MAX_MAP_ENTRIES 64
+
+/* Featurize one serialized ParserSchema into a zeroed row. Returns 1 on
+ * success, 0 on a wire-format error (row left as-is). */
+static int featurize_one(const uint8_t *msg, int len, int32_t *row,
+                         int seq_len, uint32_t vocab) {
+    cursor_t c = { msg, msg + len };
+    int pos = 0;
+    row[pos++] = CLS_ID;
+    map_entry_t entries[MAX_MAP_ENTRIES];
+    int n_entries = 0;
+    const uint8_t *template_p = NULL; uint64_t template_len = 0;
+    /* first pass: locate template (5), stream variables (6) after template,
+     * collect map entries (10). Field order on the wire follows field
+     * numbers for our own serializer, so template precedes variables. */
+    while (c.p < c.end) {
+        uint64_t tag;
+        if (!read_varint(&c, &tag)) return 0;
+        uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+        if (wt == 2) {
+            uint64_t l;
+            if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) return 0;
+            if (field == 5) { template_p = c.p; template_len = l; }
+            c.p += l;
+        } else if (!skip_field(&c, wt)) {
+            return 0;
+        }
+    }
+    if (template_p && pos < seq_len)
+        pos = tokenize_span(template_p, (int)template_len, row, pos, seq_len, vocab);
+    /* second pass: variables in order */
+    c.p = msg; c.end = msg + len;
+    while (c.p < c.end && pos < seq_len) {
+        uint64_t tag;
+        if (!read_varint(&c, &tag)) return 0;
+        uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+        if (wt == 2) {
+            uint64_t l;
+            if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) return 0;
+            if (field == 6)
+                pos = tokenize_span(c.p, (int)l, row, pos, seq_len, vocab);
+            else if (field == 10 && n_entries < MAX_MAP_ENTRIES) {
+                if (parse_map_entry(c.p, (int)l, &entries[n_entries]) &&
+                    entries[n_entries].key)
+                    n_entries++;
+            }
+            c.p += l;
+        } else if (!skip_field(&c, wt)) {
+            return 0;
+        }
+    }
+    if (n_entries > 0 && pos < seq_len) {
+        qsort(entries, (size_t)n_entries, sizeof(map_entry_t), cmp_map_entry);
+        for (int i = 0; i < n_entries && pos < seq_len; i++) {
+            pos = tokenize_span(entries[i].key, entries[i].key_len, row, pos, seq_len, vocab);
+            if (pos < seq_len)
+                pos = tokenize_span(entries[i].val, entries[i].val_len, row, pos, seq_len, vocab);
+        }
+    }
+    return 1;
+}
+
+/* msgs: concatenated message bytes; offsets: n+1 prefix offsets into msgs.
+ * out: zeroed [n, seq_len] int32. ok: [n] bytes, 1 = parsed. */
+int dm_featurize_batch(const uint8_t *msgs, const int64_t *offsets, int n,
+                       int32_t *out, uint8_t *ok, int seq_len, int32_t vocab) {
+    for (int i = 0; i < n; i++) {
+        const uint8_t *p = msgs + offsets[i];
+        int len = (int)(offsets[i + 1] - offsets[i]);
+        ok[i] = (uint8_t)featurize_one(p, len, out + (int64_t)i * seq_len,
+                                       seq_len, (uint32_t)vocab);
+    }
+    return 0;
+}
+
+/* Raw text lines -> token rows (same tokenizer). */
+int dm_encode_batch(const uint8_t *texts, const int64_t *offsets, int n,
+                    int32_t *out, int seq_len, int32_t vocab) {
+    for (int i = 0; i < n; i++) {
+        int32_t *row = out + (int64_t)i * seq_len;
+        row[0] = CLS_ID;
+        tokenize_span(texts + offsets[i], (int)(offsets[i + 1] - offsets[i]),
+                      row, 1, seq_len, (uint32_t)vocab);
+    }
+    return 0;
+}
+
+/* ---------------- template matching ---------------- */
+
+/* Templates are passed pre-normalized and pre-split: seg_data holds all
+ * literal segments concatenated; seg_offsets/seg_counts describe, per
+ * template, its literal segments (split on "<*>"). Matching: anchored first
+ * segment (unless template starts with <*>), anchored last segment (unless
+ * it ends with <*>), in-order containment for the middle ones — the
+ * wildcard-matching semantics of the Python fallback regex
+ * (library/parsers/template_matcher.py compile_template). Returns the
+ * 0-based index of the first matching template, or -1. */
+int dm_match_templates(const uint8_t *line, int line_len,
+                       const uint8_t *seg_data, const int64_t *seg_offsets,
+                       const int32_t *seg_counts, const uint8_t *starts_wild,
+                       const uint8_t *ends_wild, int n_templates) {
+    int64_t seg_idx = 0;
+    for (int t = 0; t < n_templates; t++) {
+        int n_segs = seg_counts[t];
+        const uint8_t *pos = line;
+        const uint8_t *end = line + line_len;
+        int okflag = 1;
+        for (int s = 0; s < n_segs && okflag; s++) {
+            const uint8_t *seg = seg_data + seg_offsets[seg_idx + s];
+            int seg_len = (int)(seg_offsets[seg_idx + s + 1] - seg_offsets[seg_idx + s]);
+            if (seg_len == 0) continue;
+            if (s == 0 && !starts_wild[t]) {
+                if (end - pos < seg_len || memcmp(pos, seg, (size_t)seg_len) != 0)
+                    okflag = 0;
+                else
+                    pos += seg_len;
+            } else if (s == n_segs - 1 && !ends_wild[t]) {
+                if (pos > end - seg_len ||
+                    memcmp(end - seg_len, seg, (size_t)seg_len) != 0)
+                    okflag = 0;
+                else
+                    pos = end;
+            } else {
+                /* in-order containment (memmem) */
+                const uint8_t *found = NULL;
+                for (const uint8_t *q = pos; q + seg_len <= end; q++) {
+                    if (memcmp(q, seg, (size_t)seg_len) == 0) { found = q; break; }
+                }
+                if (!found) okflag = 0; else pos = found + seg_len;
+            }
+        }
+        if (okflag) return t;
+        seg_idx += n_segs; /* offsets are one global prefix array */
+    }
+    return -1;
+}

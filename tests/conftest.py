@@ -6,10 +6,18 @@ the env vars must be set before jax is first imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# This image's sitecustomize registers the axon TPU backend and force-sets
+# jax_platforms to "axon,cpu" for every interpreter, overriding the env var;
+# flip it back before any backend initializes so tests run on the virtual
+# 8-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import socket
 import threading
@@ -59,7 +67,8 @@ def run_service():
         thread = threading.Thread(target=service.run, daemon=True)
         thread.start()
         started.append((service, thread))
-        assert wait_until(lambda: service.web_server.port not in (None,), 5.0)
+        # with http_port=0 the real port is only known once the server binds
+        assert wait_until(lambda: service.web_server.port, 5.0)
         return service
 
     yield _run

@@ -1,0 +1,125 @@
+"""Scorer model tests: tokenizer, MLP autoencoder, LogBERT."""
+import jax
+import numpy as np
+import pytest
+
+from detectmateservice_tpu.models import (
+    CLS_ID,
+    PAD_ID,
+    HashTokenizer,
+    LogBERTConfig,
+    LogBERTScorer,
+    MLPScorer,
+    MLPScorerConfig,
+)
+from detectmateservice_tpu.models.logbert import token_nll
+
+
+class TestHashTokenizer:
+    def test_deterministic(self):
+        tok = HashTokenizer(vocab_size=1024, seq_len=8)
+        a = tok.encode("user bob logged in")
+        b = tok.encode("user bob logged in")
+        assert (a == b).all()
+
+    def test_cls_and_padding(self):
+        tok = HashTokenizer(vocab_size=1024, seq_len=8)
+        row = tok.encode("one two")
+        assert row[0] == CLS_ID
+        assert row[3] == PAD_ID and row[7] == PAD_ID
+        assert row.shape == (8,) and row.dtype == np.int32
+
+    def test_truncation(self):
+        tok = HashTokenizer(vocab_size=1024, seq_len=4)
+        row = tok.encode(" ".join(f"t{i}" for i in range(20)))
+        assert (row != PAD_ID).all()
+
+    def test_encode_into_matches_encode(self):
+        tok = HashTokenizer(vocab_size=4096, seq_len=16)
+        text = "Some Mixed-Case LINE with 123 numbers!"
+        row = np.zeros(16, np.int32)
+        tok.encode_into(text, row)
+        assert (row == tok.encode(text)).all()
+
+    def test_batch(self):
+        tok = HashTokenizer(vocab_size=1024, seq_len=8)
+        batch = tok.encode_batch(["a b", "c d e"])
+        assert batch.shape == (2, 8)
+        assert (batch[0] == tok.encode("a b")).all()
+
+    def test_different_values_differ(self):
+        tok = HashTokenizer(vocab_size=65536, seq_len=8)
+        assert not (tok.encode("user alice") == tok.encode("user mallory")).all()
+
+
+@pytest.fixture(scope="module")
+def mlp():
+    scorer = MLPScorer(MLPScorerConfig(vocab_size=512, dim=32, seq_len=8))
+    params, opt = scorer.init(jax.random.PRNGKey(0))
+    return scorer, params, opt
+
+
+@pytest.fixture(scope="module")
+def logbert():
+    scorer = LogBERTScorer(LogBERTConfig(vocab_size=512, dim=32, depth=2, heads=2, seq_len=8))
+    params, opt = scorer.init(jax.random.PRNGKey(0))
+    return scorer, params, opt
+
+
+class TestScorers:
+    @pytest.mark.parametrize("fixture", ["mlp", "logbert"])
+    def test_score_shape_and_dtype(self, fixture, request):
+        scorer, params, _ = request.getfixturevalue(fixture)
+        tokens = np.random.randint(3, 512, (5, 8)).astype(np.int32)
+        scores = np.asarray(scorer.score(params, tokens))
+        assert scores.shape == (5,)
+        assert np.isfinite(scores).all()
+
+    @pytest.mark.parametrize("fixture", ["mlp", "logbert"])
+    def test_train_step_reduces_loss(self, fixture, request):
+        scorer, params, opt = request.getfixturevalue(fixture)
+        tokens = np.random.randint(3, 512, (16, 8)).astype(np.int32)
+        rng = jax.random.PRNGKey(1)
+        first = None
+        for i in range(30):
+            rng, r = jax.random.split(rng)
+            params, opt, loss = scorer.train_step(params, opt, r, tokens)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first
+
+    def test_logbert_separates_normal_from_anomalous(self):
+        scorer = LogBERTScorer(LogBERTConfig(vocab_size=2048, dim=48, depth=2,
+                                             heads=2, seq_len=12))
+        params, opt = scorer.init(jax.random.PRNGKey(0))
+        tok = HashTokenizer(vocab_size=2048, seq_len=12)
+        normal = tok.encode_batch(
+            [f"user u{i % 6} login ok from host{i % 4}" for i in range(128)]
+        )
+        weird = tok.encode_batch(["kernel panic stack smash exploit shell"] * 8)
+        rng = jax.random.PRNGKey(1)
+        for _ in range(6):
+            for s in range(0, 128, 32):
+                rng, r = jax.random.split(rng)
+                params, opt, _ = scorer.train_step(params, opt, r, normal[s:s + 32])
+        sn = np.asarray(scorer.score(params, normal[:32]))
+        sw = np.asarray(scorer.score(params, weird))
+        assert sw.mean() > sn.mean() + 3 * sn.std()
+
+    def test_token_nll_prefers_certain_model(self):
+        tokens = np.array([[2, 5, 7, 0]], np.int32)
+        sure = np.full((1, 4, 10), -10.0, np.float32)
+        for pos, t in enumerate([2, 5, 7, 0]):
+            sure[0, pos, t] = 10.0
+        unsure = np.zeros((1, 4, 10), np.float32)
+        nll_sure = float(token_nll(jax.numpy.asarray(sure), jax.numpy.asarray(tokens))[0])
+        nll_unsure = float(token_nll(jax.numpy.asarray(unsure), jax.numpy.asarray(tokens))[0])
+        assert nll_sure < nll_unsure
+
+    def test_pad_tokens_do_not_affect_score(self, logbert):
+        scorer, params, _ = logbert
+        a = np.array([[2, 5, 7, 9, 0, 0, 0, 0]], np.int32)
+        scores_a = float(np.asarray(scorer.score(params, a))[0])
+        # identical content, same padding → identical score (sanity)
+        scores_b = float(np.asarray(scorer.score(params, a.copy()))[0])
+        assert scores_a == pytest.approx(scores_b)

@@ -1,0 +1,82 @@
+"""Native kernel parity tests: the C featurizer/tokenizer/template-matcher
+must agree exactly with the pure-Python implementations."""
+import numpy as np
+import pytest
+
+matchkern = pytest.importorskip("detectmateservice_tpu.utils.matchkern")
+
+from detectmateservice_tpu.models.tokenizer import HashTokenizer
+from detectmateservice_tpu.schemas import ParserSchema
+
+
+class TestFeaturizeParity:
+    def test_matches_python_path(self):
+        tok = HashTokenizer(vocab_size=32768, seq_len=32)
+        msgs, py_rows = [], []
+        for i in range(64):
+            template = f"event <*> type {i % 5} from <*>"
+            variables = [f"val{i}", f"host-{i % 9}"]
+            hv = {"Time": str(1700000000 + i), "level": "WARN", "b": "x", "a": f"y{i}"}
+            msgs.append(ParserSchema(EventID=i, template=template,
+                                     variables=variables,
+                                     logFormatVariables=hv).serialize())
+            parts = [template] + variables + [f"{k}={v}" for k, v in sorted(hv.items())]
+            py_rows.append(tok.encode(" ".join(parts)))
+        c_rows, ok = matchkern.featurize_batch(msgs, 32, 32768)
+        assert ok.all()
+        assert (c_rows == np.stack(py_rows)).all()
+
+    def test_garbage_flagged_not_ok(self):
+        _, ok = matchkern.featurize_batch([b"\xff\xff\xff\xff"], 16, 1024)
+        assert not ok[0]
+
+    def test_empty_message_ok(self):
+        rows, ok = matchkern.featurize_batch([ParserSchema().serialize()], 16, 1024)
+        assert ok[0]
+        assert rows[0][0] == 2  # CLS only
+
+
+class TestEncodeParity:
+    @pytest.mark.parametrize("text", [
+        "simple line", "", "MIXED Case 123", "punct!@#$%^&*()sep",
+        "unicode café line", "a" * 500,
+    ])
+    def test_matches_python(self, text):
+        c = matchkern.encode_batch([text], 16, 4096)
+        p = HashTokenizer(4096, 16).encode_batch([text])
+        assert (c == p).all()
+
+
+class TestTemplateMatcherParity:
+    def test_against_python_regexes(self):
+        from detectmateservice_tpu.library.parsers.template_matcher import compile_template
+
+        templates = [
+            "user <*> logged in from <*>",
+            "query failed: <*>",
+            "<*> startup complete",
+            "exact literal line",
+            "a<*>b<*>c",
+        ]
+        tm = matchkern.TemplateMatcher(templates)
+        regexes = [compile_template(t) for t in templates]
+        lines = [
+            "user bob logged in from 1.2.3.4",
+            "query failed: timeout after 3s",
+            "service x startup complete",
+            "exact literal line",
+            "aXbYc", "abc", "aXbc", "abXc",
+            "no template matches this",
+            "user  logged in from ",
+        ]
+        for line in lines:
+            py_idx = -1
+            for i, rx in enumerate(regexes):
+                if rx.match(line):
+                    py_idx = i
+                    break
+            c_idx, c_vars = tm.match(line)
+            assert c_idx == py_idx, f"{line!r}: C={c_idx} PY={py_idx}"
+            if py_idx >= 0:
+                py_vars = [g for g in regexes[py_idx].match(line).groups() if g is not None]
+                assert c_vars == py_vars

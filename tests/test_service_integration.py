@@ -1,0 +1,203 @@
+"""Tier-3 in-process service integration (model of the reference's
+tests/test_engine_loop.py, test_service_multi_output_integration.py,
+test_smoke_service.py): full Service with web server, driven via transport
+sockets and HTTP simultaneously."""
+import json
+import urllib.request
+
+import pytest
+import yaml
+
+from detectmateservice_tpu.core import Service
+from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory, TransportTimeout
+from detectmateservice_tpu.schemas import DetectorSchema, LogSchema, ParserSchema
+from detectmateservice_tpu.settings import ServiceSettings
+
+from conftest import wait_until
+
+
+def http(method, port, path, payload=None):
+    body = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        raw = resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+        return json.loads(raw) if "json" in ctype else raw.decode()
+
+
+def make_service(run_service, factory, addr, **kw):
+    settings = ServiceSettings(
+        component_type=kw.pop("component_type", "core"),
+        engine_addr=addr, http_host="127.0.0.1", http_port=0,
+        log_to_file=False, **kw,
+    )
+    return run_service(Service(settings, socket_factory=factory))
+
+
+class TestServiceLifecycle:
+    def test_passthrough_and_admin(self, run_service, inproc_factory):
+        svc = make_service(run_service, inproc_factory, "inproc://svc1")
+        assert wait_until(lambda: svc.engine.running)
+        port = svc.web_server.port
+
+        client = inproc_factory.create_output("inproc://svc1")
+        client.recv_timeout = 2000
+        client.send(b"hello")
+        assert client.recv() == b"hello"  # core passthrough echo
+
+        status = http("GET", port, "/admin/status")
+        assert status["status"]["running"] is True
+        assert status["status"]["component_type"] == "core"
+
+    def test_stop_start_via_http(self, run_service, inproc_factory):
+        svc = make_service(run_service, inproc_factory, "inproc://svc2")
+        assert wait_until(lambda: svc.engine.running)
+        port = svc.web_server.port
+        http("POST", port, "/admin/stop")
+        assert wait_until(lambda: not svc.engine.running)
+        assert http("GET", port, "/admin/status")["status"]["running"] is False
+        http("POST", port, "/admin/start")
+        assert wait_until(lambda: svc.engine.running)
+        # engine processes again after the restart (sockets reopened)
+        client = inproc_factory.create_output("inproc://svc2")
+        client.recv_timeout = 2000
+        client.send(b"again")
+        assert client.recv() == b"again"
+
+    def test_metrics_endpoint(self, run_service, inproc_factory):
+        svc = make_service(run_service, inproc_factory, "inproc://svc3")
+        assert wait_until(lambda: svc.engine.running)
+        client = inproc_factory.create_output("inproc://svc3")
+        client.recv_timeout = 2000
+        client.send(b"x")
+        client.recv()
+        text = http("GET", svc.web_server.port, "/metrics")
+        assert "data_read_bytes_total" in text
+        assert "processing_duration_seconds" in text
+        assert "engine_running" in text
+
+    def test_no_autostart_waits_for_admin(self, run_service, inproc_factory):
+        svc = make_service(run_service, inproc_factory, "inproc://svc4",
+                           engine_autostart=False)
+        port = svc.web_server.port
+        assert not svc.engine.running
+        http("POST", port, "/admin/start")
+        assert wait_until(lambda: svc.engine.running)
+
+    def test_unknown_route_404(self, run_service, inproc_factory):
+        svc = make_service(run_service, inproc_factory, "inproc://svc5")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            http("GET", svc.web_server.port, "/nope")
+        assert err.value.code == 404
+
+
+class TestReconfigure:
+    def test_in_memory_and_persist(self, run_service, inproc_factory, tmp_path):
+        config_file = tmp_path / "config.yaml"
+        config_file.write_text(yaml.safe_dump(
+            {"detectors": {"X": {"method_type": "x", "knob": 1}}}))
+        svc = make_service(run_service, inproc_factory, "inproc://svc6",
+                           config_file=str(config_file))
+        port = svc.web_server.port
+        assert wait_until(lambda: svc.engine.running)
+
+        new_config = {"detectors": {"X": {"method_type": "x", "knob": 2}}}
+        resp = http("POST", port, "/admin/reconfigure",
+                    {"config": new_config, "persist": False})
+        assert resp["config"]["detectors"]["X"]["knob"] == 2
+        # in-memory only: file unchanged
+        assert yaml.safe_load(config_file.read_text())["detectors"]["X"]["knob"] == 1
+
+        http("POST", port, "/admin/reconfigure", {"config": new_config, "persist": True})
+        assert yaml.safe_load(config_file.read_text())["detectors"]["X"]["knob"] == 2
+
+    def test_empty_payload_noop(self, run_service, inproc_factory, tmp_path):
+        config_file = tmp_path / "c.yaml"
+        config_file.write_text(yaml.safe_dump({"detectors": {"X": {"a": 1}}}))
+        svc = make_service(run_service, inproc_factory, "inproc://svc7",
+                           config_file=str(config_file))
+        resp = http("POST", svc.web_server.port, "/admin/reconfigure", {"config": {}})
+        assert resp["config"]["detectors"]["X"]["a"] == 1
+
+    def test_no_config_manager_errors(self, run_service, inproc_factory):
+        svc = make_service(run_service, inproc_factory, "inproc://svc8")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            http("POST", svc.web_server.port, "/admin/reconfigure",
+                 {"config": {"detectors": {}}})
+        assert err.value.code == 500
+
+
+class TestRealComponentPipeline:
+    """In-process parser → detector chain over the inproc transport."""
+
+    def test_parser_to_detector_flow(self, run_service, inproc_factory, tmp_path):
+        parser_config = tmp_path / "p.yaml"
+        parser_config.write_text(yaml.safe_dump({"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": "<Level> <Component> <Content>", "time_format": None,
+            "params": {"remove_spaces": False, "remove_punctuation": False,
+                       "lowercase": False, "path_templates": None},
+        }}}))
+        detector_config = tmp_path / "d.yaml"
+        detector_config.write_text(yaml.safe_dump({"detectors": {"NewValueDetector": {
+            "method_type": "new_value_detector", "data_use_training": 2,
+            "auto_config": False,
+            "global": {"gi": {"header_variables": [{"pos": "Component"}]}},
+        }}}))
+
+        make_service(run_service, inproc_factory, "inproc://pipe-parser",
+                     component_type="parsers.template_matcher.MatcherParser",
+                     config_file=str(parser_config),
+                     out_addr=["inproc://pipe-detector"])
+        make_service(run_service, inproc_factory, "inproc://pipe-detector",
+                     component_type="detectors.new_value_detector.NewValueDetector",
+                     config_file=str(detector_config),
+                     out_addr=["inproc://pipe-out"])
+        sink = inproc_factory.create("inproc://pipe-out")
+        sink.recv_timeout = 3000
+        ingress = inproc_factory.create_output("inproc://pipe-parser")
+
+        for i, component in enumerate(["sshd", "cron", "sshd"]):
+            ingress.send(LogSchema(logID=str(i),
+                                   log=f"INFO {component} routine message").serialize())
+        # training (2) + known value: no output — timeout is the contract
+        with pytest.raises(TransportTimeout):
+            sink.recv()
+        ingress.send(LogSchema(logID="9", log="INFO rootkit suspicious thing").serialize())
+        alert = DetectorSchema.from_bytes(sink.recv())
+        assert dict(alert.alertsObtain) == {"Global - Component": "Unknown value: 'rootkit'"}
+        assert list(alert.logIDs) == ["9"]
+
+    def test_jax_scorer_service_micro_batched(self, run_service, inproc_factory, tmp_path):
+        config = tmp_path / "j.yaml"
+        config.write_text(yaml.safe_dump({"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+            "data_use_training": 32, "train_epochs": 2, "min_train_steps": 60,
+            "seq_len": 16, "dim": 32, "max_batch": 32,
+            "pipeline_depth": 1, "threshold_sigma": 4.0,
+        }}}))
+        make_service(run_service, inproc_factory, "inproc://jax-det",
+                     component_type="detectors.jax_scorer.JaxScorerDetector",
+                     config_file=str(config),
+                     out_addr=["inproc://jax-out"],
+                     engine_batch_size=16, engine_batch_timeout_ms=30.0)
+        sink = inproc_factory.create("inproc://jax-out")
+        sink.recv_timeout = 15000
+        ingress = inproc_factory.create_output("inproc://jax-det")
+
+        def parser_msg(template, variables, log_id):
+            return ParserSchema(EventID=1, template=template, variables=variables,
+                                logID=log_id, logFormatVariables={}).serialize()
+
+        for i in range(32):  # training
+            ingress.send(parser_msg("user <*> ok from <*>",
+                                    [f"u{i % 4}", f"10.0.0.{i % 8}"], str(i)))
+        for _ in range(8):   # anomalies through the micro-batched engine
+            ingress.send(parser_msg("segfault <*> exploit <*>",
+                                    ["0xdead", "shellcode"], "evil"))
+        alert = DetectorSchema.from_bytes(sink.recv())
+        assert alert.detectorType == "jax_scorer"
+        assert list(alert.logIDs) == ["evil"]
