@@ -98,12 +98,10 @@ class JaxScorerDetector(CoreDetector):
         self._ensure_scorer()
         import jax
 
-        warm = np.zeros((1, self.config.seq_len), np.int32)
         for b in (1, 8, self.config.train_batch_size, self.config.max_batch):
             bucket = _bucket(b, self.config.max_batch)
             tokens = np.zeros((bucket, self.config.seq_len), np.int32)
             jax.block_until_ready(self._scorer.score(self._params, self._put(tokens)))
-        del warm
 
     def _ensure_scorer(self) -> None:
         if self._scorer is not None:
@@ -153,6 +151,12 @@ class JaxScorerDetector(CoreDetector):
         )
 
     # -- training -------------------------------------------------------
+    def train(self, input_: ParserSchema) -> None:
+        """Single-message training path (engine_batch_size=1 parity mode):
+        buffer the tokenized row so the phase-boundary ``fit`` has data —
+        ``process_batch`` buffers directly and never calls this."""
+        self._train_buffer.append(self.featurize(input_))
+
     def fit(self) -> Dict[str, float]:
         """Train on the buffered normal traffic, calibrate the threshold."""
         self._ensure_scorer()
@@ -228,24 +232,35 @@ class JaxScorerDetector(CoreDetector):
         try:
             from ...utils import matchkern
 
-            return matchkern.featurize_batch(
+            tokens, ok = matchkern.featurize_batch(
                 batch, self.config.seq_len, self.config.vocab_size
             )
+            if not ok.all():
+                # the native kernel refuses rows it cannot featurize with
+                # exact parity (e.g. >64 header-map entries); retry those in
+                # Python so only genuinely corrupt messages stay failed
+                self._featurize_python_rows(batch, tokens, ok, np.flatnonzero(~ok))
+            return tokens, ok
         except ImportError:
             pass
-        from ...schemas import schemas_pb2 as _pb
-
         tokens = np.zeros((len(batch), self.config.seq_len), np.int32)
         ok = np.zeros(len(batch), dtype=bool)
-        for i, raw in enumerate(batch):
+        self._featurize_python_rows(batch, tokens, ok, range(len(batch)))
+        return tokens, ok
+
+    def _featurize_python_rows(self, batch: List[bytes], tokens: np.ndarray,
+                               ok: np.ndarray, indices) -> None:
+        from ...schemas import schemas_pb2 as _pb
+
+        for i in indices:
             msg = _pb.ParserSchema()
             try:
-                msg.ParseFromString(raw)
+                msg.ParseFromString(batch[i])
             except Exception:
                 continue
+            tokens[i] = 0  # the native pass may have partially filled the row
             self._featurize_pb_into(msg, tokens[i])
             ok[i] = True
-        return tokens, ok
 
     def process_batch(self, batch: List[bytes]) -> List[Optional[bytes]]:
         """Batched hot path: one featurize kernel + one jit call per
@@ -385,5 +400,17 @@ class JaxScorerDetector(CoreDetector):
         )
         self._params, self._opt_state = params, opt_state
         self._trained = int(meta.get("trained", 0))
-        self._threshold = meta.get("threshold")
         self._fitted = bool(meta.get("fitted", False))
+        if self.config.score_threshold is not None:
+            # explicit config override outranks the checkpointed calibration
+            self._threshold = self.config.score_threshold
+        else:
+            thr = meta.get("threshold")
+            if thr is not None:
+                self._threshold = float(thr)
+            elif self._fitted:
+                self._threshold = float("inf")
+            else:
+                # unfitted checkpoint: drop any stale in-memory calibration so
+                # the next fit() recalibrates for the restored run
+                self._threshold = None

@@ -95,4 +95,6 @@ def blockwise_attention(
         return blockwise_attention_step(q, k_b, v_b, acc, row_max, row_sum, m_b), None
 
     (acc, _, row_sum), _ = jax.lax.scan(step, init, (k_blocks, v_blocks, mask_blocks))
-    return (acc / row_sum[..., None]).astype(q.dtype)
+    # defensive guard matching ring.py; row_sum stays ≥ 1 even for fully
+    # masked rows (masked logits are finfo.min, not -inf, so probs = 1)
+    return (acc / jnp.maximum(row_sum[..., None], 1e-30)).astype(q.dtype)

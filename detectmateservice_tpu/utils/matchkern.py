@@ -20,17 +20,50 @@ _LIB_PATH = _PKG_DIR / "_native" / "libdmkern.so"
 _SRC_PATH = _PKG_DIR.parent / "native" / "matchkern" / "dmkern.c"
 
 
-def _load() -> ctypes.CDLL:
+def _stale() -> bool:
+    """True when the library is missing or older than its source.
+
+    The mtime comparison is a dev convenience (rebuild after editing the C
+    source); on a fresh checkout it may fire spuriously, so a failed rebuild
+    falls back to the committed library rather than raising.
+    """
     if not _LIB_PATH.exists():
-        if not _SRC_PATH.exists():
+        return True
+    return (_SRC_PATH.exists()
+            and _SRC_PATH.stat().st_mtime > _LIB_PATH.stat().st_mtime)
+
+
+def _rebuild() -> None:
+    """Compile to a temp file and atomically replace, so concurrent importers
+    never dlopen a half-written library."""
+    import os
+    import tempfile
+
+    _LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_LIB_PATH.parent))
+    os.close(fd)
+    try:
+        subprocess.run(["cc", "-O3", "-shared", "-fPIC", "-o", tmp,
+                        str(_SRC_PATH), "-lz"],
+                       check=True, capture_output=True, timeout=120)
+        os.chmod(tmp, 0o755)  # mkstemp creates 0600; other users must dlopen
+        os.replace(tmp, str(_LIB_PATH))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load() -> ctypes.CDLL:
+    if _stale():
+        if not _SRC_PATH.exists() and not _LIB_PATH.exists():
             raise ImportError(f"native kernel source not found at {_SRC_PATH}")
-        _LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
-        cmd = ["cc", "-O3", "-shared", "-fPIC", "-o", str(_LIB_PATH),
-               str(_SRC_PATH), "-lz"]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        except (subprocess.SubprocessError, OSError) as exc:
-            raise ImportError(f"cannot build native kernel: {exc}")
+        if _SRC_PATH.exists():
+            try:
+                _rebuild()
+            except (subprocess.SubprocessError, OSError) as exc:
+                if not _LIB_PATH.exists():
+                    raise ImportError(f"cannot build native kernel: {exc}")
+                # no compiler / read-only tree: use the committed library
     lib = ctypes.CDLL(str(_LIB_PATH))
     lib.dm_featurize_batch.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,

@@ -154,7 +154,11 @@ static int featurize_one(const uint8_t *msg, int len, int32_t *row,
             if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) return 0;
             if (field == 6)
                 pos = tokenize_span(c.p, (int)l, row, pos, seq_len, vocab);
-            else if (field == 10 && n_entries < MAX_MAP_ENTRIES) {
+            else if (field == 10) {
+                /* more map entries than we can sort: report failure so the
+                 * caller re-featurizes this row in Python (exact parity
+                 * beats a silently different token stream) */
+                if (n_entries >= MAX_MAP_ENTRIES) return 0;
                 if (parse_map_entry(c.p, (int)l, &entries[n_entries]) &&
                     entries[n_entries].key)
                     n_entries++;

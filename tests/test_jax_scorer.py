@@ -110,3 +110,43 @@ class TestCheckpoint:
         # restored detector skips training and scores immediately
         out = fresh.process_batch(normal_msgs(8)) + fresh.flush()
         assert isinstance(out, list)
+
+
+class TestSingleMessageTraining:
+    def test_per_message_training_populates_buffer_and_alerts(self):
+        # engine_batch_size=1 parity mode: every message goes through
+        # CoreDetector.process → train() → fit at the boundary; the detector
+        # must still learn and alert (regression: train() was a no-op, so the
+        # threshold calibrated to inf and nothing ever alerted)
+        det = JaxScorerDetector(config=scorer_config(data_use_training=16))
+        for raw in normal_msgs(16):
+            assert det.process(raw) is None
+        assert len(det._train_buffer) == 16 or det._fitted
+        weird = msg("segfault <*> exploit <*>", ["0xdead", "shellcode"], log_id="7")
+        out = det.process(weird)
+        assert det._fitted
+        assert np.isfinite(det._threshold)
+        assert out is not None, "single-message path never alerts"
+        assert list(DetectorSchema.from_bytes(out).logIDs) == ["7"]
+
+
+class TestCheckpointThreshold:
+    def test_config_override_survives_restore(self, trained_detector, tmp_path):
+        trained_detector.save_checkpoint(str(tmp_path / "ckpt"))
+        fresh = JaxScorerDetector(config=scorer_config(score_threshold=123.0))
+        fresh.load_checkpoint(str(tmp_path / "ckpt"))
+        assert fresh._threshold == 123.0  # explicit override wins over checkpoint
+
+    def test_missing_threshold_key_defaults_finite_semantics(self, trained_detector, tmp_path):
+        import json
+        trained_detector.save_checkpoint(str(tmp_path / "ckpt"))
+        meta_path = tmp_path / "ckpt" / "meta.json"
+        meta = json.loads(meta_path.read_text())
+        meta.pop("threshold", None)
+        meta_path.write_text(json.dumps(meta))
+        fresh = JaxScorerDetector(config=scorer_config())
+        fresh.load_checkpoint(str(tmp_path / "ckpt"))
+        # no calibration available → comparable (inf) threshold, not None
+        assert fresh._threshold == float("inf")
+        out = fresh.process_batch(normal_msgs(4)) + fresh.flush()
+        assert all(o is None for o in out) or not out

@@ -80,3 +80,53 @@ class TestTemplateMatcherParity:
             if py_idx >= 0:
                 py_vars = [g for g in regexes[py_idx].match(line).groups() if g is not None]
                 assert c_vars == py_vars
+
+
+class TestMapOverflowParity:
+    def test_native_rows_match_python_below_limit(self):
+        # ≤64 entries: the native kernel handles the row itself — compare its
+        # output against the pure-Python featurization to pin real parity
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+        from detectmateservice_tpu.schemas import ParserSchema
+        from detectmateservice_tpu.utils import matchkern
+        import numpy as np
+
+        lfv = {f"key{i:03d}": f"value{i}" for i in range(60)}
+        raw = ParserSchema(EventID=1, template="t <*>", variables=["x"],
+                           logFormatVariables=lfv).serialize()
+        tokens_native, ok = matchkern.featurize_batch([raw], 512, 32768)
+        assert ok.all()
+
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False,
+            "seq_len": 512, "data_use_training": 0}}})
+        tokens_py = np.zeros_like(tokens_native)
+        ok_py = np.zeros(1, dtype=bool)
+        det._featurize_python_rows([raw], tokens_py, ok_py, [0])
+        assert ok_py.all()
+        np.testing.assert_array_equal(tokens_native, tokens_py)
+
+    def test_many_header_variables_match_python_path(self):
+        # >64 logFormatVariables entries: the native kernel refuses the row
+        # (bounded sort buffer) and the detector retries it in Python —
+        # the resulting token row must equal the all-Python featurization
+        # (regression: entries past 64 were silently dropped)
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+        from detectmateservice_tpu.schemas import ParserSchema
+
+        lfv = {f"key{i:03d}": f"value{i}" for i in range(100)}
+        raw = ParserSchema(EventID=1, template="t <*>", variables=["x"],
+                           logFormatVariables=lfv).serialize()
+
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False,
+            "seq_len": 512, "data_use_training": 0}}})
+        tokens_native, ok = det._featurize_raw_batch([raw])
+        assert ok.all()
+
+        import numpy as np
+        tokens_py = np.zeros_like(tokens_native)
+        ok_py = np.zeros(1, dtype=bool)
+        det._featurize_python_rows([raw], tokens_py, ok_py, [0])
+        assert ok_py.all()
+        np.testing.assert_array_equal(tokens_native, tokens_py)
