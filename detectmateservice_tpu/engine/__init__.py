@@ -8,6 +8,7 @@ from .socket import (
     TransportTimeout,
     ZmqPairSocketFactory,
     InprocQueueSocketFactory,
+    make_socket_factory,
 )
 
 __all__ = [
@@ -23,4 +24,5 @@ __all__ = [
     "TransportTimeout",
     "ZmqPairSocketFactory",
     "InprocQueueSocketFactory",
+    "make_socket_factory",
 ]

@@ -41,7 +41,7 @@ from .socket import (
     TransportAgain,
     TransportError,
     TransportTimeout,
-    ZmqPairSocketFactory,
+    make_socket_factory,
 )
 
 
@@ -87,7 +87,9 @@ class Engine:
         self.settings = settings
         self.processor = processor
         self.logger = logger or logging.getLogger("engine")
-        self._factory = socket_factory or ZmqPairSocketFactory()
+        self._factory = socket_factory or make_socket_factory(
+            getattr(settings, "transport_backend", "auto"), self.logger
+        )
         self._running = False
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -242,23 +244,43 @@ class Engine:
             # micro-batch mode: drain what arrived within the window
             batch = [raw]
             deadline = time.monotonic() + batch_timeout_s
-            saved_timeout = self._pair_sock.recv_timeout
-            while len(batch) < batch_size:
-                remaining_ms = (deadline - time.monotonic()) * 1000.0
-                if remaining_ms <= 0:
-                    break
-                self._pair_sock.recv_timeout = max(1, int(remaining_ms))
-                try:
-                    nxt = self._pair_sock.recv()
-                except TransportTimeout:
-                    break
-                except TransportError:
-                    break
-                if nxt:
-                    read_b.inc(len(nxt))
-                    read_l.inc(max(1, nxt.count(b"\n") + (0 if nxt.endswith(b"\n") else 1)))
-                    batch.append(nxt)
-            self._pair_sock.recv_timeout = saved_timeout
+            recv_many = getattr(self._pair_sock, "recv_many", None)
+            if callable(recv_many):
+                # native transport: drain the whole window in single native
+                # calls — one GIL crossing per burst instead of per message
+                while len(batch) < batch_size:
+                    remaining_ms = (deadline - time.monotonic()) * 1000.0
+                    if remaining_ms <= 0:
+                        break
+                    try:
+                        frames = recv_many(batch_size - len(batch),
+                                           max(1, int(remaining_ms)))
+                    except (TransportTimeout, TransportError):
+                        break
+                    for nxt in frames:
+                        if nxt:
+                            read_b.inc(len(nxt))
+                            read_l.inc(max(1, nxt.count(b"\n")
+                                           + (0 if nxt.endswith(b"\n") else 1)))
+                            batch.append(nxt)
+            else:
+                saved_timeout = self._pair_sock.recv_timeout
+                while len(batch) < batch_size:
+                    remaining_ms = (deadline - time.monotonic()) * 1000.0
+                    if remaining_ms <= 0:
+                        break
+                    self._pair_sock.recv_timeout = max(1, int(remaining_ms))
+                    try:
+                        nxt = self._pair_sock.recv()
+                    except TransportTimeout:
+                        break
+                    except TransportError:
+                        break
+                    if nxt:
+                        read_b.inc(len(nxt))
+                        read_l.inc(max(1, nxt.count(b"\n") + (0 if nxt.endswith(b"\n") else 1)))
+                        batch.append(nxt)
+                self._pair_sock.recv_timeout = saved_timeout
             try:
                 outs = batch_fn(batch)
             except Exception as exc:

@@ -590,6 +590,27 @@ class InprocQueueSocket:
         self._closed = True
 
 
+def make_socket_factory(backend: str = "auto",
+                        logger: Optional[logging.Logger] = None) -> EngineSocketFactory:
+    """Resolve a transport backend name to a factory.
+
+    ``native`` = the in-tree C++ transport (raises if it cannot be built),
+    ``zmq`` = the Python backend, ``auto`` = native when available else zmq.
+    Native and zmq frames are wire-compatible, so a pipeline can mix them.
+    """
+    if backend in ("auto", "native"):
+        try:
+            from .native_transport import NativePairSocketFactory
+
+            return NativePairSocketFactory()
+        except ImportError as exc:
+            if backend == "native":
+                raise TransportError(f"native transport unavailable: {exc}")
+            if logger:
+                logger.debug("native transport unavailable (%s); using zmq", exc)
+    return ZmqPairSocketFactory()
+
+
 class InprocQueueSocketFactory:
     """Queue-based factory for tests and single-process demos."""
 

@@ -129,6 +129,10 @@ class ServiceSettings(BaseModel):
     # contract; > 1 enables micro-batched dispatch to the accelerator.
     engine_batch_size: int = Field(default=1, ge=1, le=4096)
     engine_batch_timeout_ms: float = Field(default=2.0, ge=0.0)
+    # transport_backend selects the data-plane implementation: "native" is
+    # the in-tree C++ transport (native/transport), "zmq" the Python pyzmq
+    # backend; both are wire-compatible. "auto" prefers native when built.
+    transport_backend: str = Field(default="auto", pattern="^(auto|zmq|native)$")
     backend: str = Field(default="auto", pattern="^(auto|cpu|tpu)$")
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 8}
     checkpoint_dir: Optional[str] = None

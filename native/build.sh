@@ -8,7 +8,9 @@ $CC -O3 -shared -fPIC -o ../detectmateservice_tpu/_native/libdmkern.so matchkern
 echo "built detectmateservice_tpu/_native/libdmkern.so"
 if [ -f transport/dmtransport.cpp ]; then
     CXX="${CXX:-c++}"
+    # link the soname directly: this image ships libzmq.so.5 without the
+    # -lzmq dev symlink or header (the ABI is declared in the .cpp)
     $CXX -O2 -std=c++17 -shared -fPIC -o ../detectmateservice_tpu/_native/libdmtransport.so \
-        transport/dmtransport.cpp -lzmq -lpthread
+        transport/dmtransport.cpp -l:libzmq.so.5 -lpthread
     echo "built detectmateservice_tpu/_native/libdmtransport.so"
 fi

@@ -1,0 +1,219 @@
+"""Native C++ transport tests: pair semantics, batched drain, wire interop
+with the Python zmq backend, error taxonomy, and engine integration.
+
+The native transport plays the role of the reference's NNG C data plane
+(reference: src/service/features/engine_socket.py:35-78 via pynng; SURVEY.md
+§2.8); these tests mirror the reference's engine/socket-factory tiers
+(tests/test_engine_multi_output.py, test_engine_socket_factory_error_handling.py)
+against the C++ implementation.
+"""
+import time
+
+import pytest
+
+from detectmateservice_tpu.engine.socket import (
+    TransportClosed,
+    TransportError,
+    TransportTimeout,
+    ZmqPairSocketFactory,
+    make_socket_factory,
+)
+
+native = pytest.importorskip(
+    "detectmateservice_tpu.engine.native_transport",
+    reason="native transport not built and no compiler available",
+)
+NativePairSocketFactory = native.NativePairSocketFactory
+
+
+def _wait_recv(sock, timeout_ms=2000):
+    sock.recv_timeout = timeout_ms
+    return sock.recv()
+
+
+class TestNativePair:
+    def test_ipc_roundtrip(self, tmp_path):
+        f = NativePairSocketFactory()
+        server = f.create(f"ipc://{tmp_path}/n.ipc")
+        client = f.create_output(f"ipc://{tmp_path}/n.ipc")
+        time.sleep(0.2)  # background connect
+        client.send(b"ping")
+        assert _wait_recv(server) == b"ping"
+        server.send(b"pong")
+        assert _wait_recv(client) == b"pong"
+        client.close()
+        server.close()
+
+    def test_tcp_roundtrip(self, free_port):
+        f = NativePairSocketFactory()
+        server = f.create(f"tcp://127.0.0.1:{free_port}")
+        client = f.create_output(f"tcp://127.0.0.1:{free_port}")
+        time.sleep(0.2)
+        client.send(b"over tcp")
+        assert _wait_recv(server) == b"over tcp"
+        client.close()
+        server.close()
+
+    def test_recv_timeout(self, tmp_path):
+        f = NativePairSocketFactory()
+        sock = f.create(f"ipc://{tmp_path}/t.ipc")
+        sock.recv_timeout = 50
+        with pytest.raises(TransportTimeout):
+            sock.recv()
+        sock.close()
+
+    def test_large_frame(self, tmp_path):
+        # the reference exercises a 1 MiB message
+        # (tests/test_engine_multi_output.py:430-448)
+        f = NativePairSocketFactory()
+        server = f.create(f"ipc://{tmp_path}/big.ipc")
+        client = f.create_output(f"ipc://{tmp_path}/big.ipc")
+        time.sleep(0.2)
+        payload = bytes(range(256)) * 4096  # 1 MiB
+        client.send(payload)
+        assert _wait_recv(server) == payload
+        client.close()
+        server.close()
+
+    def test_stale_ipc_file_unlinked(self, tmp_path):
+        path = tmp_path / "stale.ipc"
+        path.write_text("stale")
+        f = NativePairSocketFactory()
+        sock = f.create(f"ipc://{path}")
+        sock.close()
+        assert not path.exists()  # unlinked on close too
+
+    def test_bad_scheme_rejected(self):
+        with pytest.raises(TransportError):
+            NativePairSocketFactory().create("bogus://x")
+
+    def test_tcp_requires_port(self):
+        with pytest.raises(TransportError):
+            NativePairSocketFactory().create("tcp://127.0.0.1")
+
+    def test_port_in_use(self, free_port):
+        f = NativePairSocketFactory()
+        first = f.create(f"tcp://127.0.0.1:{free_port}")
+        with pytest.raises(TransportError):
+            f.create(f"tcp://127.0.0.1:{free_port}")
+        first.close()
+
+    def test_closed_socket_raises(self, tmp_path):
+        f = NativePairSocketFactory()
+        sock = f.create(f"ipc://{tmp_path}/c.ipc")
+        sock.close()
+        with pytest.raises(TransportClosed):
+            sock.recv()
+        with pytest.raises(TransportClosed):
+            sock.send(b"x")
+        sock.close()  # idempotent
+
+
+class TestRecvMany:
+    def test_drains_queued_frames(self, tmp_path):
+        f = NativePairSocketFactory()
+        server = f.create(f"ipc://{tmp_path}/m.ipc")
+        client = f.create_output(f"ipc://{tmp_path}/m.ipc")
+        time.sleep(0.2)
+        for i in range(7):
+            client.send(b"msg-%d" % i)
+        time.sleep(0.2)
+        frames = server.recv_many(100, 1000)
+        assert frames == [b"msg-%d" % i for i in range(7)]
+        client.close()
+        server.close()
+
+    def test_respects_max_n(self, tmp_path):
+        f = NativePairSocketFactory()
+        server = f.create(f"ipc://{tmp_path}/mn.ipc")
+        client = f.create_output(f"ipc://{tmp_path}/mn.ipc")
+        time.sleep(0.2)
+        for i in range(10):
+            client.send(b"%d" % i)
+        time.sleep(0.2)
+        first = server.recv_many(4, 1000)
+        rest = server.recv_many(100, 1000)
+        assert len(first) == 4
+        assert first + rest == [b"%d" % i for i in range(10)]
+        client.close()
+        server.close()
+
+    def test_timeout_when_empty(self, tmp_path):
+        f = NativePairSocketFactory()
+        sock = f.create(f"ipc://{tmp_path}/e.ipc")
+        with pytest.raises(TransportTimeout):
+            sock.recv_many(10, 50)
+        sock.close()
+
+
+class TestWireInterop:
+    """Native and Python zmq backends speak the same frames, both directions."""
+
+    def test_native_listener_python_dialer(self, tmp_path):
+        addr = f"ipc://{tmp_path}/x1.ipc"
+        server = NativePairSocketFactory().create(addr)
+        client = ZmqPairSocketFactory().create_output(addr)
+        time.sleep(0.2)
+        client.send(b"py->native")
+        assert _wait_recv(server) == b"py->native"
+        server.send(b"native->py")
+        assert _wait_recv(client) == b"native->py"
+        client.close()
+        server.close()
+
+    def test_python_listener_native_dialer(self, tmp_path):
+        addr = f"ipc://{tmp_path}/x2.ipc"
+        server = ZmqPairSocketFactory().create(addr)
+        client = NativePairSocketFactory().create_output(addr)
+        time.sleep(0.2)
+        client.send(b"native->py")
+        assert _wait_recv(server) == b"native->py"
+        server.send(b"py->native")
+        assert _wait_recv(client) == b"py->native"
+        client.close()
+        server.close()
+
+
+class TestFactorySelection:
+    def test_auto_prefers_native(self):
+        factory = make_socket_factory("auto")
+        assert isinstance(factory, NativePairSocketFactory)
+
+    def test_zmq_explicit(self):
+        assert isinstance(make_socket_factory("zmq"), ZmqPairSocketFactory)
+
+    def test_native_explicit(self):
+        assert isinstance(make_socket_factory("native"), NativePairSocketFactory)
+
+
+class TestEngineOverNativeTransport:
+    def test_echo_loop_and_batch(self, tmp_path):
+        from detectmateservice_tpu.engine import Engine
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        class Reverser:
+            def process(self, data):
+                return data[::-1]
+
+            def process_batch(self, batch):
+                return [d[::-1] for d in batch]
+
+        addr = f"ipc://{tmp_path}/eng.ipc"
+        settings = ServiceSettings(
+            component_type="parser", engine_addr=addr, out_addr=[],
+            engine_batch_size=8, engine_batch_timeout_ms=5.0,
+            transport_backend="native",
+        )
+        engine = Engine(settings, Reverser())
+        engine.start()
+        try:
+            client = NativePairSocketFactory().create_output(addr)
+            time.sleep(0.2)
+            for i in range(20):
+                client.send(b"abc%d" % i)
+            client.recv_timeout = 2000
+            got = sorted(client.recv() for _ in range(20))
+            assert got == sorted((b"abc%d" % i)[::-1] for i in range(20))
+            client.close()
+        finally:
+            engine.stop()
