@@ -241,45 +241,33 @@ class Engine:
                 self._send_to_outputs(out)
                 continue
 
-            # micro-batch mode: drain what arrived within the window
+            # micro-batch mode: drain what arrived within the window. The
+            # native transport's recv_many takes a whole burst per GIL
+            # crossing; other sockets fall back to one recv per frame.
             batch = [raw]
             deadline = time.monotonic() + batch_timeout_s
             recv_many = getattr(self._pair_sock, "recv_many", None)
-            if callable(recv_many):
-                # native transport: drain the whole window in single native
-                # calls — one GIL crossing per burst instead of per message
-                while len(batch) < batch_size:
-                    remaining_ms = (deadline - time.monotonic()) * 1000.0
-                    if remaining_ms <= 0:
-                        break
-                    try:
+            saved_timeout = None if callable(recv_many) else self._pair_sock.recv_timeout
+            while len(batch) < batch_size:
+                remaining_ms = (deadline - time.monotonic()) * 1000.0
+                if remaining_ms <= 0:
+                    break
+                try:
+                    if callable(recv_many):
                         frames = recv_many(batch_size - len(batch),
                                            max(1, int(remaining_ms)))
-                    except (TransportTimeout, TransportError):
-                        break
-                    for nxt in frames:
-                        if nxt:
-                            read_b.inc(len(nxt))
-                            read_l.inc(max(1, nxt.count(b"\n")
-                                           + (0 if nxt.endswith(b"\n") else 1)))
-                            batch.append(nxt)
-            else:
-                saved_timeout = self._pair_sock.recv_timeout
-                while len(batch) < batch_size:
-                    remaining_ms = (deadline - time.monotonic()) * 1000.0
-                    if remaining_ms <= 0:
-                        break
-                    self._pair_sock.recv_timeout = max(1, int(remaining_ms))
-                    try:
-                        nxt = self._pair_sock.recv()
-                    except TransportTimeout:
-                        break
-                    except TransportError:
-                        break
+                    else:
+                        self._pair_sock.recv_timeout = max(1, int(remaining_ms))
+                        frames = [self._pair_sock.recv()]
+                except (TransportTimeout, TransportError):
+                    break
+                for nxt in frames:
                     if nxt:
                         read_b.inc(len(nxt))
-                        read_l.inc(max(1, nxt.count(b"\n") + (0 if nxt.endswith(b"\n") else 1)))
+                        read_l.inc(max(1, nxt.count(b"\n")
+                                       + (0 if nxt.endswith(b"\n") else 1)))
                         batch.append(nxt)
+            if saved_timeout is not None:
                 self._pair_sock.recv_timeout = saved_timeout
             try:
                 outs = batch_fn(batch)

@@ -39,7 +39,9 @@ _SRC_PATH = _PKG_DIR.parent / "native" / "transport" / "dmtransport.cpp"
 # keep in sync with dmtransport.cpp
 _OK, _ETIMEOUT, _EAGAIN, _ECLOSED, _EERR, _ETOOBIG = 0, -1, -2, -3, -4, -5
 
-_MAX_FRAME = 16 * 1024 * 1024  # recv buffer cap per frame
+_INITIAL_BUF = 16 * 1024 * 1024  # starting recv buffer; grows on demand —
+                                 # oversized frames are stashed native-side
+                                 # (dmt_pending_size) and retried, never lost
 
 
 def _stale() -> bool:
@@ -82,7 +84,13 @@ def _load() -> ctypes.CDLL:
             except (subprocess.SubprocessError, OSError) as exc:
                 if not _LIB_PATH.exists():
                     raise ImportError(f"cannot build native transport: {exc}")
-    lib = ctypes.CDLL(str(_LIB_PATH))
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as exc:
+        # e.g. no libzmq.so.5 on this host, or a wrong-arch committed .so —
+        # surface as ImportError so "auto" backend selection falls back to
+        # the pure-Python transport
+        raise ImportError(f"cannot load native transport: {exc}")
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.dmt_listen.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.dmt_listen.restype = ctypes.c_void_p
@@ -96,6 +104,8 @@ def _load() -> ctypes.CDLL:
                                   ctypes.c_int, ctypes.c_int,
                                   ctypes.POINTER(ctypes.c_longlong)]
     lib.dmt_recv_many.restype = ctypes.c_int
+    lib.dmt_pending_size.argtypes = [ctypes.c_void_p]
+    lib.dmt_pending_size.restype = ctypes.c_longlong
     lib.dmt_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
                              ctypes.c_int]
     lib.dmt_send.restype = ctypes.c_int
@@ -114,8 +124,6 @@ def _raise(code: int, what: str) -> None:
         raise TransportAgain(f"{what} would block")
     if code == _ECLOSED:
         raise TransportClosed(f"{what} on closed socket")
-    if code == _ETOOBIG:
-        raise TransportError(f"{what}: frame exceeds {_MAX_FRAME} bytes")
     raise TransportError(f"{what} failed (code {code})")
 
 
@@ -148,11 +156,17 @@ class NativePairSocket:
     def recv(self) -> bytes:
         if self._closed:
             raise TransportClosed(f"recv on closed socket {self._addr}")
-        buf = self._ensure_buf(_MAX_FRAME)
-        n = _lib.dmt_recv(self._handle, buf, len(buf))
-        if n < 0:
-            _raise(int(n), "recv")
-        return bytes(memoryview(buf)[: int(n)])
+        buf = self._ensure_buf(_INITIAL_BUF)
+        while True:
+            n = _lib.dmt_recv(self._handle, buf, len(buf))
+            if n == _ETOOBIG:
+                # frame is stashed native-side; grow and retry — no data loss
+                need = int(_lib.dmt_pending_size(self._handle))
+                buf = self._ensure_buf(max(need, len(buf) * 2))
+                continue
+            if n < 0:
+                _raise(int(n), "recv")
+            return bytes(memoryview(buf)[: int(n)])
 
     def recv_many(self, max_n: int, first_timeout_ms: int) -> List[bytes]:
         """Drain up to ``max_n`` queued frames in one native call. Blocks up
@@ -160,10 +174,18 @@ class NativePairSocket:
         TransportTimeout when nothing arrived."""
         if self._closed:
             raise TransportClosed(f"recv on closed socket {self._addr}")
-        buf = self._ensure_buf(max(_MAX_FRAME, max_n * 4096))
+        buf = self._ensure_buf(max(_INITIAL_BUF, max_n * 4096))
         used = ctypes.c_longlong(0)
-        count = _lib.dmt_recv_many(self._handle, buf, len(buf), max_n,
-                                   int(first_timeout_ms), ctypes.byref(used))
+        while True:
+            count = _lib.dmt_recv_many(self._handle, buf, len(buf), max_n,
+                                       int(first_timeout_ms), ctypes.byref(used))
+            if count == _ETOOBIG:
+                # first frame alone exceeds the buffer: it is stashed
+                # native-side; grow and retry — no data loss
+                need = int(_lib.dmt_pending_size(self._handle))
+                buf = self._ensure_buf(max(need + 4, len(buf) * 2))
+                continue
+            break
         if count < 0:
             _raise(int(count), "recv_many")
         frames: List[bytes] = []
@@ -198,20 +220,31 @@ class NativePairSocket:
 
 
 class NativePairSocketFactory:
-    """EngineSocketFactory over the C++ transport. Handles the same schemes
-    as the Python zmq backend minus tls+tcp (which stays on the Python ssl
-    transport — the factory delegates)."""
+    """EngineSocketFactory over the C++ transport. tls+tcp stays on the
+    Python ssl transport and ws on the Python zmq backend — the factory
+    delegates those schemes, so every address the zmq factory accepts works
+    here too."""
 
     SCHEMES = ("ipc", "tcp", "inproc")
+
+    def _delegate(self, scheme: str):
+        if scheme == "tls+tcp":
+            from .socket import TlsTcpSocketFactory
+
+            return TlsTcpSocketFactory()
+        if scheme == "ws":
+            from .socket import ZmqPairSocketFactory
+
+            return ZmqPairSocketFactory()
+        return None
 
     def create(self, addr: str, logger: Optional[logging.Logger] = None,
                tls_config: Optional[object] = None) -> EngineSocket:
         logger = logger or logging.getLogger(__name__)
         scheme = addr.split("://", 1)[0] if "://" in addr else ""
-        if scheme == "tls+tcp":
-            from .socket import TlsTcpSocketFactory
-
-            return TlsTcpSocketFactory().create(addr, logger, tls_config)
+        delegate = self._delegate(scheme)
+        if delegate is not None:
+            return delegate.create(addr, logger, tls_config)
         if scheme not in self.SCHEMES:
             raise TransportError(f"unsupported scheme {scheme!r} in {addr!r}")
         if scheme == "tcp":
@@ -232,10 +265,9 @@ class NativePairSocketFactory:
                       buffer_size: int = 100) -> EngineSocket:
         logger = logger or logging.getLogger(__name__)
         scheme = addr.split("://", 1)[0] if "://" in addr else ""
-        if scheme == "tls+tcp":
-            from .socket import TlsTcpSocketFactory
-
-            return TlsTcpSocketFactory().create_output(
+        delegate = self._delegate(scheme)
+        if delegate is not None:
+            return delegate.create_output(
                 addr, logger, tls_config, dial_timeout, buffer_size)
         if scheme not in self.SCHEMES:
             raise TransportError(f"unsupported scheme {scheme!r} in {addr!r}")

@@ -603,7 +603,7 @@ def make_socket_factory(backend: str = "auto",
             from .native_transport import NativePairSocketFactory
 
             return NativePairSocketFactory()
-        except ImportError as exc:
+        except (ImportError, OSError) as exc:
             if backend == "native":
                 raise TransportError(f"native transport unavailable: {exc}")
             if logger:

@@ -51,6 +51,11 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # batch's CPU featurization (jax dispatch is async)
     pipeline_depth: int = 4
     device: Optional[str] = None      # e.g. "tpu:0"; default = first device
+    # multi-chip scale-out (BASELINE config #5): a mesh shape like
+    # {"data": 8} shards batches over all chips via parallel.ShardedScorer
+    # (DP) and params per the Megatron rules when "model" > 1 (TP); XLA
+    # inserts the ICI collectives. None = single device.
+    mesh_shape: Optional[Dict[str, int]] = None
     seed: int = 0
 
 
@@ -77,6 +82,7 @@ class JaxScorerDetector(CoreDetector):
             vocab_size=self.config.vocab_size, seq_len=self.config.seq_len
         )
         self._scorer = None
+        self._sharded = None  # parallel.ShardedScorer when mesh_shape is set
         self._params = None
         self._opt_state = None
         self._rng = None
@@ -101,7 +107,7 @@ class JaxScorerDetector(CoreDetector):
         for b in (1, 8, self.config.train_batch_size, self.config.max_batch):
             bucket = _bucket(b, self.config.max_batch)
             tokens = np.zeros((bucket, self.config.seq_len), np.int32)
-            jax.block_until_ready(self._scorer.score(self._params, self._put(tokens)))
+            jax.block_until_ready(self._score_dev(tokens))
 
     def _ensure_scorer(self) -> None:
         if self._scorer is not None:
@@ -124,6 +130,17 @@ class JaxScorerDetector(CoreDetector):
             ))
         else:
             raise LibraryError(f"unknown scorer model {cfg.model!r}")
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        if cfg.mesh_shape:
+            # multi-chip: batches shard over the mesh's data axis, params per
+            # the model rules; ShardedScorer owns the (sharded) params
+            from ...parallel.mesh import make_mesh
+            from ...parallel.sharded import ShardedScorer
+
+            mesh = make_mesh(dict(cfg.mesh_shape))
+            self._sharded = ShardedScorer(self._scorer, mesh=mesh, rng=self._rng)
+            self._device = f"mesh({','.join(f'{k}={v}' for k, v in mesh.shape.items())})"
+            return
         devices = jax.devices()
         self._device = devices[0]
         if cfg.device:
@@ -131,7 +148,6 @@ class JaxScorerDetector(CoreDetector):
                 if str(d).lower().startswith(cfg.device.lower()):
                     self._device = d
                     break
-        self._rng = jax.random.PRNGKey(cfg.seed)
         params, opt_state = self._scorer.init(self._rng)
         # params pinned in device memory once (HBM residency; north-star item)
         self._params = jax.device_put(params, self._device)
@@ -141,6 +157,21 @@ class JaxScorerDetector(CoreDetector):
         import jax
 
         return jax.device_put(array, self._device)
+
+    def _score_dev(self, tokens: np.ndarray):
+        """Dispatch scoring for [n, S] tokens; returns the device array
+        without forcing readback (single device or sharded mesh)."""
+        if self._sharded is not None:
+            return self._sharded.score_device(tokens)
+        return self._scorer.score(self._params, self._put(tokens))
+
+    def _train_step(self, step_rng, batch: np.ndarray) -> float:
+        if self._sharded is not None:
+            return self._sharded.train_step(step_rng, batch)
+        self._params, self._opt_state, loss_arr = self._scorer.train_step(
+            self._params, self._opt_state, step_rng, self._put(batch)
+        )
+        return float(loss_arr)
 
     # -- featurization (CPU side) ---------------------------------------
     def featurize(self, input_: ParserSchema) -> np.ndarray:
@@ -181,13 +212,10 @@ class JaxScorerDetector(CoreDetector):
             for start in range(0, len(data) - bs + 1, bs):
                 batch = data[order[start:start + bs]]
                 self._rng, step_rng = jax.random.split(self._rng)
-                self._params, self._opt_state, loss_arr = self._scorer.train_step(
-                    self._params, self._opt_state, step_rng, self._put(batch)
-                )
-                loss = float(loss_arr)
+                loss = self._train_step(step_rng, batch)
         if self._threshold is None:
             scores = np.concatenate([
-                np.asarray(self._scorer.score(self._params, self._put(data[i:i + bs])))
+                np.asarray(self._score_dev(data[i:i + bs]))[: len(data[i:i + bs])]
                 for i in range(0, len(data), bs)
             ])[: len(data)]
             self._threshold = float(scores.mean() + cfg.threshold_sigma * scores.std())
@@ -206,7 +234,7 @@ class JaxScorerDetector(CoreDetector):
             if len(chunk) < bucket:
                 pad = np.zeros((bucket - len(chunk), tokens.shape[1]), np.int32)
                 chunk = np.concatenate([chunk, pad])
-            scores = np.asarray(self._scorer.score(self._params, self._put(chunk)))
+            scores = np.asarray(self._score_dev(chunk))
             out[start:start + min(bucket, n - start)] = scores[: min(bucket, n - start)]
         return out
 
@@ -306,7 +334,7 @@ class JaxScorerDetector(CoreDetector):
                 chunk = np.concatenate(
                     [chunk, np.zeros((bucket - real, tokens.shape[1]), np.int32)]
                 )
-            scores = self._scorer.score(self._params, self._put(chunk))
+            scores = self._score_dev(chunk)
             try:
                 scores.copy_to_host_async()
             except AttributeError:
@@ -389,16 +417,29 @@ class JaxScorerDetector(CoreDetector):
     def save_checkpoint(self, directory: str) -> None:
         from ...utils.checkpoint import save_scorer_state
 
-        save_scorer_state(directory, self._params, self._opt_state, self.state_dict())
+        if self._sharded is not None:
+            save_scorer_state(directory, self._sharded.params,
+                              self._sharded.opt_state, self.state_dict())
+        else:
+            save_scorer_state(directory, self._params, self._opt_state,
+                              self.state_dict())
 
     def load_checkpoint(self, directory: str) -> None:
         from ...utils.checkpoint import load_scorer_state
 
         self._ensure_scorer()
-        params, opt_state, meta = load_scorer_state(
-            directory, self._params, self._opt_state
-        )
-        self._params, self._opt_state = params, opt_state
+        if self._sharded is not None:
+            # restore against the sharded targets so each leaf comes back
+            # with its mesh placement intact
+            params, opt_state, meta = load_scorer_state(
+                directory, self._sharded.params, self._sharded.opt_state
+            )
+            self._sharded.params, self._sharded.opt_state = params, opt_state
+        else:
+            params, opt_state, meta = load_scorer_state(
+                directory, self._params, self._opt_state
+            )
+            self._params, self._opt_state = params, opt_state
         self._trained = int(meta.get("trained", 0))
         self._fitted = bool(meta.get("fitted", False))
         if self.config.score_threshold is not None:

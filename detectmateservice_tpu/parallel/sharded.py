@@ -81,8 +81,25 @@ class ShardedScorer:
         tokens = jax.device_put(tokens, self._batch_sharding)
         return np.asarray(self._score(self.params, tokens))[:n]
 
-    def train_step(self, rng: jax.Array, tokens: np.ndarray) -> float:
+    def score_device(self, tokens: np.ndarray) -> jax.Array:
+        """Asynchronous scoring: dispatch and return the device array without
+        forcing a host readback (rows beyond the caller's real batch are
+        padding — the caller slices). Lets the detector's pipelined hot path
+        overlap readback with the next batch's featurization."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
+        tokens = jax.device_put(tokens, self._batch_sharding)
+        return self._score(self.params, tokens)
+
+    def train_step(self, rng: jax.Array, tokens: np.ndarray) -> float:
+        # pad by wrapping real rows, NOT zeros: synthetic all-PAD rows would
+        # enter the loss mean and train the model that empty sequences are
+        # normal; duplicating real rows only slightly oversamples them
+        tokens = np.asarray(tokens)
+        n = len(tokens)
+        dp = self.data_parallelism
+        padded = ((n + dp - 1) // dp) * dp
+        if padded != n:
+            tokens = np.concatenate([tokens, tokens[: padded - n]])
         tokens = jax.device_put(tokens, self._batch_sharding)
         self.params, self.opt_state, loss = self._train(
             self.params, self.opt_state, rng, tokens

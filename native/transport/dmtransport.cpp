@@ -85,6 +85,11 @@ struct DmtSocket {
     int recv_timeout_ms = -1;      // -1 = block forever
     std::string unlink_on_close;   // stale-ipc-file handling, parity with
                                    // reference engine_socket.py:46-54
+    // a frame already taken off the zmq socket that did not fit the caller's
+    // buffer is stashed here, NEVER destroyed — the caller grows its buffer
+    // (dmt_pending_size) and the next recv consumes the stash first
+    bool has_pending = false;
+    zmq_msg_t pending;
 };
 
 // process-wide context, like the Python backend's shared zmq.Context
@@ -187,25 +192,48 @@ int dmt_set_recv_timeout(void *handle, int timeout_ms) {
 
 // --- data path -------------------------------------------------------------
 
-// Receive one frame into buf. Returns the frame length (which may exceed
-// cap: then only cap bytes are copied and the caller must treat it as an
-// error — the engine uses a generous fixed cap). Negative = error code.
+// Size of the stashed frame that last failed to fit (0 = none). The caller
+// grows its buffer to at least this and retries the recv.
+long long dmt_pending_size(void *handle) {
+    DmtSocket *s = static_cast<DmtSocket *>(handle);
+    if (s == nullptr || s->closed.load()) return 0;
+    std::lock_guard<std::mutex> lock(s->mu);
+    return s->has_pending ? (long long)zmq_msg_size(&s->pending) : 0;
+}
+
+// Take the next frame: the stashed one if present, else one off the socket.
+// Returns DMT_OK with *msg initialized, or a negative code (msg untouched).
+static int next_frame(DmtSocket *s, zmq_msg_t *msg, int flags) {
+    if (s->has_pending) {
+        *msg = s->pending;  // ownership moves to the caller
+        s->has_pending = false;
+        return DMT_OK;
+    }
+    zmq_msg_init(msg);
+    int n = zmq_msg_recv(msg, s->zsock, flags);
+    if (n < 0) {
+        zmq_msg_close(msg);
+        if (zmq_errno() == EAGAIN) return DMT_ETIMEOUT;
+        return s->closed.load() ? DMT_ECLOSED : DMT_EERR;
+    }
+    return DMT_OK;
+}
+
+// Receive one frame into buf. Returns the frame length, or a negative error
+// code. DMT_ETOOBIG stashes the frame (no data loss): query
+// dmt_pending_size, grow the buffer, call again.
 long long dmt_recv(void *handle, unsigned char *buf, long long cap) {
     DmtSocket *s = static_cast<DmtSocket *>(handle);
     if (s == nullptr || s->closed.load()) return DMT_ECLOSED;
     std::lock_guard<std::mutex> lock(s->mu);
     if (s->closed.load()) return DMT_ECLOSED;
     zmq_msg_t msg;
-    zmq_msg_init(&msg);
-    int n = zmq_msg_recv(&msg, s->zsock, 0);
-    if (n < 0) {
-        zmq_msg_close(&msg);
-        if (zmq_errno() == EAGAIN) return DMT_ETIMEOUT;
-        return s->closed.load() ? DMT_ECLOSED : DMT_EERR;
-    }
+    int rc = next_frame(s, &msg, 0);
+    if (rc != DMT_OK) return rc;
     size_t len = zmq_msg_size(&msg);
     if ((long long)len > cap) {
-        zmq_msg_close(&msg);
+        s->pending = msg;  // keep the frame for a retry with a bigger buffer
+        s->has_pending = true;
         return DMT_ETOOBIG;
     }
     std::memcpy(buf, zmq_msg_data(&msg), len);
@@ -238,29 +266,17 @@ int dmt_recv_many(void *handle, unsigned char *buf, long long cap, int max_n,
     int rc = DMT_OK;
     for (int i = 0; i < max_n; ++i) {
         zmq_msg_t msg;
-        zmq_msg_init(&msg);
-        int n = zmq_msg_recv(&msg, s->zsock, i == 0 ? 0 : ZMQ_DONTWAIT);
-        if (n < 0) {
-            zmq_msg_close(&msg);
-            if (i == 0) {
-                rc = (zmq_errno() == EAGAIN)
-                         ? DMT_ETIMEOUT
-                         : (s->closed.load() ? DMT_ECLOSED : DMT_EERR);
-            }
+        int frc = next_frame(s, &msg, i == 0 ? 0 : ZMQ_DONTWAIT);
+        if (frc != DMT_OK) {
+            if (i == 0) rc = frc;
             break;  // i > 0: queue drained, return what we have
         }
         size_t len = zmq_msg_size(&msg);
         if (off + 4 + (long long)len > cap) {
-            // no room for this frame: requeueing is impossible on a zmq
-            // socket, so copy what fits only if nothing was consumed yet
-            if (count == 0) {
-                zmq_msg_close(&msg);
-                rc = DMT_ETOOBIG;
-                break;
-            }
-            // frame loss would violate the at-most-once-per-recv contract;
-            // size the buffer as max_n * max_frame to make this unreachable
-            zmq_msg_close(&msg);
+            // no room: stash the frame for the next call — never destroy it
+            s->pending = msg;
+            s->has_pending = true;
+            if (count == 0) rc = DMT_ETOOBIG;
             break;
         }
         uint32_t len32 = (uint32_t)len;
@@ -301,6 +317,10 @@ int dmt_close(void *handle) {
     bool was = s->closed.exchange(true);
     if (!was) {
         std::lock_guard<std::mutex> lock(s->mu);
+        if (s->has_pending) {
+            zmq_msg_close(&s->pending);
+            s->has_pending = false;
+        }
         zmq_close(s->zsock);
         s->zsock = nullptr;
         if (!s->unlink_on_close.empty()) ::remove(s->unlink_on_close.c_str());

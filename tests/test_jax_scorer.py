@@ -150,3 +150,66 @@ class TestCheckpointThreshold:
         assert fresh._threshold == float("inf")
         out = fresh.process_batch(normal_msgs(4)) + fresh.flush()
         assert all(o is None for o in out) or not out
+
+
+class TestMeshSharded:
+    """mesh_shape routes the hot path through parallel.ShardedScorer: batches
+    shard over the data axis of the virtual 8-device mesh (conftest), params
+    per the model rules; XLA inserts the collectives (BASELINE config #5)."""
+
+    def _mesh_detector(self, **overrides):
+        return JaxScorerDetector(config=scorer_config(
+            mesh_shape={"data": 8}, **overrides))
+
+    def test_train_detect_over_mesh(self):
+        det = self._mesh_detector()
+        assert det.process_batch(normal_msgs(32)) == []
+        assert det._sharded is not None
+        assert det._sharded.data_parallelism == 8
+        weird = [msg("segfault <*> exploit <*>", ["0xdead", f"x{i}"], log_id=str(100 + i))
+                 for i in range(4)]
+        out = det.process_batch(normal_msgs(8) + weird) + det.flush()
+        alerts = [o for o in out if o is not None]
+        assert alerts, "mesh-sharded detector never alerted on anomalies"
+        ids = {i for a in alerts for i in DetectorSchema.from_bytes(a).logIDs}
+        assert ids <= {str(100 + i) for i in range(4)}
+
+    def test_results_match_single_device(self):
+        # same seed → identical init params; inference-only scoring must agree
+        # tightly (only XLA partitioning reduction order differs). Training
+        # accumulates in shard order, so trained thresholds agree loosely.
+        single = JaxScorerDetector(config=scorer_config())
+        sharded = self._mesh_detector()
+        probe = np.stack([single.featurize(ParserSchema.from_bytes(m))
+                          for m in normal_msgs(8, salt="p")])
+        np.testing.assert_allclose(single.score_tokens(probe),
+                                   sharded.score_tokens(probe), rtol=1e-4)
+        train = normal_msgs(32)
+        single.process_batch(train)
+        sharded.process_batch(train)
+        assert sharded._threshold == pytest.approx(single._threshold, rel=5e-2)
+
+    def test_checkpoint_roundtrip_over_mesh(self, tmp_path):
+        det = self._mesh_detector()
+        det.process_batch(normal_msgs(32))
+        det.save_checkpoint(str(tmp_path / "ckpt"))
+        fresh = self._mesh_detector()
+        fresh.load_checkpoint(str(tmp_path / "ckpt"))
+        assert fresh._fitted
+        assert fresh._threshold == pytest.approx(det._threshold)
+        probe = np.stack([det.featurize(ParserSchema.from_bytes(m))
+                          for m in normal_msgs(4, salt="c")])
+        np.testing.assert_allclose(det.score_tokens(probe),
+                                   fresh.score_tokens(probe), rtol=1e-5)
+
+    def test_logbert_tensor_parallel_mesh(self):
+        # dp×tp mesh: logbert params shard over "model" per the Megatron
+        # rules; a tiny under-trained model is noisy, so assert the pipeline
+        # contract (runs, in-order, list out) rather than alert quality
+        det = JaxScorerDetector(config=scorer_config(
+            model="logbert", mesh_shape={"data": 4, "model": 2},
+            dim=32, depth=1, seq_len=16, threshold_sigma=8.0))
+        assert det.process_batch(normal_msgs(32)) == []
+        assert det._sharded is not None
+        out = det.process_batch(normal_msgs(8)) + det.flush()
+        assert isinstance(out, list)
