@@ -38,6 +38,7 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     dim: int = 128
     depth: int = 2                    # logbert only
     heads: int = 4                    # logbert only
+    score_topk: int = 0               # logbert only: 0=mean NLL, k>0=top-k mean
     data_use_training: int = 256
     train_epochs: int = 3
     # small training buffers still get enough optimizer steps to converge
@@ -45,6 +46,11 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     train_batch_size: int = 32
     threshold_sigma: float = 4.0
     score_threshold: Optional[float] = None  # explicit override wins
+    # "none": score = sequence NLL. "position": score = max over positions of
+    # (NLL - mu_pos)/sigma_pos with mu/sigma calibrated on training traffic —
+    # noisy fields (pids, timestamps) self-suppress, low-entropy fields flag
+    # unseen values sharply (models/logbert.py positional_z_max)
+    score_norm: str = "none"
     max_batch: int = 1024
     # how many scored batches may be in flight before results are forced
     # back to the host; hides device→host readback latency behind the next
@@ -90,6 +96,8 @@ class JaxScorerDetector(CoreDetector):
         self._threshold: Optional[float] = self.config.score_threshold
         self._train_buffer: List[np.ndarray] = []
         self._fitted = False
+        self._norm_mu: Optional[np.ndarray] = None     # [S] fp32, "position" norm
+        self._norm_sigma: Optional[np.ndarray] = None  # [S] fp32
         self._metrics_labels = None
         # in-flight scored batches: (scores_device_array, parsed_msgs, n_real)
         from collections import deque
@@ -104,10 +112,22 @@ class JaxScorerDetector(CoreDetector):
         self._ensure_scorer()
         import jax
 
+        warm_norm = (self.config.score_norm == "position"
+                     and self._norm_mu is None)
         for b in (1, 8, self.config.train_batch_size, self.config.max_batch):
             bucket = _bucket(b, self.config.max_batch)
             tokens = np.zeros((bucket, self.config.seq_len), np.int32)
             jax.block_until_ready(self._score_dev(tokens))
+            if warm_norm:
+                # detection will run the _normscore kernel once calibrated;
+                # warm it per bucket with dummy stats so the train→detect
+                # boundary pays no compile stall on the hot path
+                dummy = np.ones(self.config.seq_len, np.float32)
+                self._norm_mu, self._norm_sigma = np.zeros_like(dummy), dummy
+                try:
+                    jax.block_until_ready(self._score_dev(tokens))
+                finally:
+                    self._norm_mu = self._norm_sigma = None
 
     def _ensure_scorer(self) -> None:
         if self._scorer is not None:
@@ -115,12 +135,15 @@ class JaxScorerDetector(CoreDetector):
         import jax
 
         cfg = self.config
+        if cfg.score_norm not in ("none", "position"):
+            raise LibraryError(
+                f"unknown score_norm {cfg.score_norm!r}; expected 'none' or 'position'")
         if cfg.model == "logbert":
             from ...models.logbert import LogBERTConfig, LogBERTScorer
 
             self._scorer = LogBERTScorer(LogBERTConfig(
                 vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
-                heads=cfg.heads, seq_len=cfg.seq_len,
+                heads=cfg.heads, seq_len=cfg.seq_len, score_topk=cfg.score_topk,
             ))
         elif cfg.model == "mlp":
             from ...models.mlp import MLPScorer, MLPScorerConfig
@@ -160,10 +183,47 @@ class JaxScorerDetector(CoreDetector):
 
     def _score_dev(self, tokens: np.ndarray):
         """Dispatch scoring for [n, S] tokens; returns the device array
-        without forcing readback (single device or sharded mesh)."""
+        without forcing readback (single device or sharded mesh). Applies
+        per-position normalization once calibrated (fit)."""
+        if self._norm_mu is not None:
+            if self._sharded is not None:
+                return self._sharded.normscore_device(
+                    tokens, self._norm_mu, self._norm_sigma)
+            return self._scorer._normscore(
+                self._params, self._put(tokens), self._norm_mu, self._norm_sigma)
         if self._sharded is not None:
             return self._sharded.score_device(tokens)
         return self._scorer.score(self._params, self._put(tokens))
+
+    def _token_nlls_dev(self, tokens: np.ndarray):
+        if self._sharded is not None:
+            return self._sharded.token_nlls_device(tokens)
+        return self._scorer._token_nlls(self._params, self._put(tokens))
+
+    def _calibrate_position_norm(self, data: np.ndarray, bs: int) -> np.ndarray:
+        """Masked per-position mean/std of training NLLs → mu/sigma [S].
+
+        Returns the calibration split's z-max scores (computed host-side from
+        the same NLLs — no second forward pass) for threshold calibration."""
+        from ...models.tokenizer import PAD_ID
+
+        nlls = np.concatenate([
+            np.asarray(self._token_nlls_dev(data[i:i + bs]))[: len(data[i:i + bs])]
+            for i in range(0, len(data), bs)
+        ])[: len(data)]
+        mask = (data != PAD_ID).astype(np.float32)
+        cnt = np.maximum(mask.sum(0), 1.0)
+        mu = (nlls * mask).sum(0) / cnt
+        var = ((nlls - mu) ** 2 * mask).sum(0) / cnt
+        # sigma floor: a near-constant position stays sensitive to unseen
+        # values without the z-score exploding on float jitter
+        sigma = np.maximum(np.sqrt(var), 0.05)
+        self._norm_mu = mu.astype(np.float32)
+        self._norm_sigma = sigma.astype(np.float32)
+        z = (nlls - mu) / sigma
+        z = np.where(mask > 0, z, -np.inf)
+        zmax = z.max(-1)
+        return np.where(np.isfinite(zmax), zmax, 0.0).astype(np.float32)
 
     def _train_step(self, step_rng, batch: np.ndarray) -> float:
         if self._sharded is not None:
@@ -204,20 +264,36 @@ class JaxScorerDetector(CoreDetector):
         bs = min(cfg.train_batch_size, len(data))
         loss = float("nan")
         rng = np.random.default_rng(cfg.seed)
-        steps_per_epoch = max(1, len(data) // bs)
+        # "position" norm calibrates on a held-out split: statistics computed
+        # on data the model memorized underestimate the NLL of *fresh* values
+        # in high-entropy fields (pids, timestamps), which then all z-spike
+        if cfg.score_norm == "position" and len(data) >= 64:
+            n_cal = max(16, len(data) // 5)
+            calib, train_data = data[-n_cal:], data[:-n_cal]
+            bs = min(bs, len(train_data))  # keep the train loop non-empty
+        else:
+            calib, train_data = data, data
+        steps_per_epoch = max(1, len(train_data) // bs)
         epochs = max(cfg.train_epochs,
                      -(-cfg.min_train_steps // steps_per_epoch))  # ceil division
         for _ in range(epochs):
-            order = rng.permutation(len(data))
-            for start in range(0, len(data) - bs + 1, bs):
-                batch = data[order[start:start + bs]]
+            order = rng.permutation(len(train_data))
+            for start in range(0, len(train_data) - bs + 1, bs):
+                batch = train_data[order[start:start + bs]]
                 self._rng, step_rng = jax.random.split(self._rng)
                 loss = self._train_step(step_rng, batch)
-        if self._threshold is None:
+        if cfg.score_norm == "position":
+            # calibrate BEFORE thresholding so the threshold is in z units;
+            # the returned z-max scores reuse the same forward pass
+            scores = self._calibrate_position_norm(calib, bs)
+            if self._threshold is None:
+                self._threshold = float(
+                    scores.mean() + cfg.threshold_sigma * scores.std())
+        elif self._threshold is None:
             scores = np.concatenate([
-                np.asarray(self._score_dev(data[i:i + bs]))[: len(data[i:i + bs])]
-                for i in range(0, len(data), bs)
-            ])[: len(data)]
+                np.asarray(self._score_dev(calib[i:i + bs]))[: len(calib[i:i + bs])]
+                for i in range(0, len(calib), bs)
+            ])[: len(calib)]
             self._threshold = float(scores.mean() + cfg.threshold_sigma * scores.std())
         self._fitted = True
         return {"loss": loss, "threshold": self._threshold}
@@ -412,6 +488,9 @@ class JaxScorerDetector(CoreDetector):
             "trained": self._trained,
             "threshold": self._threshold,
             "fitted": self._fitted,
+            "norm_mu": None if self._norm_mu is None else self._norm_mu.tolist(),
+            "norm_sigma": (None if self._norm_sigma is None
+                           else self._norm_sigma.tolist()),
         }
 
     def save_checkpoint(self, directory: str) -> None:
@@ -442,6 +521,15 @@ class JaxScorerDetector(CoreDetector):
             self._params, self._opt_state = params, opt_state
         self._trained = int(meta.get("trained", 0))
         self._fitted = bool(meta.get("fitted", False))
+        mu, sigma = meta.get("norm_mu"), meta.get("norm_sigma")
+        if self.config.score_norm == "position":
+            self._norm_mu = None if mu is None else np.asarray(mu, np.float32)
+            self._norm_sigma = (None if sigma is None
+                                else np.asarray(sigma, np.float32))
+        else:
+            # a config that turned normalization off outranks checkpointed
+            # calibration — otherwise scores and threshold disagree on units
+            self._norm_mu = self._norm_sigma = None
         if self.config.score_threshold is not None:
             # explicit config override outranks the checkpointed calibration
             self._threshold = self.config.score_threshold

@@ -39,6 +39,9 @@ class LogBERTConfig:
     dtype: Any = jnp.bfloat16
     mask_prob: float = 0.15
     learning_rate: float = 1e-3
+    # 0 = mean NLL over all observed tokens; k > 0 = mean of the k most
+    # surprising tokens (sharper for single-field anomalies)
+    score_topk: int = 0
 
 
 class Block(nn.Module):
@@ -84,16 +87,43 @@ class LogBERT(nn.Module):
         return logits
 
 
-def token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Per-sequence mean NLL of the observed non-PAD tokens → [B] fp32.
+def token_nll(logits: jax.Array, tokens: jax.Array, topk: int = 0) -> jax.Array:
+    """Per-sequence NLL of the observed non-PAD tokens → [B] fp32.
 
     This is the anomaly score: a model trained on normal traffic assigns high
-    NLL (= surprise) to unseen token patterns.
+    NLL (= surprise) to unseen token patterns. ``topk > 0`` averages only the
+    k most surprising tokens instead of all of them — a log line that is
+    normal except for one injected value should score on the anomaly, not
+    have it diluted across the other ~30 tokens.
     """
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
     mask = (tokens != PAD_ID).astype(jnp.float32)
-    return -(tok_lp * mask).sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+    nll = -tok_lp * mask  # PAD positions contribute 0
+    if topk > 0:
+        k = min(topk, nll.shape[-1])
+        top = jax.lax.top_k(nll, k)[0]
+        denom = jnp.minimum(jnp.maximum(mask.sum(-1), 1.0), float(k))
+        return top.sum(-1) / denom
+    return nll.sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+
+
+def positional_z_max(nlls: jax.Array, tokens: jax.Array,
+                     mu: jax.Array, sigma: jax.Array) -> jax.Array:
+    """Per-position-normalized anomaly score: max over positions of
+    ``(NLL - mu_pos) / sigma_pos`` → [B] fp32.
+
+    ``mu``/``sigma`` [S] are calibrated on training traffic. High-entropy
+    positions (random pids, timestamps) get large sigma and self-suppress;
+    low-entropy positions (process names, paths) get small sigma, so an
+    unseen value there produces a large z — the signal a plain sequence-mean
+    NLL dilutes across the other ~30 tokens. All-PAD rows score 0.
+    """
+    mask = tokens != PAD_ID
+    z = (nlls - mu) / sigma
+    z = jnp.where(mask, z, -jnp.inf)
+    zmax = jnp.max(z, axis=-1)
+    return jnp.where(jnp.isfinite(zmax), zmax, 0.0)
 
 
 def masked_lm_loss(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
@@ -114,6 +144,8 @@ class LogBERTScorer:
         self.optimizer = optax.adamw(self.config.learning_rate)
         self._score = jax.jit(self._score_impl)
         self._train = jax.jit(self._train_impl)
+        self._token_nlls = jax.jit(self._token_nlls_impl)
+        self._normscore = jax.jit(self._normscore_impl)
 
     def init(self, rng: jax.Array) -> Tuple[Any, Any]:
         dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
@@ -122,7 +154,19 @@ class LogBERTScorer:
 
     # -- jitted impls ---------------------------------------------------
     def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
-        return token_nll(self.model.apply(params, tokens), tokens)
+        return token_nll(self.model.apply(params, tokens), tokens,
+                         topk=self.config.score_topk)
+
+    def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
+        """[B, S] per-position NLL (PAD positions → 0)."""
+        logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
+        tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
+        return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
+
+    def _normscore_impl(self, params, tokens: jax.Array,
+                        mu: jax.Array, sigma: jax.Array) -> jax.Array:
+        return positional_z_max(self._token_nlls_impl(params, tokens),
+                                tokens, mu, sigma)
 
     def _train_impl(self, params, opt_state, rng, tokens):
         cfg = self.config

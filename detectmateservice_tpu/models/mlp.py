@@ -69,6 +69,8 @@ class MLPScorer:
         self.optimizer = optax.adamw(self.config.learning_rate)
         self._score = jax.jit(self._score_impl)
         self._train = jax.jit(self._train_impl)
+        self._token_nlls = jax.jit(self._token_nlls_impl)
+        self._normscore = jax.jit(self._normscore_impl)
 
     def init(self, rng: jax.Array) -> Tuple[Any, Any]:
         dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
@@ -77,6 +79,19 @@ class MLPScorer:
 
     def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
         return bag_nll(self.model.apply(params, tokens), tokens)
+
+    def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
+        """[B, S] per-position NLL under the bag context distribution."""
+        logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
+        tok_lp = jnp.take_along_axis(logprobs, tokens, axis=-1)  # [B, S]
+        return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
+
+    def _normscore_impl(self, params, tokens: jax.Array,
+                        mu: jax.Array, sigma: jax.Array) -> jax.Array:
+        from .logbert import positional_z_max
+
+        return positional_z_max(self._token_nlls_impl(params, tokens),
+                                tokens, mu, sigma)
 
     def _train_impl(self, params, opt_state, rng, tokens):
         del rng  # no stochastic corruption in the bag model

@@ -54,6 +54,14 @@ class ShardedScorer:
             scorer._score_impl,
             in_shardings=(self._param_sharding, self._batch_sharding),
         )
+        self._token_nlls = jax.jit(
+            scorer._token_nlls_impl,
+            in_shardings=(self._param_sharding, self._batch_sharding),
+        )
+        self._normscore = jax.jit(
+            scorer._normscore_impl,
+            in_shardings=(self._param_sharding, self._batch_sharding, None, None),
+        )
         self._train = jax.jit(
             scorer._train_impl,
             in_shardings=(self._param_sharding, self._opt_sharding, None,
@@ -89,6 +97,18 @@ class ShardedScorer:
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
         return self._score(self.params, tokens)
+
+    def token_nlls_device(self, tokens: np.ndarray) -> jax.Array:
+        """[n, S] → [n_padded, S] per-position NLLs on device."""
+        tokens, _ = self._pad_batch(np.asarray(tokens))
+        tokens = jax.device_put(tokens, self._batch_sharding)
+        return self._token_nlls(self.params, tokens)
+
+    def normscore_device(self, tokens: np.ndarray, mu, sigma) -> jax.Array:
+        """Per-position-normalized scores (models.logbert.positional_z_max)."""
+        tokens, _ = self._pad_batch(np.asarray(tokens))
+        tokens = jax.device_put(tokens, self._batch_sharding)
+        return self._normscore(self.params, tokens, mu, sigma)
 
     def train_step(self, rng: jax.Array, tokens: np.ndarray) -> float:
         # pad by wrapping real rows, NOT zeros: synthetic all-PAD rows would

@@ -213,3 +213,59 @@ class TestMeshSharded:
         assert det._sharded is not None
         out = det.process_batch(normal_msgs(8)) + det.flush()
         assert isinstance(out, list)
+
+
+def noisy_msg(stable, noise, log_id="1"):
+    # one low-entropy field (comm) + one high-entropy field (pid)
+    return msg("pid=<*> comm=<*> exe=<*>", [noise, stable, f"/usr/bin/{stable}"],
+               log_id=log_id)
+
+
+class TestPositionNorm:
+    """score_norm=position: per-position z-scores calibrated on held-out
+    training traffic — noisy fields self-suppress, low-entropy fields flag
+    unseen values (models/logbert.py positional_z_max)."""
+
+    def _config(self, **overrides):
+        return scorer_config(score_norm="position", data_use_training=96,
+                             threshold_sigma=5.0, seq_len=16, **overrides)
+
+    def _train_msgs(self, n, start=0):
+        comms = ["cron", "sshd", "systemd", "bash"]
+        return [noisy_msg(comms[i % 4], str(3000 + i * 17), log_id=str(start + i))
+                for i in range(n)]
+
+    def test_noisy_field_suppressed_stable_field_flagged(self):
+        det = JaxScorerDetector(config=self._config())
+        assert det.process_batch(self._train_msgs(96)) == []
+        assert det._norm_mu is not None and det._norm_sigma is not None
+        # fresh pids (noise) on known comms: no alerts
+        out = det.process_batch(self._train_msgs(32, start=500)) + det.flush()
+        assert [o for o in out if o is not None] == []
+        # unseen comm (low-entropy field): alert
+        bad = [noisy_msg("xmrig", "4242", log_id="999")]
+        out = det.process_batch(self._train_msgs(7, start=600) + bad) + det.flush()
+        alerts = [o for o in out if o is not None]
+        assert len(alerts) == 1
+        assert list(DetectorSchema.from_bytes(alerts[0]).logIDs) == ["999"]
+
+    def test_checkpoint_preserves_calibration(self, tmp_path):
+        det = JaxScorerDetector(config=self._config())
+        det.process_batch(self._train_msgs(96))
+        det.save_checkpoint(str(tmp_path / "ckpt"))
+        fresh = JaxScorerDetector(config=self._config())
+        fresh.load_checkpoint(str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(fresh._norm_mu, det._norm_mu, rtol=1e-6)
+        np.testing.assert_allclose(fresh._norm_sigma, det._norm_sigma, rtol=1e-6)
+        bad = [noisy_msg("xmrig", "77", log_id="7")]
+        out = fresh.process_batch(self._train_msgs(7, start=700) + bad) + fresh.flush()
+        assert len([o for o in out if o is not None]) == 1
+
+    def test_position_norm_over_mesh(self):
+        det = JaxScorerDetector(config=self._config(mesh_shape={"data": 8}))
+        assert det.process_batch(self._train_msgs(96)) == []
+        bad = [noisy_msg("nc", "88", log_id="888")]
+        out = det.process_batch(self._train_msgs(7, start=800) + bad) + det.flush()
+        alerts = [o for o in out if o is not None]
+        assert len(alerts) == 1
+        assert list(DetectorSchema.from_bytes(alerts[0]).logIDs) == ["888"]
