@@ -79,11 +79,20 @@ class LibraryComponentProcessor:
             return [self.component.process(data) for data in batch]
 
     def flush(self):
-        """Drain a pipelined component (engine calls this on idle and stop)."""
+        """Drain a pipelined component (engine calls this on idle)."""
         if self.component is None:
             return []
         flush_fn = getattr(self.component, "flush", None)
         return flush_fn() if callable(flush_fn) else []
+
+    def flush_final(self):
+        """Stop-time drain: unlike ``flush`` this may block (e.g. waiting out
+        a background boundary fit) so nothing pending is lost at shutdown."""
+        if self.component is None:
+            return []
+        final_fn = (getattr(self.component, "flush_final", None)
+                    or getattr(self.component, "flush", None))
+        return final_fn() if callable(final_fn) else []
 
 
 class Service:
@@ -95,6 +104,13 @@ class Service:
     ) -> None:
         self.settings = settings
         self.logger = self._setup_logging()
+        # record the platform choice WITHOUT importing jax — non-jax
+        # components (parsers, readers) must not pay jax's import cost;
+        # jax-using components apply the pin before their first jax op
+        # (DETECTMATE_BACKEND=cpu reaches here via the settings env layer)
+        from .utils.backend import request_platform
+
+        request_platform(settings.backend)
         self._labels = dict(
             component_type=settings.component_type,
             component_id=settings.component_id or "unknown",

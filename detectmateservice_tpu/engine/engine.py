@@ -279,14 +279,17 @@ class Engine:
                 if out is not None:
                     self._send_to_outputs(out)
 
-        # loop exiting (stop requested): drain the pipeline before sockets close
-        if callable(flush_fn):
+        # loop exiting (stop requested): drain the pipeline before sockets
+        # close — flush_final (when provided) also waits out work the
+        # idle-time flush leaves running, e.g. a background boundary fit
+        final_fn = getattr(self.processor, "flush_final", None) or flush_fn
+        if callable(final_fn):
             try:
-                for out in flush_fn():
+                for out in final_fn():
                     if out is not None:
                         self._send_to_outputs(out)
             except Exception as exc:
-                self.logger.error("flush() at stop raised: %s", exc)
+                self.logger.error("flush at stop raised: %s", exc)
 
     # -- fan-out --------------------------------------------------------
     def _send_to_outputs(self, data: bytes) -> bool:

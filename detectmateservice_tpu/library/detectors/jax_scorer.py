@@ -51,6 +51,9 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # noisy fields (pids, timestamps) self-suppress, low-entropy fields flag
     # unseen values sharply (models/logbert.py positional_z_max)
     score_norm: str = "none"
+    # run the train→detect boundary fit in a background thread so the engine
+    # loop keeps draining its input during training (batched path only)
+    async_fit: bool = True
     max_batch: int = 1024
     # how many scored batches may be in flight before results are forced
     # back to the host; hides device→host readback latency behind the next
@@ -98,6 +101,8 @@ class JaxScorerDetector(CoreDetector):
         self._fitted = False
         self._norm_mu: Optional[np.ndarray] = None     # [S] fp32, "position" norm
         self._norm_sigma: Optional[np.ndarray] = None  # [S] fp32
+        self._fit_thread = None                        # async boundary fit
+        self._pending: List = []                       # (tokens_row, raw) backlog
         self._metrics_labels = None
         # in-flight scored batches: (scores_device_array, parsed_msgs, n_real)
         from collections import deque
@@ -112,28 +117,40 @@ class JaxScorerDetector(CoreDetector):
         self._ensure_scorer()
         import jax
 
-        warm_norm = (self.config.score_norm == "position"
-                     and self._norm_mu is None)
+        # warm only the kernels this mode's detect path will run — every
+        # extra warmed kernel costs a full XLA compile at startup (the
+        # persistent compilation cache amortizes restarts, not first boot)
+        position = self.config.score_norm == "position" and self._norm_mu is None
+        dummy_stats = np.ones(self.config.seq_len, np.float32)
         for b in (1, 8, self.config.train_batch_size, self.config.max_batch):
             bucket = _bucket(b, self.config.max_batch)
             tokens = np.zeros((bucket, self.config.seq_len), np.int32)
-            jax.block_until_ready(self._score_dev(tokens))
-            if warm_norm:
-                # detection will run the _normscore kernel once calibrated;
-                # warm it per bucket with dummy stats so the train→detect
-                # boundary pays no compile stall on the hot path
-                dummy = np.ones(self.config.seq_len, np.float32)
-                self._norm_mu, self._norm_sigma = np.zeros_like(dummy), dummy
+            if position:
+                self._norm_mu, self._norm_sigma = (np.zeros_like(dummy_stats),
+                                                   dummy_stats)
                 try:
                     jax.block_until_ready(self._score_dev(tokens))
                 finally:
                     self._norm_mu = self._norm_sigma = None
+            else:
+                jax.block_until_ready(self._score_dev(tokens))
+        if position:
+            # fit's calibration pass runs token_nlls at the train bucket
+            bucket = _bucket(self.config.train_batch_size, self.config.max_batch)
+            tokens = np.zeros((bucket, self.config.seq_len), np.int32)
+            jax.block_until_ready(self._token_nlls_dev(tokens))
 
     def _ensure_scorer(self) -> None:
         if self._scorer is not None:
             return
+        from ...utils.backend import apply_platform_pin
+
+        apply_platform_pin()
         import jax
 
+        from ...utils.profiling import enable_compilation_cache
+
+        enable_compilation_cache()
         cfg = self.config
         if cfg.score_norm not in ("none", "position"):
             raise LibraryError(
@@ -207,10 +224,19 @@ class JaxScorerDetector(CoreDetector):
         the same NLLs — no second forward pass) for threshold calibration."""
         from ...models.tokenizer import PAD_ID
 
-        nlls = np.concatenate([
-            np.asarray(self._token_nlls_dev(data[i:i + bs]))[: len(data[i:i + bs])]
-            for i in range(0, len(data), bs)
-        ])[: len(data)]
+        # pad every chunk to the warmed compile bucket — a ragged tail shape
+        # would force a fresh XLA compile right at the phase boundary
+        bucket = _bucket(max(bs, self.config.train_batch_size),
+                         self.config.max_batch)
+        chunks = []
+        for i in range(0, len(data), bucket):
+            chunk = data[i:i + bucket]
+            real = len(chunk)
+            if real < bucket:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((bucket - real,) + chunk.shape[1:], chunk.dtype)])
+            chunks.append(np.asarray(self._token_nlls_dev(chunk))[:real])
+        nlls = np.concatenate(chunks)[: len(data)]
         mask = (data != PAD_ID).astype(np.float32)
         cnt = np.maximum(mask.sum(0), 1.0)
         mu = (nlls * mask).sum(0) / cnt
@@ -223,7 +249,8 @@ class JaxScorerDetector(CoreDetector):
         z = (nlls - mu) / sigma
         z = np.where(mask > 0, z, -np.inf)
         zmax = z.max(-1)
-        return np.where(np.isfinite(zmax), zmax, 0.0).astype(np.float32)
+        # match positional_z_max: only all-PAD (-inf) rows become 0
+        return np.where(np.isneginf(zmax), 0.0, zmax).astype(np.float32)
 
     def _train_step(self, step_rng, batch: np.ndarray) -> float:
         if self._sharded is not None:
@@ -290,10 +317,16 @@ class JaxScorerDetector(CoreDetector):
                 self._threshold = float(
                     scores.mean() + cfg.threshold_sigma * scores.std())
         elif self._threshold is None:
-            scores = np.concatenate([
-                np.asarray(self._score_dev(calib[i:i + bs]))[: len(calib[i:i + bs])]
-                for i in range(0, len(calib), bs)
-            ])[: len(calib)]
+            bucket = _bucket(max(bs, cfg.train_batch_size), cfg.max_batch)
+            parts = []
+            for i in range(0, len(calib), bucket):
+                chunk = calib[i:i + bucket]
+                real = len(chunk)
+                if real < bucket:  # stay on the warmed compile bucket
+                    chunk = np.concatenate([chunk, np.zeros(
+                        (bucket - real,) + chunk.shape[1:], chunk.dtype)])
+                parts.append(np.asarray(self._score_dev(chunk))[:real])
+            scores = np.concatenate(parts)[: len(calib)]
             self._threshold = float(scores.mean() + cfg.threshold_sigma * scores.std())
         self._fitted = True
         return {"loss": loss, "threshold": self._threshold}
@@ -370,7 +403,15 @@ class JaxScorerDetector(CoreDetector):
         """Batched hot path: one featurize kernel + one jit call per
         micro-batch, preserving the per-message in-order None-filtering
         contract. Raw bytes are decoded into schema objects only for the
-        (rare) anomalous messages, at alert-construction time."""
+        (rare) anomalous messages, at alert-construction time.
+
+        The train→detect boundary fit runs in a background thread
+        (``async_fit``): the engine loop keeps draining its input — messages
+        that arrive mid-fit buffer in-process (ordered) instead of piling
+        into socket buffers and dropping — and the pending backlog dispatches
+        on the first call after the fit completes."""
+        if self._fit_thread is not None and not self._fit_thread.is_alive():
+            self._finish_fit()
         tokens, ok = self._featurize_raw_batch(batch)
 
         # split the batch across the train/detect phase boundary
@@ -382,7 +423,10 @@ class JaxScorerDetector(CoreDetector):
                 self._train_buffer.append(tokens[i])
                 self._trained += 1
                 if self._trained == self.config.data_use_training:
-                    self.fit()
+                    self._start_fit()
+            elif self._fit_thread is not None:
+                # fit still running: keep order by buffering the message
+                self._pending.append((tokens[i], batch[i]))
             else:
                 if not self._fitted:
                     self.fit()
@@ -397,6 +441,45 @@ class JaxScorerDetector(CoreDetector):
         # training/filtered messages of THIS batch produced no output; the
         # drained outputs (older batches) are already in order
         return ready
+
+    # -- async fit at the phase boundary --------------------------------
+    def _start_fit(self) -> None:
+        if not self.config.async_fit:
+            self.fit()
+            return
+        import threading
+
+        def _fit_safe():
+            try:
+                self.fit()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("background fit failed")
+                self._fitted = True  # fail open: detect with inf threshold
+                if self._threshold is None:
+                    self._threshold = float("inf")
+
+        self._fit_thread = threading.Thread(target=_fit_safe, daemon=True,
+                                            name="ScorerFit")
+        self._fit_thread.start()
+
+    def _finish_fit(self, wait: bool = False) -> None:
+        """Join a finished (or, with ``wait``, still-running) fit thread and
+        dispatch the ordered backlog that accumulated during the fit."""
+        thread = self._fit_thread
+        if thread is None:
+            return
+        if thread.is_alive() and not wait:
+            return
+        thread.join()
+        self._fit_thread = None
+        if self._pending:
+            tokens = np.stack([t for t, _ in self._pending])
+            raws = [r for _, r in self._pending]
+            self._pending = []
+            self._dispatch(tokens, raws)
+            self._count_device_lines(len(raws))
 
     def _dispatch(self, tokens: np.ndarray, msgs: List[Any]) -> None:
         """Asynchronously score [n, S] tokens, padded to a compile bucket."""
@@ -434,11 +517,22 @@ class JaxScorerDetector(CoreDetector):
         return out
 
     def flush(self) -> List[Optional[bytes]]:
-        """Drain every in-flight batch (engine calls on idle/stop)."""
+        """Idle-time drain (engine calls on every input lull): NON-blocking —
+        a 100 ms lull does not mean the input stays idle, so waiting out a
+        running boundary fit here would stall the engine loop and drop
+        messages at the socket HWM (the failure async_fit exists to prevent).
+        A finished fit's backlog is dispatched; a running fit is left alone."""
+        self._finish_fit(wait=False)
         out: List[Optional[bytes]] = []
         while self._inflight:
             out.extend(self._drain_one())
         return out
+
+    def flush_final(self) -> List[Optional[bytes]]:
+        """Stop-time drain: waits for a running boundary fit so its pending
+        backlog is scored and emitted before sockets close."""
+        self._finish_fit(wait=True)
+        return self.flush()
 
     def _make_alert_pb(self, msg, score: float) -> bytes:
         """Alert construction from a decoded pb2 message (anomalies only —
@@ -449,6 +543,7 @@ class JaxScorerDetector(CoreDetector):
 
     def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
         """Single-message path (parity mode / tests): batch of one."""
+        self._finish_fit(wait=True)  # mixed usage: boundary fit may be running
         if not self._fitted:
             self.fit()
         score = float(self.score_tokens(self.featurize(input_)[None])[0])
@@ -495,6 +590,10 @@ class JaxScorerDetector(CoreDetector):
 
     def save_checkpoint(self, directory: str) -> None:
         from ...utils.checkpoint import save_scorer_state
+
+        # a boundary fit mutates params/threshold concurrently — land it
+        # first so the checkpoint is a consistent post-fit snapshot
+        self._finish_fit(wait=True)
 
         if self._sharded is not None:
             save_scorer_state(directory, self._sharded.params,

@@ -123,7 +123,9 @@ def positional_z_max(nlls: jax.Array, tokens: jax.Array,
     z = (nlls - mu) / sigma
     z = jnp.where(mask, z, -jnp.inf)
     zmax = jnp.max(z, axis=-1)
-    return jnp.where(jnp.isfinite(zmax), zmax, 0.0)
+    # -inf only means an all-PAD row (score 0); +inf is a maximally
+    # anomalous token (NLL overflow) and must stay an alert, not become 0
+    return jnp.where(jnp.isneginf(zmax), 0.0, zmax)
 
 
 def masked_lm_loss(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:

@@ -1,0 +1,40 @@
+"""Lazy jax platform pinning.
+
+``ServiceSettings.backend`` ("auto" | "cpu" | "tpu") selects the accelerator
+platform, but importing jax costs seconds of cold-start and hundreds of MB of
+RSS — a parser or reader service must never pay that. So the Service records
+the request here without importing jax, and jax-using components (the scorer's
+``_ensure_scorer``) apply it right before their first jax op.
+
+The env var route (``JAX_PLATFORMS``) is not enough on images whose
+sitecustomize force-registers an accelerator platform for every interpreter;
+``jax.config.update("jax_platforms", ...)`` before backend initialization is
+the reliable override.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+_requested: Optional[str] = None
+
+
+def request_platform(name: Optional[str]) -> None:
+    """Record the platform choice (no jax import). "auto"/None = leave as-is."""
+    global _requested
+    if name in ("cpu", "tpu"):
+        _requested = name
+
+
+def apply_platform_pin(logger=None) -> None:
+    """Pin jax to the requested platform; call before the first jax op."""
+    global _requested
+    if _requested is None:
+        return
+    name, _requested = _requested, None
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", name)
+    except Exception as exc:  # backend already initialized elsewhere
+        if logger is not None:
+            logger.warning("cannot pin jax platform %r: %s", name, exc)

@@ -7,6 +7,35 @@ import time
 from typing import Any, Dict
 
 
+_cache_enabled = False
+_cache_lock = threading.Lock()
+
+
+def enable_compilation_cache(path: str = "") -> None:
+    """Enable JAX's persistent compilation cache (idempotent).
+
+    Service restarts then skip the multi-second XLA compiles for every
+    already-seen (kernel, bucket) shape — the largest component of a scorer
+    service's cold-start time. Failures are non-fatal (read-only FS etc.)."""
+    global _cache_enabled
+    with _cache_lock:
+        if _cache_enabled:
+            return
+        import os
+
+        import jax
+
+        cache_dir = (path or os.environ.get("DETECTMATE_JAX_CACHE")
+                     or os.path.expanduser("~/.cache/detectmate/jax"))
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            _cache_enabled = True
+        except Exception:
+            pass
+
+
 def capture_trace(out_dir: str, duration_ms: int = 1000) -> Dict[str, Any]:
     """Record a jax.profiler trace for ``duration_ms`` into ``out_dir``.
 

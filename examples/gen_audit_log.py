@@ -39,9 +39,10 @@ def make_line(i: int, rng: random.Random, anomaly: bool) -> str:
 
 def generate(n: int, anomaly_rate: float = 0.005, seed: int = 7):
     rng = random.Random(seed)
-    # anomalies only after the training prefix would have been consumed
-    # (the scorer example trains on the first 512 messages)
-    guard = max(640, n // 10) if n > 1280 else max(64, n // 10)
+    # anomalies only after the training prefix would have been consumed —
+    # the scorer example trains on the first 512 messages, so any stream
+    # long enough for that path keeps its anomalies past index 640
+    guard = max(640, n // 10) if n > 640 else max(64, n // 10)
     for i in range(n):
         anomaly = i > guard and rng.random() < anomaly_rate
         yield make_line(i, rng, anomaly), anomaly

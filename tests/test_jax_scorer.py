@@ -32,6 +32,7 @@ def trained_detector():
     det = JaxScorerDetector(config=scorer_config())
     out = det.process_batch(normal_msgs(32))
     assert out == []  # training messages produce no output
+    det.flush_final()  # async boundary fit: wait so tests see calibrated state
     return det
 
 
@@ -50,6 +51,7 @@ class TestTrainingPhase:
     def test_explicit_threshold_respected(self):
         det = JaxScorerDetector(config=scorer_config(score_threshold=123.0))
         det.process_batch(normal_msgs(32))
+        det.flush_final()
         assert det._threshold == 123.0
 
 
@@ -95,6 +97,7 @@ class TestDetection:
         det = JaxScorerDetector(config=scorer_config(
             model="logbert", dim=32, depth=1, heads=2, data_use_training=32))
         det.process_batch(normal_msgs(32))
+        det.flush_final()  # wait out the async boundary fit
         assert det._fitted
         out = det.process_batch(normal_msgs(8)) + det.flush()
         assert isinstance(out, list)
@@ -158,6 +161,7 @@ class TestMeshSharded:
     per the model rules; XLA inserts the collectives (BASELINE config #5)."""
 
     def _mesh_detector(self, **overrides):
+        overrides.setdefault("async_fit", False)
         return JaxScorerDetector(config=scorer_config(
             mesh_shape={"data": 8}, **overrides))
 
@@ -208,7 +212,7 @@ class TestMeshSharded:
         # contract (runs, in-order, list out) rather than alert quality
         det = JaxScorerDetector(config=scorer_config(
             model="logbert", mesh_shape={"data": 4, "model": 2},
-            dim=32, depth=1, seq_len=16, threshold_sigma=8.0))
+            dim=32, depth=1, seq_len=16, threshold_sigma=8.0, async_fit=False))
         assert det.process_batch(normal_msgs(32)) == []
         assert det._sharded is not None
         out = det.process_batch(normal_msgs(8)) + det.flush()
@@ -227,8 +231,10 @@ class TestPositionNorm:
     unseen values (models/logbert.py positional_z_max)."""
 
     def _config(self, **overrides):
+        # sync fit: these tests assert calibration state right at the boundary
         return scorer_config(score_norm="position", data_use_training=96,
-                             threshold_sigma=5.0, seq_len=16, **overrides)
+                             threshold_sigma=5.0, seq_len=16, async_fit=False,
+                             **overrides)
 
     def _train_msgs(self, n, start=0):
         comms = ["cron", "sshd", "systemd", "bash"]
@@ -269,3 +275,58 @@ class TestPositionNorm:
         alerts = [o for o in out if o is not None]
         assert len(alerts) == 1
         assert list(DetectorSchema.from_bytes(alerts[0]).logIDs) == ["888"]
+
+
+class TestAsyncFit:
+    """async_fit runs the train→detect boundary fit off-thread: the engine
+    keeps draining input, mid-fit messages buffer in order, and the backlog
+    dispatches when the fit lands (flush waits so nothing is lost at stop)."""
+
+    def _slow_fit_detector(self, delay=0.4, **overrides):
+        det = JaxScorerDetector(config=scorer_config(**overrides))
+        real_fit = det.fit
+
+        def slow_fit():
+            import time
+            time.sleep(delay)
+            return real_fit()
+
+        det.fit = slow_fit
+        return det
+
+    def test_mid_fit_messages_buffer_then_alert(self):
+        det = self._slow_fit_detector()
+        assert det.process_batch(normal_msgs(32)) == []    # boundary: fit starts
+        assert det._fit_thread is not None and det._fit_thread.is_alive()
+        weird = [msg("segfault <*> exploit <*>", ["0xdead", "x"], log_id="55")] * 4
+        out = det.process_batch(normal_msgs(4) + weird)
+        assert out == []                                   # buffered, fit running
+        assert len(det._pending) == 8
+        # idle-time flush must NOT block on the running fit (engine calls it
+        # on every 100ms lull); stop-time flush_final waits and drains
+        assert det.flush() == []
+        drained = det.flush_final()
+        assert det._fit_thread is None and det._pending == []
+        assert det._fitted
+        alerts = [o for o in drained if o is not None]
+        assert alerts and all(
+            set(DetectorSchema.from_bytes(a).logIDs) == {"55"} for a in alerts)
+
+    def test_backlog_dispatches_on_next_batch_in_order(self):
+        det = self._slow_fit_detector(delay=0.2, pipeline_depth=0)
+        det.process_batch(normal_msgs(32))
+        det.process_batch([msg("segfault <*> exploit <*>", ["0xdead", "a"],
+                               log_id="71")])
+        det._fit_thread.join()  # deterministic: fit lands in the background
+        out = det.process_batch([msg("segfault <*> exploit <*>", ["0xdead", "b"],
+                                     log_id="72")])
+        out += det.flush()
+        ids = [list(DetectorSchema.from_bytes(o).logIDs)[0]
+               for o in out if o is not None]
+        assert ids == ["71", "72"]  # backlog first, then the new message
+
+    def test_sync_mode_unchanged(self):
+        det = JaxScorerDetector(config=scorer_config(async_fit=False))
+        assert det.process_batch(normal_msgs(32)) == []
+        assert det._fit_thread is None
+        assert det._fitted
