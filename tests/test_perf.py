@@ -1,0 +1,129 @@
+"""Coarse performance-regression guards (CPU).
+
+The reference ships no perf tests at all (SURVEY.md §6); these exist so an
+accidental 10x collapse in a hot path fails in CI rather than in the field.
+Thresholds are deliberately ~5-10x below observed CPU numbers — they catch
+algorithmic regressions (per-message recompiles, accidental O(n^2), lost
+native kernels), not hardware variance. The real throughput benchmark is
+bench.py on TPU.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from detectmateservice_tpu.schemas import ParserSchema
+
+
+def rate(n, elapsed):
+    return n / max(elapsed, 1e-9)
+
+
+def make_parsed(n):
+    return [ParserSchema(
+        EventID=1, template="type=<*> msg=audit(<*>): pid=<*> uid=<*> comm=<*>",
+        variables=["SYSCALL", f"17000{i % 100}.{i % 997}", str(300 + i % 500),
+                   str(i % 4), ["cron", "sshd", "systemd", "bash"][i % 4]],
+        logID=str(i), logFormatVariables={"Time": str(1_700_000_000 + i)},
+    ).serialize() for i in range(n)]
+
+
+class TestFeaturizeThroughput:
+    def test_native_featurize_batch(self):
+        matchkern = pytest.importorskip("detectmateservice_tpu.utils.matchkern")
+        msgs = make_parsed(20_000)
+        matchkern.featurize_batch(msgs[:128], 32, 32768)  # warm
+        t0 = time.perf_counter()
+        tokens, ok = matchkern.featurize_batch(msgs, 32, 32768)
+        r = rate(len(msgs), time.perf_counter() - t0)
+        assert ok.all()
+        assert r > 100_000, f"native featurize collapsed to {r:,.0f} lines/s"
+
+    def test_python_featurize_fallback(self):
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False,
+            "data_use_training": 8, "seq_len": 32}}})
+        msgs = make_parsed(2_000)
+        t0 = time.perf_counter()
+        tokens = np.zeros((len(msgs), 32), np.int32)
+        ok = np.zeros(len(msgs), dtype=bool)
+        det._featurize_python_rows(msgs, tokens, ok, range(len(msgs)))
+        r = rate(len(msgs), time.perf_counter() - t0)
+        assert ok.all()
+        assert r > 5_000, f"python featurize fallback collapsed to {r:,.0f} lines/s"
+
+
+class TestDetectorThroughput:
+    def test_scorer_batch_path_cpu(self):
+        # full detector contract on CPU: decode -> featurize -> jit score ->
+        # filter; guards against recompile storms and per-message dispatch
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        batch = 2048
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+            "data_use_training": 512, "train_epochs": 1, "min_train_steps": 10,
+            "seq_len": 32, "dim": 64, "max_batch": batch,
+            "threshold_sigma": 8.0, "async_fit": False}}})
+        train = make_parsed(512)
+        det.process_batch(train)
+        msgs = make_parsed(4 * batch)
+        det.process_batch(msgs[:batch])  # warm the bench bucket
+        det.flush()
+        t0 = time.perf_counter()
+        for start in range(0, len(msgs), batch):
+            det.process_batch(msgs[start:start + batch])
+        det.flush()
+        r = rate(len(msgs), time.perf_counter() - t0)
+        assert r > 10_000, f"CPU scorer path collapsed to {r:,.0f} lines/s"
+
+
+class TestTemplateMatchThroughput:
+    def test_matcher_parser_rate(self):
+        from detectmateservice_tpu.library.parsers.template_matcher import MatcherParser
+
+        parser = MatcherParser(config={"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "params": {"log_format": "type=<Type> msg=audit(<Time>): <Content>"}}}})
+        # inject templates directly (no file IO in the timing loop)
+        lines = [
+            f'type=SYSCALL msg=audit(170000{i % 97}.1:2): arch=c000003e '
+            f'syscall=59 success=yes exit=0 pid={300 + i % 500} uid=0 '
+            f'comm="cron" exe="/usr/sbin/cron"'
+            for i in range(5_000)
+        ]
+        t0 = time.perf_counter()
+        parsed = [parser.parse_line(line, log_id=str(i))
+                  for i, line in enumerate(lines)]
+        r = rate(len(lines), time.perf_counter() - t0)
+        assert all(p is not None for p in parsed)
+        assert r > 5_000, f"parser collapsed to {r:,.0f} lines/s"
+
+
+class TestTransportThroughput:
+    def test_native_recv_many_burst(self, tmp_path):
+        native = pytest.importorskip(
+            "detectmateservice_tpu.engine.native_transport")
+        f = native.NativePairSocketFactory()
+        server = f.create(f"ipc://{tmp_path}/perf.ipc")
+        client = f.create_output(f"ipc://{tmp_path}/perf.ipc", buffer_size=8192)
+        time.sleep(0.2)
+        payload = b"x" * 256
+        n = 20_000
+        t0 = time.perf_counter()
+        got = 0
+        sent = 0
+        while got < n:
+            while sent < n:
+                try:
+                    client.send(payload, block=False)
+                    sent += 1
+                except Exception:
+                    break
+            got += len(server.recv_many(4096, 1000))
+        r = rate(n, time.perf_counter() - t0)
+        client.close()
+        server.close()
+        assert r > 50_000, f"native transport collapsed to {r:,.0f} msgs/s"
