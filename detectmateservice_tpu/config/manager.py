@@ -39,6 +39,7 @@ class ServiceConfig(BaseModel):
     detectors: Optional[Dict[str, Any]] = None
     parsers: Optional[Dict[str, Any]] = None
     readers: Optional[Dict[str, Any]] = None
+    outputs: Optional[Dict[str, Any]] = None
 
 
 class ConfigManager:

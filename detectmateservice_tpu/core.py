@@ -85,6 +85,17 @@ class LibraryComponentProcessor:
         flush_fn = getattr(self.component, "flush", None)
         return flush_fn() if callable(flush_fn) else []
 
+    def pending_count(self) -> int:
+        """In-flight results held by the component (engine poll hint)."""
+        fn = getattr(self.component, "pending_count", None)
+        return fn() if callable(fn) else 0
+
+    def drain_ready(self):
+        """Non-blocking drain of already-landed results (engine short-poll
+        tick); components without the hook fall back to flush()."""
+        fn = getattr(self.component, "drain_ready", None)
+        return fn() if callable(fn) else self.flush()
+
     def flush_final(self):
         """Stop-time drain: unlike ``flush`` this may block (e.g. waiting out
         a background boundary fit) so nothing pending is lost at shutdown."""

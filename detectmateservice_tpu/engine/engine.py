@@ -202,21 +202,44 @@ class Engine:
         use_batches = batch_size > 1 and callable(batch_fn)
         batch_timeout_s = self.settings.engine_batch_timeout_ms / 1000.0
 
-        flush_fn = getattr(self.processor, "flush", None) if use_batches else None
+        # flush is wired for EVERY processor (not just batched ones): a
+        # single-message component may also hold time-windowed state it emits
+        # on idle (e.g. OutputWriter's partial aggregation group)
+        flush_fn = getattr(self.processor, "flush", None)
+        # while the processor holds in-flight (pipelined) results, poll with a
+        # short timeout so they drain within milliseconds of readiness instead
+        # of waiting out the full idle-lull timeout — the sparse-traffic
+        # latency contract (<10 ms p50) depends on this
+        pending_fn = getattr(self.processor, "pending_count", None) if use_batches else None
+        # a short-poll tick is NOT true idleness: drain only what is already
+        # host-readable (drain_ready) so the loop never blocks on an unready
+        # device readback while new traffic queues in the socket buffer
+        drain_fn = getattr(self.processor, "drain_ready", None)
+        base_timeout = self.settings.engine_recv_timeout
+        short_timeout = min(5, base_timeout)
+        current_timeout = base_timeout
         while self._running and not self._stop_event.is_set():
+            if callable(pending_fn):
+                want = short_timeout if pending_fn() > 0 else base_timeout
+                if want != current_timeout:
+                    self._pair_sock.recv_timeout = want
+                    current_timeout = want
             try:
                 raw = self._pair_sock.recv()
             except TransportTimeout:
-                # input went idle: drain any pipelined results so a quiet
-                # stream still gets bounded latency
-                if callable(flush_fn):
+                # input went idle (or a short-poll tick passed): drain
+                # pipelined results so a quiet stream still gets bounded
+                # latency; blocking flush only at the true idle timeout
+                fn = (drain_fn if current_timeout == short_timeout
+                      and callable(drain_fn) else flush_fn)
+                if callable(fn):
                     try:
-                        for out in flush_fn():
+                        for out in fn():
                             if out is not None:
                                 self._send_to_outputs(out)
                     except Exception as exc:
                         err_c.inc()
-                        self.logger.error("flush() raised: %s", exc)
+                        self.logger.error("idle drain raised: %s", exc)
                 continue
             except TransportError as exc:
                 if not self._running:

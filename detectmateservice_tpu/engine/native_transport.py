@@ -221,18 +221,21 @@ class NativePairSocket:
 
 class NativePairSocketFactory:
     """EngineSocketFactory over the C++ transport. tls+tcp stays on the
-    Python ssl transport and ws on the Python zmq backend — the factory
-    delegates those schemes, so every address the zmq factory accepts works
-    here too."""
+    Python ssl transport, and ws AND inproc on the Python zmq backend — the
+    factory delegates those schemes, so every address the zmq factory accepts
+    works here too. inproc in particular MUST delegate: the native layer's
+    private zmq context can never rendezvous with pyzmq's process-wide
+    ``Context.instance()``, so a native-side inproc endpoint would silently
+    never connect to a zmq-side (or auto-fallback) peer in the same process."""
 
-    SCHEMES = ("ipc", "tcp", "inproc")
+    SCHEMES = ("ipc", "tcp")
 
     def _delegate(self, scheme: str):
         if scheme == "tls+tcp":
             from .socket import TlsTcpSocketFactory
 
             return TlsTcpSocketFactory()
-        if scheme == "ws":
+        if scheme in ("ws", "inproc"):
             from .socket import ZmqPairSocketFactory
 
             return ZmqPairSocketFactory()

@@ -18,7 +18,7 @@ from typing import Any, Dict, Optional, Type
 
 from pydantic import BaseModel, ConfigDict, ValidationError
 
-CATEGORIES = ("detectors", "parsers", "readers")
+CATEGORIES = ("detectors", "parsers", "readers", "outputs")
 
 
 class LibraryError(Exception):

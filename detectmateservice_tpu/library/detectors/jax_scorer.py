@@ -58,7 +58,13 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # how many scored batches may be in flight before results are forced
     # back to the host; hides device→host readback latency behind the next
     # batch's CPU featurization (jax dispatch is async)
-    pipeline_depth: int = 4
+    pipeline_depth: int = 8
+    # batches at or below this size score on a CPU-jitted twin of the model
+    # (host-resident params) instead of the accelerator: a lone message costs
+    # ~1 ms on host vs 2 host↔device round-trips on a remote/tunneled TPU
+    # (~70 ms each, measured) — this is what makes the <10 ms p50 target hold
+    # for sparse traffic. 0 disables the host path.
+    host_score_max_batch: int = 128
     device: Optional[str] = None      # e.g. "tpu:0"; default = first device
     # multi-chip scale-out (BASELINE config #5): a mesh shape like
     # {"data": 8} shards batches over all chips via parallel.ShardedScorer
@@ -101,8 +107,19 @@ class JaxScorerDetector(CoreDetector):
         self._fitted = False
         self._norm_mu: Optional[np.ndarray] = None     # [S] fp32, "position" norm
         self._norm_sigma: Optional[np.ndarray] = None  # [S] fp32
+        import threading
+
         self._fit_thread = None                        # async boundary fit
+        # guards the join-and-dispatch handoff in _finish_fit: the engine
+        # loop and external callers (detect/save_checkpoint/flush_final) may
+        # race it, and an unguarded handoff can double-dispatch the backlog
+        self._fit_lock = threading.Lock()
         self._pending: List = []                       # (tokens_row, raw) backlog
+        self._host_params = None                       # CPU twin for small batches
+        self._host_score = None
+        self._host_normscore = None
+        self._cpu_device = None
+        self._ready_supported: Optional[bool] = None   # jax.Array.is_ready seen?
         self._metrics_labels = None
         # in-flight scored batches: (scores_device_array, parsed_msgs, n_real)
         from collections import deque
@@ -122,7 +139,12 @@ class JaxScorerDetector(CoreDetector):
         # persistent compilation cache amortizes restarts, not first boot)
         position = self.config.score_norm == "position" and self._norm_mu is None
         dummy_stats = np.ones(self.config.seq_len, np.float32)
-        for b in (1, 8, self.config.train_batch_size, self.config.max_batch):
+        # small buckets are only ever scored on-device when the host path is
+        # off; with it on, warming them would waste two accelerator compiles
+        # (the host twin warms its own buckets at fit time)
+        host_path = self._cpu_device is not None
+        small = () if host_path else (1, 8)
+        for b in (*small, self.config.train_batch_size, self.config.max_batch):
             bucket = _bucket(b, self.config.max_batch)
             tokens = np.zeros((bucket, self.config.seq_len), np.int32)
             if position:
@@ -192,6 +214,39 @@ class JaxScorerDetector(CoreDetector):
         # params pinned in device memory once (HBM residency; north-star item)
         self._params = jax.device_put(params, self._device)
         self._opt_state = jax.device_put(opt_state, self._device)
+        if cfg.host_score_max_batch > 0:
+            try:
+                self._cpu_device = jax.devices("cpu")[0]
+                self._host_score = jax.jit(self._scorer._score_impl,
+                                           device=self._cpu_device)
+                self._host_normscore = jax.jit(self._scorer._normscore_impl,
+                                               device=self._cpu_device)
+            except Exception:
+                self._cpu_device = None  # no CPU backend: accelerator-only
+
+    def _sync_host_params(self) -> None:
+        """Mirror the current params onto the host CPU backend (one transfer,
+        after fit / checkpoint load) so small batches can score locally."""
+        if self._cpu_device is None or self._params is None:
+            return
+        import jax
+
+        try:
+            self._host_params = jax.device_put(self._params, self._cpu_device)
+            # warm the host compile for EVERY power-of-two bucket up to the
+            # host cap so no first-occurrence small batch pays a synchronous
+            # XLA compile on the engine hot path (this runs in the background
+            # fit thread under async_fit; CPU compiles are ~100 ms each)
+            cap = self.config.host_score_max_batch
+            sizes, b = [cap], 1
+            while b < cap:
+                sizes.append(b)
+                b *= 2
+            for bucket in sorted({_bucket(s, cap) for s in sizes}):
+                jax.block_until_ready(self._score_host(
+                    np.zeros((bucket, self.config.seq_len), np.int32)))
+        except Exception:
+            self._host_params = None
 
     def _put(self, array: np.ndarray):
         import jax
@@ -329,6 +384,7 @@ class JaxScorerDetector(CoreDetector):
             scores = np.concatenate(parts)[: len(calib)]
             self._threshold = float(scores.mean() + cfg.threshold_sigma * scores.std())
         self._fitted = True
+        self._sync_host_params()
         return {"loss": loss, "threshold": self._threshold}
 
     # -- scoring --------------------------------------------------------
@@ -410,7 +466,8 @@ class JaxScorerDetector(CoreDetector):
         that arrive mid-fit buffer in-process (ordered) instead of piling
         into socket buffers and dropping — and the pending backlog dispatches
         on the first call after the fit completes."""
-        if self._fit_thread is not None and not self._fit_thread.is_alive():
+        fit_thread = self._fit_thread  # local read: another thread may None it
+        if fit_thread is not None and not fit_thread.is_alive():
             self._finish_fit()
         tokens, ok = self._featurize_raw_batch(batch)
 
@@ -425,8 +482,21 @@ class JaxScorerDetector(CoreDetector):
                 if self._trained == self.config.data_use_training:
                     self._start_fit()
             elif self._fit_thread is not None:
-                # fit still running: keep order by buffering the message
-                self._pending.append((tokens[i], batch[i]))
+                # fit still running: keep order by buffering the message.
+                # The append happens under _fit_lock so _finish_fit's
+                # backlog handoff (stack + clear) can never interleave
+                # with it and drop/mis-pair a message.
+                with self._fit_lock:
+                    if self._fit_thread is not None:
+                        self._pending.append((tokens[i], batch[i]))
+                        continue
+                # fit finished and its backlog was already dispatched by
+                # another thread between the check and the lock: this
+                # message scores normally (order is preserved — backlog
+                # dispatch happened first, detect_idx dispatches below)
+                if not self._fitted:
+                    self.fit()
+                detect_idx.append(i)
             else:
                 if not self._fitted:
                     self.fit()
@@ -436,11 +506,51 @@ class JaxScorerDetector(CoreDetector):
             n = len(detect_idx)
             self._dispatch(tokens[detect_idx], [batch[i] for i in detect_idx])
             self._count_device_lines(n)
+        # event-driven drain: anything whose readback already landed goes out
+        # NOW (bounded latency even under a steady stream that never lulls);
+        # the depth gate stays as the backstop that also bounds memory
+        while self._inflight and self._head_ready():
+            ready.extend(self._drain_one())
         while len(self._inflight) > self.config.pipeline_depth:
             ready.extend(self._drain_one())
         # training/filtered messages of THIS batch produced no output; the
         # drained outputs (older batches) are already in order
         return ready
+
+    def _head_ready(self) -> bool:
+        """True when the oldest in-flight batch's scores are host-readable
+        without blocking (host-path numpy results always are)."""
+        scores = self._inflight[0][0]
+        if isinstance(scores, np.ndarray):
+            return True
+        is_ready = getattr(scores, "is_ready", None)
+        if callable(is_ready):
+            self._ready_supported = True
+            try:
+                return bool(is_ready())
+            except Exception:
+                return False
+        self._ready_supported = False
+        return False  # cannot tell: leave it to the depth gate / flush
+
+    def pending_count(self) -> int:
+        """In-flight scored batches not yet drained (engine poll hint: while
+        results are pending the engine shortens its recv timeout so a drain
+        happens within milliseconds of readiness, not at the 100 ms lull)."""
+        return len(self._inflight)
+
+    def drain_ready(self) -> List[Optional[bytes]]:
+        """Engine short-poll tick: pop only batches whose readback already
+        landed — never blocks the loop on an in-flight device batch. When the
+        array type cannot report readiness at all, fall back to the blocking
+        flush (otherwise nothing would ever drain on short ticks)."""
+        out: List[Optional[bytes]] = []
+        self._finish_fit(wait=False)
+        while self._inflight and self._head_ready():
+            out.extend(self._drain_one())
+        if self._inflight and self._ready_supported is False:
+            out.extend(self.flush())
+        return out
 
     # -- async fit at the phase boundary --------------------------------
     def _start_fit(self) -> None:
@@ -466,25 +576,50 @@ class JaxScorerDetector(CoreDetector):
 
     def _finish_fit(self, wait: bool = False) -> None:
         """Join a finished (or, with ``wait``, still-running) fit thread and
-        dispatch the ordered backlog that accumulated during the fit."""
-        thread = self._fit_thread
-        if thread is None:
-            return
-        if thread.is_alive() and not wait:
-            return
-        thread.join()
-        self._fit_thread = None
-        if self._pending:
-            tokens = np.stack([t for t, _ in self._pending])
-            raws = [r for _, r in self._pending]
-            self._pending = []
-            self._dispatch(tokens, raws)
-            self._count_device_lines(len(raws))
+        dispatch the ordered backlog that accumulated during the fit.
+
+        Lock-guarded: the engine loop and external callers (detect /
+        save_checkpoint / flush_final — mixed usage the class supports) may
+        call this concurrently; without the lock both could observe a
+        non-empty backlog and double-dispatch it."""
+        pre = self._fit_thread  # local read: another thread may None it
+        if pre is not None and pre.is_alive() and not wait:
+            return  # cheap pre-check without the lock
+        with self._fit_lock:
+            thread = self._fit_thread
+            if thread is None:
+                return
+            if thread.is_alive() and not wait:
+                return
+            thread.join()
+            self._fit_thread = None
+            if self._pending:
+                tokens = np.stack([t for t, _ in self._pending])
+                raws = [r for _, r in self._pending]
+                self._pending = []
+                self._dispatch(tokens, raws)
+                self._count_device_lines(len(raws))
 
     def _dispatch(self, tokens: np.ndarray, msgs: List[Any]) -> None:
-        """Asynchronously score [n, S] tokens, padded to a compile bucket."""
+        """Asynchronously score [n, S] tokens, padded to a compile bucket.
+
+        Small batches (≤ ``host_score_max_batch``) score synchronously on the
+        CPU twin instead: on a remote/tunneled accelerator a lone message
+        would otherwise pay two ~70 ms transfer round-trips for ~µs of MXU
+        work. The host result enters the same in-flight queue (as a ready
+        numpy array) so ordering with accelerator batches is preserved."""
         self._ensure_scorer()
         n = len(tokens)
+        if (0 < n <= self.config.host_score_max_batch
+                and self._host_params is not None):
+            bucket = _bucket(n, self.config.host_score_max_batch)
+            chunk = tokens
+            if n < bucket:  # power-of-two buckets: few compiled host shapes
+                chunk = np.concatenate(
+                    [tokens, np.zeros((bucket - n, tokens.shape[1]), np.int32)])
+            scores = np.asarray(self._score_host(chunk))[:n]
+            self._inflight.append((scores, list(msgs), n))
+            return
         bucket = _bucket(n, self.config.max_batch)
         for start in range(0, n, bucket):
             chunk = tokens[start:start + bucket]
@@ -499,6 +634,13 @@ class JaxScorerDetector(CoreDetector):
             except AttributeError:
                 pass
             self._inflight.append((scores, msgs[start:start + real], real))
+
+    def _score_host(self, tokens: np.ndarray):
+        """Score a small batch on the CPU backend with the mirrored params."""
+        if self._norm_mu is not None:
+            return self._host_normscore(self._host_params, tokens,
+                                        self._norm_mu, self._norm_sigma)
+        return self._host_score(self._host_params, tokens)
 
     def _drain_one(self) -> List[Optional[bytes]]:
         scores_dev, raws, real = self._inflight.popleft()
@@ -621,6 +763,11 @@ class JaxScorerDetector(CoreDetector):
         self._trained = int(meta.get("trained", 0))
         self._fitted = bool(meta.get("fitted", False))
         mu, sigma = meta.get("norm_mu"), meta.get("norm_sigma")
+        # norm-mode mismatch: the checkpointed threshold is in the units the
+        # checkpoint was calibrated under (z-scores with norm stats, raw NLL
+        # without); applying it across a mode change silently mis-calibrates
+        # detection, so it is discarded (fail open) unless config overrides
+        norm_mismatch = (mu is not None) != (self.config.score_norm == "position")
         if self.config.score_norm == "position":
             self._norm_mu = None if mu is None else np.asarray(mu, np.float32)
             self._norm_sigma = (None if sigma is None
@@ -634,7 +781,17 @@ class JaxScorerDetector(CoreDetector):
             self._threshold = self.config.score_threshold
         else:
             thr = meta.get("threshold")
-            if thr is not None:
+            if thr is not None and norm_mismatch:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "checkpoint norm calibration (%s) does not match config "
+                    "score_norm=%r: discarding the checkpointed threshold "
+                    "(alerts disabled until reconfigured or refitted)",
+                    "present" if mu is not None else "absent",
+                    self.config.score_norm)
+                self._threshold = float("inf")
+            elif thr is not None:
                 self._threshold = float(thr)
             elif self._fitted:
                 self._threshold = float("inf")
@@ -642,3 +799,4 @@ class JaxScorerDetector(CoreDetector):
                 # unfitted checkpoint: drop any stale in-memory calibration so
                 # the next fit() recalibrates for the restored run
                 self._threshold = None
+        self._sync_host_params()

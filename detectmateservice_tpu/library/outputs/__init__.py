@@ -1,0 +1,5 @@
+"""Output/aggregation components (reference fluentout role,
+container/fluentout/fluent.conf:1-24)."""
+from .file_sink import OutputWriter, OutputWriterConfig
+
+__all__ = ["OutputWriter", "OutputWriterConfig"]

@@ -119,7 +119,10 @@ class ShardedScorer:
         dp = self.data_parallelism
         padded = ((n + dp - 1) // dp) * dp
         if padded != n:
-            tokens = np.concatenate([tokens, tokens[: padded - n]])
+            # modular repetition handles n < padded - n too (e.g. a 3-row
+            # final batch on a data=8 mesh); a plain slice would come up
+            # short and crash the sharded device_put
+            tokens = tokens[np.arange(padded) % n]
         tokens = jax.device_put(tokens, self._batch_sharding)
         self.params, self.opt_state, loss = self._train(
             self.params, self.opt_state, rng, tokens

@@ -11,12 +11,44 @@ _cache_enabled = False
 _cache_lock = threading.Lock()
 
 
+def _machine_fingerprint() -> str:
+    """Stable id for (host µarch, jax version): XLA:CPU AOT artifacts are
+    machine-specific, and a cache shared across heterogeneous hosts loads
+    executables compiled for the wrong CPU features ("could lead to
+    execution errors such as SIGILL" — observed in CI). Keying the cache dir
+    by this fingerprint makes cross-machine reuse structurally impossible."""
+    import hashlib
+    import platform as plt
+
+    parts = [plt.machine(), plt.system()]
+    try:
+        import jax
+
+        parts.append(jax.__version__)
+    except Exception:
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
 def enable_compilation_cache(path: str = "") -> None:
     """Enable JAX's persistent compilation cache (idempotent).
 
     Service restarts then skip the multi-second XLA compiles for every
     already-seen (kernel, bucket) shape — the largest component of a scorer
-    service's cold-start time. Failures are non-fatal (read-only FS etc.)."""
+    service's cold-start time. Failures are non-fatal (read-only FS etc.).
+
+    ``DETECTMATE_JAX_CACHE`` controls it: unset = on under
+    ``~/.cache/detectmate/jax/<machine-fingerprint>``; a path = on there
+    (also fingerprint-suffixed); ``0``/``off``/``none``/``disabled`` = off
+    (e.g. deterministic CI timing runs)."""
     global _cache_enabled
     with _cache_lock:
         if _cache_enabled:
@@ -25,12 +57,23 @@ def enable_compilation_cache(path: str = "") -> None:
 
         import jax
 
-        cache_dir = (path or os.environ.get("DETECTMATE_JAX_CACHE")
-                     or os.path.expanduser("~/.cache/detectmate/jax"))
+        base = path or os.environ.get("DETECTMATE_JAX_CACHE") or ""
+        if base.strip().lower() in ("0", "off", "none", "disabled", "false"):
+            _cache_enabled = True  # explicitly off: don't retry every call
+            return
+        if not base:
+            base = os.path.expanduser("~/.cache/detectmate/jax")
+        cache_dir = os.path.join(base, _machine_fingerprint())
         try:
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            # keep the cache at the jax/StableHLO level only: XLA:CPU's AOT
+            # artifacts embed compile-machine tuning flags and the loader
+            # distrusts them on any feature drift ("could lead to SIGILL"
+            # cpu_aot_loader warnings observed in CI), so persisting them is
+            # a portability hazard with no TPU upside
+            jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
             _cache_enabled = True
         except Exception:
             pass

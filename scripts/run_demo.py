@@ -1,13 +1,15 @@
 #!/usr/bin/env python
-"""End-to-end demo: reader -> parser -> detector -> alert sink, as separate
-service processes over ipc sockets.
+"""End-to-end demo: reader -> parser -> detector -> output writer -> sink,
+as separate service processes over ipc sockets.
 
 Role of the reference's ``scripts/run_demo_scenario.sh`` walkthrough
 (reference: scripts/run_demo_scenario.sh, scripts/walkthrough.md), Docker-free:
 each stage is a ``detectmate`` service process launched from the example
 configs in ``examples/``; the demo feeds a synthetic audit log (no fixture
-copied from the reference), collects alerts from the final socket, and prints
-a summary with throughput and the admin-plane metrics.
+copied from the reference), collects the aggregated OutputSchema records from
+the final socket (the output stage also writes them to a dated file, the
+reference fluentout role), and prints a summary with throughput and the
+admin-plane metrics.
 
 Usage:
     python scripts/run_demo.py                  # NewValueDetector pipeline
@@ -28,7 +30,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 DEMO_DIR = Path("/tmp/detectmate-demo")
-PARSER_PORT, DETECTOR_PORT = 18111, 18112
+PARSER_PORT, DETECTOR_PORT, OUTPUT_PORT = 18111, 18112, 18113
 
 sys.path.insert(0, str(REPO))
 
@@ -79,7 +81,7 @@ def main() -> int:
     from detectmateservice_tpu.engine.socket import (
         TransportTimeout, ZmqPairSocketFactory,
     )
-    from detectmateservice_tpu.schemas import DetectorSchema, LogSchema
+    from detectmateservice_tpu.schemas import LogSchema, OutputSchema
 
     sys.path.insert(0, str(REPO / "examples"))
     from gen_audit_log import generate
@@ -90,6 +92,7 @@ def main() -> int:
 
     for name in ("parser_settings.yaml", "parser_config.yaml",
                  "detector_config.yaml", "scorer_config.yaml",
+                 "output_settings.yaml", "output_config.yaml",
                  "audit_templates.txt"):
         shutil.copy(REPO / "examples" / name, DEMO_DIR / name)
     detector_settings = ("detector_settings.yaml" if args.detector == "newvalue"
@@ -106,8 +109,9 @@ def main() -> int:
     try:
         procs.append(launch(DEMO_DIR / "parser_settings.yaml", DEMO_DIR / "parser.out"))
         procs.append(launch(DEMO_DIR / detector_settings, DEMO_DIR / "detector.out"))
-        # alert sink listens where the detector dials
-        sink = factory.create("ipc:///tmp/detectmate-demo/output.ipc")
+        procs.append(launch(DEMO_DIR / "output_settings.yaml", DEMO_DIR / "output.out"))
+        # final sink listens where the output stage dials (OutputSchema records)
+        sink = factory.create("ipc:///tmp/detectmate-demo/final.ipc")
         sink.recv_timeout = 200
         alerts = []
         stop_sink = threading.Event()
@@ -115,7 +119,7 @@ def main() -> int:
         def drain():
             while not stop_sink.is_set():
                 try:
-                    alerts.append(DetectorSchema.from_bytes(sink.recv()))
+                    alerts.append(OutputSchema.from_bytes(sink.recv()))
                 except TransportTimeout:
                     continue
                 except Exception:
@@ -126,7 +130,8 @@ def main() -> int:
 
         wait_running(PARSER_PORT)
         wait_running(DETECTOR_PORT)
-        print("[demo] both services running; feeding...")
+        wait_running(OUTPUT_PORT)
+        print("[demo] all three services running; feeding...")
 
         ingress = factory.create_output("ipc:///tmp/detectmate-demo/parser.ipc")
         t0 = time.perf_counter()
@@ -156,17 +161,22 @@ def main() -> int:
         print(f"[demo] fed {args.n} lines in {feed_s:.2f}s "
               f"({args.n / feed_s:,.0f} lines/s ingress)")
         print(f"[demo] pipeline settled after {elapsed:.2f}s; "
-              f"alerts: {len(alerts)} (expected ~{expected_anomalies})")
-        for alert in alerts[:5]:
-            print(f"  alert logIDs={list(alert.logIDs)} "
-                  f"obtain={dict(alert.alertsObtain)}")
+              f"output records: {len(alerts)} (expected ~{expected_anomalies})")
+        for record in alerts[:5]:
+            print(f"  record detectorIDs={list(record.detectorIDs)} "
+                  f"logIDs={list(record.logIDs)} obtain={dict(record.alertsObtain)}")
         if len(alerts) > 5:
             print(f"  ... and {len(alerts) - 5} more")
-        ok = len(alerts) > 0
+        # the output stage also writes the dated file (fluentout role)
+        dated = DEMO_DIR / "out" / time.strftime("output.%Y%m%d")
+        n_lines = (len(dated.read_text().strip().splitlines())
+                   if dated.exists() else 0)
+        print(f"[demo] dated sink file {dated}: {n_lines} records")
+        ok = len(alerts) > 0 and n_lines > 0
         print("[demo] RESULT:", "OK" if ok else "NO ALERTS (unexpected)")
         return 0 if ok else 1
     finally:
-        for port in (PARSER_PORT, DETECTOR_PORT):
+        for port in (PARSER_PORT, DETECTOR_PORT, OUTPUT_PORT):
             try:
                 admin(port, "shutdown")
             except Exception:

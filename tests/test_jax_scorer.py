@@ -27,6 +27,12 @@ def normal_msgs(n, salt=""):
                 log_id=str(i)) for i in range(n)]
 
 
+def _tokens_for(det, raw_msgs):
+    tokens, ok = det._featurize_raw_batch(raw_msgs)
+    assert ok.all()
+    return tokens, raw_msgs
+
+
 @pytest.fixture()
 def trained_detector():
     det = JaxScorerDetector(config=scorer_config())
@@ -73,15 +79,37 @@ class TestDetection:
         assert list(alert.logIDs) == ["66"]
         assert alert.score > 0
 
-    def test_pipelining_defers_then_flush_drains(self, trained_detector):
+    def test_small_batch_host_path_returns_immediately(self, trained_detector):
+        # batches ≤ host_score_max_batch score on the CPU twin and come back
+        # in the same call — the sparse-traffic latency contract
+        assert trained_detector._host_params is not None
         weird = [msg("segfault <*> exploit <*>", ["0xdead", "shellcode"])] * 4
         immediate = trained_detector.process_batch(weird)
-        # with pipeline_depth=2 the first batch's results are deferred
-        assert immediate == []
-        assert len(trained_detector._inflight) == 1
-        drained = trained_detector.flush()
         assert len(trained_detector._inflight) == 0
+        assert any(o is not None for o in immediate)
+
+    def test_pipelining_defers_then_flush_drains(self):
+        # with the host path off, results pipeline (deferred up to
+        # pipeline_depth batches) and flush() drains them
+        det = JaxScorerDetector(config=scorer_config(host_score_max_batch=0,
+                                                     async_fit=False))
+        det.process_batch(normal_msgs(32))
+        det.flush_final()
+        weird = [msg("segfault <*> exploit <*>", ["0xdead", "shellcode"])] * 4
+        det._dispatch(*_tokens_for(det, weird))
+        assert len(det._inflight) == 1
+        drained = det.flush()
+        assert len(det._inflight) == 0
         assert any(o is not None for o in drained)
+
+    def test_host_and_device_paths_agree(self, trained_detector):
+        # the CPU twin must reproduce the accelerator scores (same math,
+        # modulo backend float differences)
+        weird = [msg("segfault <*> exploit <*>", ["0xdead", "shellcode"])] * 4
+        tokens, _ = trained_detector._featurize_raw_batch(weird)
+        host = np.asarray(trained_detector._score_host(tokens))
+        dev = trained_detector.score_tokens(tokens)
+        np.testing.assert_allclose(host, dev, rtol=1e-3, atol=1e-3)
 
     def test_garbage_bytes_ignored(self, trained_detector):
         out = trained_detector.process_batch([b"\xff\xfe\x01garbage"])

@@ -1,0 +1,169 @@
+"""OutputWriter (fluentout role): DetectorSchema → OutputSchema aggregation,
+dated file sink, window flush, engine integration.
+
+Reference behavior being mirrored: container/fluentout/fluent.conf:1-24
+(nng_in + protobuf parse → output.%Y%m%d files) with OutputSchema field
+semantics from container/fluentout/schemas_pb.rb:8.
+"""
+import json
+import time
+
+import pytest
+
+from detectmateservice_tpu.library.outputs import OutputWriter
+from detectmateservice_tpu.schemas import DetectorSchema, OutputSchema
+
+
+def alert(i, log_ids=("1",), obtain=None):
+    return DetectorSchema(
+        detectorID=f"det{i}", detectorType="new_value_detector",
+        alertID=f"a{i}", logIDs=list(log_ids), extractedTimestamps=[100 + i],
+        description=f"alert {i}", alertsObtain=obtain or {f"k{i}": f"v{i}"},
+        detectionTimestamp=1_700_000_000,
+    ).serialize()
+
+
+def writer(tmp_path, **overrides):
+    cfg = {"method_type": "output_writer", "auto_config": False,
+           "output_dir": str(tmp_path), "aggregate_count": 1}
+    cfg.update(overrides)
+    return OutputWriter(config={"outputs": {"OutputWriter": cfg}})
+
+
+class TestAggregation:
+    def test_one_alert_one_record(self, tmp_path):
+        w = writer(tmp_path)
+        out = w.process(alert(1, log_ids=("7", "8")))
+        assert out is not None
+        record = OutputSchema.from_bytes(out)
+        assert list(record.detectorIDs) == ["det1"]
+        assert list(record.detectorTypes) == ["new_value_detector"]
+        assert list(record.alertIDs) == ["a1"]
+        assert list(record.logIDs) == ["7", "8"]
+        assert list(record.extractedTimestamps) == [101]
+        assert record.description == "alert 1"
+        assert dict(record.alertsObtain) == {"k1": "v1"}
+        assert record.outputTimestamp >= 1_700_000_000
+
+    def test_group_of_three_concatenates(self, tmp_path):
+        w = writer(tmp_path, aggregate_count=3)
+        assert w.process(alert(1)) is None
+        assert w.process(alert(2)) is None
+        out = w.process(alert(3))
+        assert out is not None
+        record = OutputSchema.from_bytes(out)
+        assert list(record.detectorIDs) == ["det1", "det2", "det3"]
+        assert list(record.alertIDs) == ["a1", "a2", "a3"]
+        assert record.description == "alert 1; alert 2; alert 3"
+        assert dict(record.alertsObtain) == {"k1": "v1", "k2": "v2", "k3": "v3"}
+
+    def test_window_expiry_flushes_partial_group(self, tmp_path):
+        w = writer(tmp_path, aggregate_count=100, aggregate_window_ms=20)
+        assert w.process(alert(1)) is None
+        assert w.flush() == []  # window not expired yet
+        time.sleep(0.03)
+        flushed = w.flush()
+        assert len(flushed) == 1 and flushed[0] is not None
+        assert list(OutputSchema.from_bytes(flushed[0]).alertIDs) == ["a1"]
+
+    def test_flush_final_emits_remainder(self, tmp_path):
+        w = writer(tmp_path, aggregate_count=100)
+        w.process(alert(1))
+        out = w.flush_final()
+        assert len(out) == 1
+        assert list(OutputSchema.from_bytes(out[0]).alertIDs) == ["a1"]
+
+    def test_corrupt_frame_filtered(self, tmp_path):
+        w = writer(tmp_path)
+        # protobuf happily parses many byte strings; use a definitely-bad tag
+        assert w.process(b"\xff\xff\xff\xff") is None
+        assert w.records_written == 0
+
+
+class TestFileSink:
+    def test_dated_file_json_lines_roundtrip(self, tmp_path):
+        w = writer(tmp_path)
+        w.process(alert(1))
+        w.process(alert(2))
+        w.flush_final()
+        path = tmp_path / time.strftime("output.%Y%m%d")
+        assert path.exists()
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 2
+        rec = json.loads(lines[0])
+        assert rec["detectorIDs"] == ["det1"]
+        assert rec["alertIDs"] == ["a1"]
+
+    def test_write_files_off(self, tmp_path):
+        w = writer(tmp_path, write_files=False)
+        assert w.process(alert(1)) is not None
+        assert not list(tmp_path.iterdir())
+
+    def test_emit_records_off_still_writes(self, tmp_path):
+        w = writer(tmp_path, emit_records=False)
+        assert w.process(alert(1)) is None
+        assert (tmp_path / time.strftime("output.%Y%m%d")).exists()
+
+
+class TestServiceIntegration:
+    def test_engine_pipeline_detector_to_output(self, tmp_path, inproc_factory):
+        """Alerts sent through a real Engine running an OutputWriter come out
+        as OutputSchema records AND land in the dated file."""
+        from detectmateservice_tpu.engine.engine import Engine
+        from detectmateservice_tpu.engine.socket import TransportTimeout
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        settings = ServiceSettings(
+            component_type="outputs.file_sink.OutputWriter",
+            engine_addr="inproc://outstage-in",
+            out_addr=["inproc://outstage-final"],
+        )
+        w = writer(tmp_path)
+        engine = Engine(settings, processor=w, socket_factory=inproc_factory)
+        final = inproc_factory.create("inproc://outstage-final")
+        final.recv_timeout = 2000
+        sender = inproc_factory.create_output("inproc://outstage-in")
+        engine.start()
+        try:
+            sender.send(alert(1))
+            record = OutputSchema.from_bytes(final.recv())
+            assert list(record.alertIDs) == ["a1"]
+        finally:
+            engine.stop()
+        assert (tmp_path / time.strftime("output.%Y%m%d")).exists()
+
+    def test_engine_idle_flush_emits_partial_group(self, tmp_path, inproc_factory):
+        """A partial aggregation group must reach downstream via the engine's
+        idle flush once its window expires — even though OutputWriter is a
+        single-message (non-batched) processor."""
+        from detectmateservice_tpu.engine.engine import Engine
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        settings = ServiceSettings(
+            component_type="outputs.file_sink.OutputWriter",
+            engine_addr="inproc://outstage-idle-in",
+            out_addr=["inproc://outstage-idle-final"],
+            engine_recv_timeout=20,
+        )
+        w = writer(tmp_path, aggregate_count=100, aggregate_window_ms=50)
+        engine = Engine(settings, processor=w, socket_factory=inproc_factory)
+        final = inproc_factory.create("inproc://outstage-idle-final")
+        final.recv_timeout = 3000
+        sender = inproc_factory.create_output("inproc://outstage-idle-in")
+        engine.start()
+        try:
+            sender.send(alert(1))  # group stays partial (1 < 100)
+            record = OutputSchema.from_bytes(final.recv())
+            assert list(record.alertIDs) == ["a1"]
+        finally:
+            engine.stop()
+
+    def test_resolver_finds_output_writer_by_short_name(self):
+        from detectmateservice_tpu.config.resolver import ComponentResolver
+
+        import importlib
+
+        path, cfg = ComponentResolver().resolve("OutputWriter")
+        module_path, cls_name = path.rsplit(".", 1)
+        assert getattr(importlib.import_module(module_path), cls_name) is OutputWriter
+        assert cfg.endswith("OutputWriterConfig")

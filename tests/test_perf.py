@@ -58,7 +58,11 @@ class TestFeaturizeThroughput:
 class TestDetectorThroughput:
     def test_scorer_batch_path_cpu(self):
         # full detector contract on CPU: decode -> featurize -> jit score ->
-        # filter; guards against recompile storms and per-message dispatch
+        # filter; guards against recompile storms and per-message dispatch.
+        # The primary assertion is DETERMINISTIC — zero new XLA compilations
+        # in the steady-state loop — because wall-clock floors flake on a
+        # loaded single-core CI box (observed in round 1); a loose best-of-3
+        # rate floor stays as the net for non-compile collapses.
         from detectmateservice_tpu.library.detectors import JaxScorerDetector
 
         batch = 2048
@@ -72,12 +76,30 @@ class TestDetectorThroughput:
         msgs = make_parsed(4 * batch)
         det.process_batch(msgs[:batch])  # warm the bench bucket
         det.flush()
-        t0 = time.perf_counter()
-        for start in range(0, len(msgs), batch):
-            det.process_batch(msgs[start:start + batch])
-        det.flush()
-        r = rate(len(msgs), time.perf_counter() - t0)
-        assert r > 10_000, f"CPU scorer path collapsed to {r:,.0f} lines/s"
+
+        def cache_sizes():
+            sizes = {}
+            for fn_name in ("_score", "_train", "_token_nlls", "_normscore"):
+                fn = getattr(det._scorer, fn_name, None)
+                cache_size = getattr(fn, "_cache_size", None)
+                if callable(cache_size):
+                    sizes[fn_name] = cache_size()
+            return sizes
+
+        warmed = cache_sizes()
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for start in range(0, len(msgs), batch):
+                det.process_batch(msgs[start:start + batch])
+            det.flush()
+            best = max(best, rate(len(msgs), time.perf_counter() - t0))
+        assert cache_sizes() == warmed, (
+            f"steady-state loop recompiled: {warmed} -> {cache_sizes()}")
+        # floor sits below single-core capacity for this model size (~2k
+        # lines/s measured on a loaded 1-core CI box): it nets only order-of-
+        # magnitude collapses; recompiles are caught exactly, above
+        assert best > 500, f"CPU scorer path collapsed to {best:,.0f} lines/s"
 
 
 class TestTemplateMatchThroughput:
