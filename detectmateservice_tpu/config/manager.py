@@ -84,6 +84,12 @@ class ConfigManager:
             self._config = self._validate(data)
             return dict(self._config)
 
+    def validate(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        """Validate WITHOUT mutating state (pre-flight for a live component
+        applying the change before the manager commits it)."""
+        with self._lock:
+            return dict(self._validate(data))
+
     def save(self, data: Optional[Dict[str, Any]] = None) -> None:
         """Persist config to disk, stripping defaults where the object can."""
         with self._lock:

@@ -250,20 +250,25 @@ class Service:
             raise ServiceError("no config manager: service was started without config_file")
         if not config_data:
             return self.config_manager.get()
-        updated = self.config_manager.update(config_data)
-        if persist:
-            self.config_manager.save()
+        # the COMPONENT validates/applies first: a vetoed or failed change
+        # must neither reach the manager nor be persisted — otherwise /status
+        # and the on-disk YAML report a config the running instance refused,
+        # and the next restart silently builds something different
         hook = getattr(self.library_component, "reconfigure", None)
         if callable(hook):
             try:
-                hook(updated)
+                hook(self.config_manager.validate(config_data))
                 self.logger.info("component reconfigured in place")
             except Exception as exc:
-                self.logger.error("component reconfigure hook failed: %s", exc)
+                self.logger.error("component reconfigure rejected: %s", exc)
+                raise ServiceError(f"component rejected reconfigure: {exc}") from exc
         else:
             self.logger.warning(
                 "component has no reconfigure hook; running instance keeps its old config"
             )
+        updated = self.config_manager.update(config_data)
+        if persist:
+            self.config_manager.save()
         return updated
 
     # -- context manager (reference: core.py:424-436) -------------------

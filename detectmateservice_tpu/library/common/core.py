@@ -168,3 +168,27 @@ class CoreComponent:
 
     def teardown(self) -> None:
         """Hook for releasing resources."""
+
+    def reconfigure(self, config: Dict[str, Any]) -> None:
+        """Apply a new (already manager-validated) config document to the
+        RUNNING instance — the capability the reference admits it lacks
+        (reference: core.py:299-345 updates only the ConfigManager; the
+        loaded component keeps its old config). The document is re-parsed
+        through the component's own config class, swapped in atomically,
+        then ``apply_config`` lets subclasses rebuild derived state."""
+        new_config = self.config_class.from_dict(config, self.name)
+        self.validate_reconfigure(new_config)
+        old_config = self.config
+        self.config = new_config
+        try:
+            self.apply_config()
+        except Exception:
+            self.config = old_config  # failed apply must not leave the
+            raise                     # instance half-configured
+
+    def validate_reconfigure(self, new_config: "CoreConfig") -> None:
+        """Hook: veto a runtime config change (raise LibraryError) before it
+        is applied — e.g. a change that would require a full refit."""
+
+    def apply_config(self) -> None:
+        """Hook: react to a swapped-in config (rebuild derived state)."""

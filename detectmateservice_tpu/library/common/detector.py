@@ -79,6 +79,7 @@ class InstanceConfig(BaseModel):
 class CoreDetectorConfig(CoreConfig):
     method_type: str = "core_detector"
     data_use_training: int = 0
+    buffer_size: int = 32  # FIXED mode: messages per detection window
     events: Dict[Union[int, str], Dict[str, InstanceConfig]] = Field(default_factory=dict)
     global_: Dict[str, InstanceConfig] = Field(default_factory=dict, alias="global")
 
@@ -110,9 +111,22 @@ class CoreDetector(CoreComponent):
         super().__init__(name=name, config=config)
         self.config: CoreDetectorConfig
         self.buffer_mode = buffer_mode
-        self._buffer = DataBuffer() if buffer_mode == BufferMode.FIXED else None
+        self._buffer = (DataBuffer(int(getattr(self.config, "buffer_size", 32)))
+                        if buffer_mode == BufferMode.FIXED else None)
         self._trained = 0
         self._alert_ids = itertools.count(int(getattr(self.config, "start_id", 0)))
+
+    def apply_config(self) -> None:
+        """Runtime reconfigure: a changed ``buffer_size`` rebuilds the FIXED
+        window in place (newest buffered messages carry over; anything beyond
+        the new size is dropped oldest-first, matching deque semantics)."""
+        if self._buffer is not None:
+            new_size = max(1, int(getattr(self.config, "buffer_size", 32)))
+            if new_size != self._buffer._size:
+                old_items = self._buffer.flush()
+                self._buffer = DataBuffer(new_size)
+                for item in old_items[-(new_size - 1):] if new_size > 1 else []:
+                    self._buffer.push(item)
 
     # -- overridables ---------------------------------------------------
     def train(self, input_: Union[ParserSchema, List[ParserSchema]]) -> None:
@@ -135,10 +149,44 @@ class CoreDetector(CoreComponent):
             self.train(input_)
             self._trained += 1
             return None
+        if self._buffer is not None:  # FIXED: windowed detection
+            window = self._buffer.push(input_)
+            if window is None:
+                return None
+            return self._detect_over_window(window)
         output_ = self.make_output(input_)
         if self.detect(input_, output_):
             return output_.serialize()
         return None
+
+    # -- FIXED (windowed) mode ------------------------------------------
+    def _detect_over_window(self, window: List[ParserSchema]) -> Optional[bytes]:
+        """One alert per window: the skeleton comes from the newest message,
+        ``logIDs``/``extractedTimestamps`` cover the whole window."""
+        output_ = self.make_output(window[-1])
+        output_["logIDs"] = [m["logID"] for m in window if m.get("logID")]
+        stamps = [self.extract_timestamp(m) for m in window]
+        output_["extractedTimestamps"] = [s for s in stamps if s is not None]
+        if self.detect_window(window, output_):
+            return output_.serialize()
+        return None
+
+    def detect_window(self, window: List[ParserSchema],
+                      output_: DetectorSchema) -> bool:
+        """FIXED-mode hook: detect over a full window. The default ORs the
+        per-message ``detect`` so any detector works windowed; contextual
+        detectors override this for cross-message logic."""
+        hit = False
+        for input_ in window:
+            hit = self.detect(input_, output_) or hit
+        return hit
+
+    def flush_final(self) -> List[Optional[bytes]]:
+        """Stop-time drain: a partial FIXED window still gets detected so no
+        buffered message is silently lost at shutdown."""
+        if self._buffer is not None and len(self._buffer):
+            return [self._detect_over_window(self._buffer.flush())]
+        return []
 
     def make_output(self, input_: ParserSchema) -> DetectorSchema:
         """Prefill a DetectorSchema alert skeleton (field semantics per the

@@ -103,6 +103,9 @@ class JaxScorerDetector(CoreDetector):
         self._rng = None
         self._device = None
         self._threshold: Optional[float] = self.config.score_threshold
+        # (mean, std) of the calibration scores, kept so a runtime
+        # threshold_sigma reconfigure can recompute the threshold refit-free
+        self._calib_stats: Optional[tuple] = None
         self._train_buffer: List[np.ndarray] = []
         self._fitted = False
         self._norm_mu: Optional[np.ndarray] = None     # [S] fp32, "position" norm
@@ -119,6 +122,8 @@ class JaxScorerDetector(CoreDetector):
         self._host_score = None
         self._host_normscore = None
         self._cpu_device = None
+        self._host_warm: set = set()                   # compiled host buckets
+        self._host_warm_thread = None
         self._ready_supported: Optional[bool] = None   # jax.Array.is_ready seen?
         self._metrics_labels = None
         # in-flight scored batches: (scores_device_array, parsed_msgs, n_real)
@@ -230,23 +235,48 @@ class JaxScorerDetector(CoreDetector):
         if self._cpu_device is None or self._params is None:
             return
         import jax
+        import threading
 
         try:
             self._host_params = jax.device_put(self._params, self._cpu_device)
-            # warm the host compile for EVERY power-of-two bucket up to the
-            # host cap so no first-occurrence small batch pays a synchronous
-            # XLA compile on the engine hot path (this runs in the background
-            # fit thread under async_fit; CPU compiles are ~100 ms each)
-            cap = self.config.host_score_max_batch
-            sizes, b = [cap], 1
-            while b < cap:
-                sizes.append(b)
-                b *= 2
-            for bucket in sorted({_bucket(s, cap) for s in sizes}):
-                jax.block_until_ready(self._score_host(
-                    np.zeros((bucket, self.config.seq_len), np.int32)))
         except Exception:
             self._host_params = None
+            return
+        # warm the lone-message bucket inline (it IS the sparse-traffic
+        # latency path), then the remaining power-of-two buckets on a
+        # background thread — until a bucket is warm its batches ride the
+        # device path, so the engine loop never blocks on a host compile
+        cap = self.config.host_score_max_batch
+        try:
+            jax.block_until_ready(self._score_host(
+                np.zeros((1, self.config.seq_len), np.int32)))
+            self._host_warm.add(1)
+        except Exception:
+            self._host_params = None
+            return
+
+        def _warm_rest():
+            sizes, b = [], 2
+            while b <= cap:
+                sizes.append(b)
+                b *= 2
+            if cap not in sizes:  # non-power-of-two cap is its own bucket
+                sizes.append(cap)
+            for size in sizes:
+                try:
+                    jax.block_until_ready(self._score_host(
+                        np.zeros((size, self.config.seq_len), np.int32)))
+                    self._host_warm.add(size)
+                except Exception:
+                    return
+
+        # non-daemon on purpose: a daemon thread killed mid-XLA-compile at
+        # interpreter exit aborts the process from C++ ("FATAL: exception
+        # not rethrown"); the thread is short-lived (a handful of small CPU
+        # compiles), so joining at exit is cheap and clean
+        self._host_warm_thread = threading.Thread(
+            target=_warm_rest, daemon=False, name="HostBucketWarm")
+        self._host_warm_thread.start()
 
     def _put(self, array: np.ndarray):
         import jax
@@ -368,10 +398,11 @@ class JaxScorerDetector(CoreDetector):
             # calibrate BEFORE thresholding so the threshold is in z units;
             # the returned z-max scores reuse the same forward pass
             scores = self._calibrate_position_norm(calib, bs)
+            self._calib_stats = (float(scores.mean()), float(scores.std()))
             if self._threshold is None:
                 self._threshold = float(
                     scores.mean() + cfg.threshold_sigma * scores.std())
-        elif self._threshold is None:
+        else:
             bucket = _bucket(max(bs, cfg.train_batch_size), cfg.max_batch)
             parts = []
             for i in range(0, len(calib), bucket):
@@ -382,7 +413,10 @@ class JaxScorerDetector(CoreDetector):
                         (bucket - real,) + chunk.shape[1:], chunk.dtype)])
                 parts.append(np.asarray(self._score_dev(chunk))[:real])
             scores = np.concatenate(parts)[: len(calib)]
-            self._threshold = float(scores.mean() + cfg.threshold_sigma * scores.std())
+            self._calib_stats = (float(scores.mean()), float(scores.std()))
+            if self._threshold is None:
+                self._threshold = float(
+                    scores.mean() + cfg.threshold_sigma * scores.std())
         self._fitted = True
         self._sync_host_params()
         return {"loss": loss, "threshold": self._threshold}
@@ -610,16 +644,23 @@ class JaxScorerDetector(CoreDetector):
         numpy array) so ordering with accelerator batches is preserved."""
         self._ensure_scorer()
         n = len(tokens)
-        if (0 < n <= self.config.host_score_max_batch
-                and self._host_params is not None):
-            bucket = _bucket(n, self.config.host_score_max_batch)
-            chunk = tokens
-            if n < bucket:  # power-of-two buckets: few compiled host shapes
-                chunk = np.concatenate(
-                    [tokens, np.zeros((bucket - n, tokens.shape[1]), np.int32)])
-            scores = np.asarray(self._score_host(chunk))[:n]
-            self._inflight.append((scores, list(msgs), n))
-            return
+        cap = self.config.host_score_max_batch
+        if 0 < n <= cap and self._host_params is not None:
+            # power-of-two host buckets keep the padding compute proportional
+            # to the batch (padding everything to the cap costs ~60 ms for
+            # 128 rows on a small CPU — measured, it broke the p50 target);
+            # buckets compile in a background warm thread, and a batch whose
+            # bucket is not warm yet rides the device path instead of
+            # stalling the engine loop on a synchronous XLA compile
+            bucket = _bucket(n, cap)
+            if bucket in self._host_warm:
+                chunk = tokens
+                if n < bucket:
+                    chunk = np.concatenate(
+                        [tokens, np.zeros((bucket - n, tokens.shape[1]), np.int32)])
+                scores = np.asarray(self._score_host(chunk))[:n]
+                self._inflight.append((scores, list(msgs), n))
+                return
         bucket = _bucket(n, self.config.max_batch)
         for start in range(0, n, bucket):
             chunk = tokens[start:start + bucket]
@@ -672,8 +713,12 @@ class JaxScorerDetector(CoreDetector):
 
     def flush_final(self) -> List[Optional[bytes]]:
         """Stop-time drain: waits for a running boundary fit so its pending
-        backlog is scored and emitted before sockets close."""
+        backlog is scored and emitted before sockets close (and for the host
+        bucket warmer, so post-restore usage sees a deterministic state)."""
         self._finish_fit(wait=True)
+        warm = self._host_warm_thread
+        if warm is not None and warm.is_alive():
+            warm.join()
         return self.flush()
 
     def _make_alert_pb(self, msg, score: float) -> bytes:
@@ -719,12 +764,52 @@ class JaxScorerDetector(CoreDetector):
         m.DEVICE_LINES().labels(**self._metrics_labels).inc(n)
         m.DEVICE_BATCHES().labels(**self._metrics_labels).inc()
 
+    # -- runtime reconfigure (POST /admin/reconfigure end-to-end) --------
+    def validate_reconfigure(self, new_config) -> None:
+        """Veto changes that would require rebuilding the compiled model or
+        re-calibrating in different units — those need a restart/refit, and
+        silently accepting them would mis-calibrate detection."""
+        frozen = ("model", "vocab_size", "seq_len", "dim", "depth", "heads",
+                  "score_topk", "score_norm", "mesh_shape")
+        for field in frozen:
+            if getattr(new_config, field) != getattr(self.config, field):
+                raise LibraryError(
+                    f"{field!r} cannot change at runtime (old="
+                    f"{getattr(self.config, field)!r} new="
+                    f"{getattr(new_config, field)!r}); restart the service")
+
+    def apply_config(self) -> None:
+        """React to a live config swap: threshold semantics re-derive
+        immediately (explicit score_threshold wins; a new threshold_sigma
+        recomputes from the stored calibration stats; pre-fit, a withdrawn
+        override clears so the upcoming fit calibrates instead of keeping
+        the stale value forever)."""
+        super().apply_config()
+        if self.config.score_threshold is not None:
+            self._threshold = float(self.config.score_threshold)
+        elif self._calib_stats is not None:
+            mean, std = self._calib_stats
+            self._threshold = float(mean + self.config.threshold_sigma * std)
+        elif not self._fitted:
+            self._threshold = None  # the upcoming fit calibrates fresh
+        else:
+            # fitted but no stored calibration (e.g. a pre-calib-stats
+            # checkpoint): nothing to recompute from — keep the live value
+            # and say so rather than silently honoring half the request
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "reconfigure: no stored calibration stats; threshold stays %r",
+                self._threshold)
+
     # -- state checkpointing (orbax; closes SURVEY §5.4) -----------------
     def state_dict(self) -> Dict[str, Any]:
         return {
             "trained": self._trained,
             "threshold": self._threshold,
             "fitted": self._fitted,
+            "calib_stats": (None if self._calib_stats is None
+                            else list(self._calib_stats)),
             "norm_mu": None if self._norm_mu is None else self._norm_mu.tolist(),
             "norm_sigma": (None if self._norm_sigma is None
                            else self._norm_sigma.tolist()),
@@ -762,6 +847,9 @@ class JaxScorerDetector(CoreDetector):
             self._params, self._opt_state = params, opt_state
         self._trained = int(meta.get("trained", 0))
         self._fitted = bool(meta.get("fitted", False))
+        stats = meta.get("calib_stats")
+        self._calib_stats = None if stats is None else (float(stats[0]),
+                                                        float(stats[1]))
         mu, sigma = meta.get("norm_mu"), meta.get("norm_sigma")
         # norm-mode mismatch: the checkpointed threshold is in the units the
         # checkpoint was calibrated under (z-scores with norm stats, raw NLL

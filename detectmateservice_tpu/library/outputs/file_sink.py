@@ -79,6 +79,11 @@ class OutputWriter(CoreComponent):
         self.teardown()
         return out
 
+    def apply_config(self) -> None:
+        """Runtime reconfigure: close the open sink so the next record
+        reopens under the (possibly new) output_dir/file_pattern."""
+        self.teardown()
+
     def teardown(self) -> None:
         if self._sink is not None:
             try:

@@ -80,32 +80,45 @@ class MatcherParser(CoreComponent):
     def __init__(self, name: Optional[str] = None, config: Any = None) -> None:
         super().__init__(name=name, config=config)
         self.config: MatcherParserConfig
-        self._format_re: Optional[Pattern] = None
-        self._format_names: List[str] = []
+        self.apply_config()
+
+    def apply_config(self) -> None:
+        """(Re)build all config-derived state — also the runtime-reconfigure
+        hook, so ``POST /admin/reconfigure`` can swap log_format or the
+        template file on a live parser. Everything is built into locals and
+        swapped in atomically at the end: a failure (bad log_format, missing
+        templates file) raises BEFORE any live state changes, so the running
+        parser keeps working on its old config instead of being bricked
+        half-updated."""
+        format_re: Optional[Pattern] = None
+        format_names: List[str] = []
         if self.config.log_format:
-            self._format_re, self._format_names = compile_log_format(self.config.log_format)
-        self._templates: List[str] = []
-        self._template_res: List[Pattern] = []
+            format_re, format_names = compile_log_format(self.config.log_format)
+        templates: List[str] = []
+        template_res: List[Pattern] = []
         if self.config.path_templates:
-            self._load_templates(self.config.path_templates)
-        self._native = None
+            templates, template_res = self._read_templates(self.config.path_templates)
+        native = None
         try:  # optional C++ matching kernel
             from ...utils import matchkern
 
-            if self._templates:
-                self._native = matchkern.TemplateMatcher(
-                    [self._normalize(t) for t in self._templates]
+            if templates:
+                native = matchkern.TemplateMatcher(
+                    [self._normalize(t) for t in templates]
                 )
         except Exception:
-            self._native = None
+            native = None
+        self._format_re, self._format_names = format_re, format_names
+        self._templates, self._template_res = templates, template_res
+        self._native = native
 
-    def _load_templates(self, path: str) -> None:
+    def _read_templates(self, path: str):
         try:
             text = Path(path).read_text(encoding="utf-8")
         except OSError as exc:
             raise LibraryError(f"{self.name}: cannot read templates file {path}: {exc}") from exc
-        self._templates = [line.rstrip("\n") for line in text.splitlines() if line.strip()]
-        self._template_res = [compile_template(self._normalize(t)) for t in self._templates]
+        templates = [line.rstrip("\n") for line in text.splitlines() if line.strip()]
+        return templates, [compile_template(self._normalize(t)) for t in templates]
 
     # ------------------------------------------------------------------
     def _normalize(self, text: str) -> str:

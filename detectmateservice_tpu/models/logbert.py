@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..ops.attention import dot_product_attention
+from ..ops.attention import attention
 from .tokenizer import MASK_ID, PAD_ID
 
 
@@ -42,6 +42,9 @@ class LogBERTConfig:
     # 0 = mean NLL over all observed tokens; k > 0 = mean of the k most
     # surprising tokens (sharper for single-field anomalies)
     score_topk: int = 0
+    # "auto" = pallas flash kernel on TPU for long sequences, fused einsum
+    # otherwise; "einsum" | "flash" | "blockwise" force a path
+    attn_impl: str = "auto"
 
 
 class Block(nn.Module):
@@ -56,8 +59,8 @@ class Block(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         b, s, _ = q.shape
         reshape = lambda t: t.reshape(b, s, cfg.heads, head_dim).transpose(0, 2, 1, 3)
-        attn_mask = pad_mask[:, None, None, :]  # [B,1,1,S]: keys at PAD are masked
-        out = dot_product_attention(reshape(q), reshape(k), reshape(v), attn_mask)
+        out = attention(reshape(q), reshape(k), reshape(v),
+                        key_mask=pad_mask, impl=cfg.attn_impl)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
         x = x + nn.Dense(cfg.dim, dtype=cfg.dtype, name="proj")(out)
         y = nn.LayerNorm(dtype=cfg.dtype)(x)

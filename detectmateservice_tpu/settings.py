@@ -42,6 +42,19 @@ ENV_NESTED_DELIMITER = "__"
 SUPPORTED_SCHEMES = ("ipc", "tcp", "tls+tcp", "ws", "inproc")
 
 
+def _ws_available() -> bool:
+    """ws:// rides libzmq's WebSocket transport, which is a compile-time
+    option many builds (including this image's) lack. Validation fails the
+    scheme up front when the capability is absent — the alternative is a
+    runtime "Protocol not supported" AFTER settings said everything was fine."""
+    try:
+        import zmq
+
+        return bool(zmq.has("ws"))
+    except Exception:
+        return False
+
+
 class SettingsError(Exception):
     """Raised for invalid service settings."""
 
@@ -58,6 +71,10 @@ def _validate_addr(addr: str) -> str:
     scheme, rest = addr.split("://", 1)
     if scheme not in SUPPORTED_SCHEMES:
         raise ValueError(f"unsupported scheme {scheme!r} in {addr!r}; expected one of {SUPPORTED_SCHEMES}")
+    if scheme == "ws" and not _ws_available():
+        raise ValueError(
+            f"{addr!r}: this libzmq build has no WebSocket transport "
+            "(zmq.has('ws') is false); use tcp:// or ipc:// instead")
     if not rest:
         raise ValueError(f"address {addr!r} has an empty target")
     if scheme in ("tcp", "tls+tcp", "ws"):

@@ -62,6 +62,18 @@ def enable_compilation_cache(path: str = "") -> None:
             _cache_enabled = True  # explicitly off: don't retry every call
             return
         if not base:
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = "unknown"
+            if backend == "cpu":
+                # XLA:CPU serializes machine-tuned AOT executables into every
+                # cache entry and its loader then distrusts them on any
+                # feature-flag drift (cpu_aot_loader "could lead to SIGILL"
+                # spew). CPU compiles here are small; persistence is off by
+                # default and opt-in via DETECTMATE_JAX_CACHE=<path>.
+                _cache_enabled = True
+                return
             base = os.path.expanduser("~/.cache/detectmate/jax")
         cache_dir = os.path.join(base, _machine_fingerprint())
         try:

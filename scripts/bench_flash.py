@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Benchmark the pallas flash-attention kernel against the einsum path.
+
+Run on TPU: ``python scripts/bench_flash.py``. Informs the FLASH_MIN_SEQ
+routing constant in ops/attention.py.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from detectmateservice_tpu.ops.attention import dot_product_attention
+    from detectmateservice_tpu.ops.flash import flash_attention
+
+    rng = np.random.default_rng(0)
+    print(f"device: {jax.devices()[0]}")
+    for (b, h, s, d) in [(8, 4, 128, 64), (8, 4, 512, 64), (4, 4, 1024, 64),
+                         (4, 4, 2048, 64), (2, 4, 4096, 64)]:
+        q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+        mask = jnp.asarray(rng.random((b, s)) > 0.1)
+
+        einsum_fn = jax.jit(lambda q, k, v, m:
+                            dot_product_attention(q, k, v, m[:, None, None, :]))
+        flash_fn = jax.jit(lambda q, k, v, m: flash_attention(q, k, v, m))
+
+        ref = jax.block_until_ready(einsum_fn(q, k, v, mask))
+        out = jax.block_until_ready(flash_fn(q, k, v, mask))
+        err = float(jnp.abs(ref.astype(jnp.float32)
+                            - out.astype(jnp.float32)).max())
+
+        def timeit(fn, n=20):
+            jax.block_until_ready(fn(q, k, v, mask))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = fn(q, k, v, mask)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / n * 1e3
+
+        te, tf = timeit(einsum_fn), timeit(flash_fn)
+        print(f"B{b} H{h} S{s} D{d}: einsum {te:7.3f} ms  flash {tf:7.3f} ms  "
+              f"speedup {te / tf:4.2f}x  max_err {err:.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,6 @@
 """In-tree component-library tests: parser, detectors, readers, doubles."""
+import json
+
 import pytest
 
 from detectmateservice_tpu.library.common.core import CoreConfig, LibraryError
@@ -224,6 +226,76 @@ class TestReaderAndFrom:
         kept = [i for i in items if i is not None]
         assert [i.log for i in kept] == ["alpha", "beta"]
         assert all(hasattr(i, "logID") for i in kept)
+
+
+class TestFixedBufferMode:
+    """BufferMode.FIXED: windowed detection (one alert per filled window,
+    logIDs cover the window; a partial window drains at stop)."""
+
+    def _nvd_fixed(self, window=3, training=2):
+        from detectmateservice_tpu.library.utils import BufferMode
+
+        cfg = nvd_config(training=training)
+        cfg["detectors"]["NewValueDetector"]["buffer_size"] = window
+        return NewValueDetector(config=cfg, buffer_mode=BufferMode.FIXED)
+
+    def test_window_fills_then_one_alert_with_all_log_ids(self):
+        det = self._nvd_fixed(window=3)
+        assert det.process(parsed("/a", "1")) is None  # training
+        assert det.process(parsed("/b", "2")) is None  # training
+        assert det.process(parsed("/a", "3")) is None  # window 1/3
+        assert det.process(parsed("/evil", "4")) is None  # window 2/3
+        out = det.process(parsed("/b", "5"))  # window full -> detect
+        alert = DetectorSchema.from_bytes(out)
+        assert list(alert.logIDs) == ["3", "4", "5"]
+        assert "'/evil'" in json.dumps(dict(alert.alertsObtain))
+
+    def test_clean_window_produces_no_output(self):
+        det = self._nvd_fixed(window=2)
+        det.process(parsed("/a", "1"))
+        det.process(parsed("/b", "2"))
+        assert det.process(parsed("/a", "3")) is None
+        assert det.process(parsed("/b", "4")) is None  # full, but all known
+
+    def test_flush_final_drains_partial_window(self):
+        det = self._nvd_fixed(window=8)
+        det.process(parsed("/a", "1"))
+        det.process(parsed("/b", "2"))
+        assert det.process(parsed("/evil", "9")) is None  # buffered (1/8)
+        out = [o for o in det.flush_final() if o is not None]
+        assert len(out) == 1
+        assert list(DetectorSchema.from_bytes(out[0]).logIDs) == ["9"]
+
+    def test_runtime_buffer_size_reconfigure_rebuilds_window(self):
+        det = self._nvd_fixed(window=8)
+        det.process(parsed("/a", "1"))
+        det.process(parsed("/b", "2"))
+        assert det.process(parsed("/evil", "3")) is None  # buffered 1/8
+        cfg = nvd_config(training=2)
+        cfg["detectors"]["NewValueDetector"]["buffer_size"] = 2
+        det.reconfigure(cfg)
+        # buffered message carried over; one more fills the NEW 2-window
+        out = det.process(parsed("/a", "4"))
+        assert out is not None
+        assert list(DetectorSchema.from_bytes(out).logIDs) == ["3", "4"]
+
+
+class TestReconfigureRollback:
+    def test_parser_keeps_old_state_when_new_config_is_broken(self, tmp_path):
+        good = tmp_path / "good.txt"
+        good.write_text("user <*> did <*>\n")
+        cfg = {"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "params": {"path_templates": str(good)}}}}
+        parser = MatcherParser(config=cfg)
+        assert parser.parse_line("user alice did ls", "1") is not None
+
+        bad = dict(cfg["parsers"]["MatcherParser"])
+        bad["params"] = {"path_templates": str(tmp_path / "missing.txt")}
+        with pytest.raises(LibraryError, match="templates file"):
+            parser.reconfigure({"parsers": {"MatcherParser": bad}})
+        # the failed reconfigure left the live parser fully functional
+        assert parser.parse_line("user bob did cat", "2") is not None
 
 
 class TestCoreDetectorContract:

@@ -1,0 +1,72 @@
+"""Observability artifacts stay in sync with the actual metric contract.
+
+The reference ships a provisioned Grafana dashboard + Prometheus scrape
+config (container/grafana/dashboards/detectmate.json, container/prometheus.yml);
+ops/ carries the process-based equivalents. These tests pin that every metric
+the dashboard queries actually exists in the exporter, so a metric rename
+breaks CI instead of silently blanking panels.
+"""
+import json
+import re
+from pathlib import Path
+
+import yaml
+
+OPS = Path(__file__).resolve().parent.parent / "ops"
+
+# every series name the exporter can emit (engine/metrics.py), plus the
+# suffixes prometheus_client derives for histograms/enums
+BASE_SERIES = {
+    "data_read_bytes_total", "data_read_lines_total",
+    "data_written_bytes_total", "data_written_lines_total",
+    "data_dropped_bytes_total", "data_dropped_lines_total",
+    "processing_errors_total", "engine_running", "engine_starts_total",
+    "processing_duration_seconds", "data_processed_bytes_total",
+    "data_processed_lines_total", "detector_device_batches_total",
+    "detector_device_lines_total", "detector_batch_size",
+}
+DERIVED = {f"{n}_bucket" for n in BASE_SERIES} | {
+    f"{n}_count" for n in BASE_SERIES} | {f"{n}_sum" for n in BASE_SERIES}
+KNOWN = BASE_SERIES | DERIVED
+
+_METRIC_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")
+_PROMQL_KEYWORDS = {
+    "rate", "sum", "by", "le", "histogram_quantile", "label_values",
+    "component_type", "component_id", "device", "irate", "max", "min", "avg",
+}
+
+
+def dashboard_exprs():
+    doc = json.loads((OPS / "grafana_dashboard.json").read_text())
+    for panel in doc["panels"]:
+        for target in panel.get("targets", []):
+            if "expr" in target:
+                yield panel["title"], target["expr"]
+
+
+class TestGrafanaDashboard:
+    def test_parses_and_has_latency_quantile_panels(self):
+        doc = json.loads((OPS / "grafana_dashboard.json").read_text())
+        exprs = "\n".join(e for _, e in dashboard_exprs())
+        for quantile in ("0.50", "0.95", "0.99"):
+            assert f"histogram_quantile({quantile}" in exprs
+        titles = [p["title"] for p in doc["panels"]]
+        assert any("Engine state" in t for t in titles)
+        assert any("device" in t.lower() for t in titles)
+
+    def test_every_queried_metric_exists(self):
+        for title, expr in dashboard_exprs():
+            names = {m for m in _METRIC_RE.findall(expr)
+                     if "_" in m and m not in _PROMQL_KEYWORDS}
+            unknown = names - KNOWN
+            assert not unknown, f"panel {title!r} queries unknown metrics {unknown}"
+
+
+class TestPrometheusScrapeConfig:
+    def test_parses_with_demo_targets(self):
+        doc = yaml.safe_load((OPS / "prometheus.yml").read_text())
+        jobs = {j["job_name"]: j for j in doc["scrape_configs"]}
+        targets = jobs["detectmate"]["static_configs"][0]["targets"]
+        assert {"127.0.0.1:18111", "127.0.0.1:18112",
+                "127.0.0.1:18113"} <= set(targets)
+        assert jobs["detectmate"]["metrics_path"] == "/metrics"
