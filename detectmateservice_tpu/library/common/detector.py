@@ -79,6 +79,10 @@ class InstanceConfig(BaseModel):
 class CoreDetectorConfig(CoreConfig):
     method_type: str = "core_detector"
     data_use_training: int = 0
+    # "no_buf" | "fixed" | "micro_batch": overrides the constructor default
+    # so the service loader (which only passes config) can select FIXED
+    # windowed detection from YAML; None keeps the component's own default
+    buffer_mode: Optional[str] = None
     buffer_size: int = 32  # FIXED mode: messages per detection window
     events: Dict[Union[int, str], Dict[str, InstanceConfig]] = Field(default_factory=dict)
     global_: Dict[str, InstanceConfig] = Field(default_factory=dict, alias="global")
@@ -110,23 +114,55 @@ class CoreDetector(CoreComponent):
     ) -> None:
         super().__init__(name=name, config=config)
         self.config: CoreDetectorConfig
+        cfg_mode = getattr(self.config, "buffer_mode", None)
+        if cfg_mode:  # YAML wins over the constructor default: the service
+            try:      # loader only ever passes config (config/loader.py)
+                buffer_mode = BufferMode(cfg_mode)
+            except ValueError as exc:
+                raise LibraryError(
+                    f"{self.name}: unknown buffer_mode {cfg_mode!r}; expected "
+                    f"one of {[m.value for m in BufferMode]}") from exc
         self.buffer_mode = buffer_mode
         self._buffer = (DataBuffer(int(getattr(self.config, "buffer_size", 32)))
                         if buffer_mode == BufferMode.FIXED else None)
+        self._pending_outputs: List[bytes] = []  # windows detected off-path
         self._trained = 0
         self._alert_ids = itertools.count(int(getattr(self.config, "start_id", 0)))
 
+    def validate_reconfigure(self, new_config) -> None:
+        """``buffer_mode`` shapes the processing topology (windowed vs
+        per-message vs engine-batched) — it cannot flip on a live instance.
+        Compared against the EFFECTIVE mode (constructor default included),
+        with an absent field meaning "keep the current mode"."""
+        new_mode = getattr(new_config, "buffer_mode", None) or self.buffer_mode.value
+        if new_mode != self.buffer_mode.value:
+            raise LibraryError(
+                f"buffer_mode cannot change at runtime (current="
+                f"{self.buffer_mode.value!r} new={new_mode!r}); restart the service")
+
     def apply_config(self) -> None:
         """Runtime reconfigure: a changed ``buffer_size`` rebuilds the FIXED
-        window in place (newest buffered messages carry over; anything beyond
-        the new size is dropped oldest-first, matching deque semantics)."""
+        window in place. Every already-buffered message is re-pushed through
+        the new window; windows that fill during the carry-over are detected
+        immediately and their alerts surface via ``flush()`` (the engine's
+        idle hook) — no buffered message is ever silently dropped."""
         if self._buffer is not None:
             new_size = max(1, int(getattr(self.config, "buffer_size", 32)))
             if new_size != self._buffer._size:
                 old_items = self._buffer.flush()
                 self._buffer = DataBuffer(new_size)
-                for item in old_items[-(new_size - 1):] if new_size > 1 else []:
-                    self._buffer.push(item)
+                for item in old_items:
+                    window = self._buffer.push(item)
+                    if window is not None:
+                        out = self._detect_over_window(window)
+                        if out is not None:
+                            self._pending_outputs.append(out)
+
+    def flush(self) -> List[Optional[bytes]]:
+        """Engine idle hook: alerts produced off the process() path (windows
+        completed during a reconfigure resize) drain here."""
+        out, self._pending_outputs = self._pending_outputs, []
+        return out
 
     # -- overridables ---------------------------------------------------
     def train(self, input_: Union[ParserSchema, List[ParserSchema]]) -> None:
@@ -182,11 +218,12 @@ class CoreDetector(CoreComponent):
         return hit
 
     def flush_final(self) -> List[Optional[bytes]]:
-        """Stop-time drain: a partial FIXED window still gets detected so no
-        buffered message is silently lost at shutdown."""
+        """Stop-time drain: pending off-path alerts plus a partial FIXED
+        window — no buffered message is silently lost at shutdown."""
+        out = self.flush()
         if self._buffer is not None and len(self._buffer):
-            return [self._detect_over_window(self._buffer.flush())]
-        return []
+            out.append(self._detect_over_window(self._buffer.flush()))
+        return out
 
     def make_output(self, input_: ParserSchema) -> DetectorSchema:
         """Prefill a DetectorSchema alert skeleton (field semantics per the

@@ -15,3 +15,15 @@ __all__ = [
 from .jax_scorer import JaxScorerDetector, JaxScorerDetectorConfig
 
 __all__ += ["JaxScorerDetector", "JaxScorerDetectorConfig"]
+
+from .llm_escalation import (
+    LLMEscalationDetector,
+    LLMEscalationDetectorConfig,
+    OpenAICompatClient,
+    RuleStubLLMClient,
+)
+
+__all__ += [
+    "LLMEscalationDetector", "LLMEscalationDetectorConfig",
+    "OpenAICompatClient", "RuleStubLLMClient",
+]

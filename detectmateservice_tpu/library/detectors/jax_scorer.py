@@ -769,6 +769,7 @@ class JaxScorerDetector(CoreDetector):
         """Veto changes that would require rebuilding the compiled model or
         re-calibrating in different units — those need a restart/refit, and
         silently accepting them would mis-calibrate detection."""
+        super().validate_reconfigure(new_config)
         frozen = ("model", "vocab_size", "seq_len", "dim", "depth", "heads",
                   "score_topk", "score_norm", "mesh_shape")
         for field in frozen:

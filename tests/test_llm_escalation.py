@@ -1,0 +1,172 @@
+"""LLMEscalationDetector: triage of detector alerts via a pluggable LLM.
+
+Capability-ceiling parity with the reference library's openai+tiktoken
+dependency (SURVEY §2.9). The assessor is injected/stubbed — no network.
+"""
+import json
+
+import pytest
+
+from detectmateservice_tpu.library.common.core import LibraryError
+from detectmateservice_tpu.library.detectors import (
+    LLMEscalationDetector,
+    RuleStubLLMClient,
+)
+from detectmateservice_tpu.schemas import DetectorSchema
+
+
+def alert_bytes(obtain=None, score=7.5, description="anomaly"):
+    return DetectorSchema(
+        detectorID="JaxScorerDetector", detectorType="jax_scorer",
+        alertID="a1", logIDs=["42"], score=score, description=description,
+        alertsObtain=obtain or {"scorer": "anomaly score 7.5 > 4.2"},
+    ).serialize()
+
+
+def detector(**overrides):
+    cfg = {"method_type": "llm_escalation", "auto_config": False,
+           "client": "stub"}
+    cfg.update(overrides)
+    return LLMEscalationDetector(
+        config={"detectors": {"LLMEscalationDetector": cfg}})
+
+
+class RecordingClient:
+    def __init__(self, verdict="malicious", confidence=0.9):
+        self.prompts = []
+        self.verdict, self.confidence = verdict, confidence
+
+    def assess(self, prompt):
+        self.prompts.append(prompt)
+        return {"verdict": self.verdict, "confidence": self.confidence,
+                "reason": "test"}
+
+
+class TestEscalation:
+    def test_alert_enriched_with_verdict(self):
+        det = detector()
+        det._client = RecordingClient()
+        out = DetectorSchema.from_bytes(det.process(alert_bytes()))
+        obtain = dict(out.alertsObtain)
+        assert obtain["llm - verdict"] == "malicious"
+        assert obtain["llm - confidence"] == "0.90"
+        assert list(out.logIDs) == ["42"]  # original alert fields intact
+
+    def test_prompt_carries_alert_context(self):
+        det = detector()
+        client = RecordingClient()
+        det._client = client
+        det.process(alert_bytes(obtain={"k": "unknown value 'xmrig'"}))
+        prompt = client.prompts[0]
+        assert "jax_scorer" in prompt and "xmrig" in prompt and "42" in prompt
+
+    def test_benign_suppression(self):
+        det = detector(suppress_benign=True, suppress_confidence=0.5)
+        det._client = RecordingClient(verdict="benign", confidence=0.9)
+        assert det.process(alert_bytes()) is None
+        assert det.suppressed == 1
+
+    def test_benign_below_confidence_bar_passes_through(self):
+        det = detector(suppress_benign=True, suppress_confidence=0.95)
+        det._client = RecordingClient(verdict="benign", confidence=0.6)
+        out = DetectorSchema.from_bytes(det.process(alert_bytes()))
+        assert dict(out.alertsObtain)["llm - verdict"] == "benign"
+
+    def test_assessor_failure_never_loses_the_alert(self):
+        class Broken:
+            def assess(self, prompt):
+                raise ConnectionError("assessor down")
+
+        det = detector()
+        det._client = Broken()
+        out = DetectorSchema.from_bytes(det.process(alert_bytes()))
+        assert "unassessed (error" in dict(out.alertsObtain)["llm - verdict"]
+
+    def test_budget_cap_annotates_instead_of_calling(self):
+        det = detector(max_assessments=1)
+        client = RecordingClient()
+        det._client = client
+        det.process(alert_bytes())
+        out = DetectorSchema.from_bytes(det.process(alert_bytes()))
+        assert dict(out.alertsObtain)["llm - verdict"] == "unassessed (budget)"
+        assert len(client.prompts) == 1
+
+    def test_corrupt_frame_filtered(self):
+        assert detector().process(b"\xff\xff\xff\xff") is None
+
+
+class TestStubClient:
+    def test_indicator_tiers(self):
+        stub = RuleStubLLMClient()
+        assert stub.assess("spawned xmrig miner")["verdict"] == "malicious"
+        assert stub.assess("wrote to /tmp/.cache")["verdict"] == "suspicious"
+        assert stub.assess("routine cron run")["verdict"] == "benign"
+
+    def test_stub_is_default_client(self):
+        det = detector()
+        assert isinstance(det._get_client(), RuleStubLLMClient)
+
+    def test_unknown_client_raises(self):
+        det = detector(client="nonsense")
+        with pytest.raises(LibraryError, match="unknown LLM client"):
+            det._get_client()
+
+
+class TestOpenAICompatClient:
+    def test_request_shape_and_response_parse(self, monkeypatch):
+        """The HTTP client forms an OpenAI-compatible request and parses the
+        model's JSON verdict (urllib patched — no sockets)."""
+        from detectmateservice_tpu.library.detectors import OpenAICompatClient
+
+        captured = {}
+
+        class FakeResp:
+            def read(self):
+                return json.dumps({"choices": [{"message": {"content": json.dumps(
+                    {"verdict": "suspicious", "confidence": 0.66,
+                     "reason": "odd path"})}}]}).encode()
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        def fake_urlopen(req, timeout):
+            captured["url"] = req.full_url
+            captured["body"] = json.loads(req.data)
+            captured["auth"] = req.headers.get("Authorization")
+            return FakeResp()
+
+        import urllib.request
+
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        client = OpenAICompatClient("http://fake/v1", "test-model", api_key="sk-x")
+        result = client.assess("judge this")
+        assert result["verdict"] == "suspicious"
+        assert captured["url"] == "http://fake/v1/chat/completions"
+        assert captured["body"]["model"] == "test-model"
+        assert captured["body"]["messages"][1]["content"] == "judge this"
+        assert captured["auth"] == "Bearer sk-x"
+
+    def test_engine_chain_scorer_to_llm_stage(self, inproc_factory):
+        """Full chain: DetectorSchema alert in -> LLM stage service ->
+        enriched alert out (the stub client assesses offline)."""
+        from detectmateservice_tpu.engine.engine import Engine
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        settings = ServiceSettings(
+            component_type="detectors.llm_escalation.LLMEscalationDetector",
+            engine_addr="inproc://llm-in", out_addr=["inproc://llm-out"])
+        det = detector()
+        engine = Engine(settings, processor=det, socket_factory=inproc_factory)
+        sink = inproc_factory.create("inproc://llm-out")
+        sink.recv_timeout = 2000
+        sender = inproc_factory.create_output("inproc://llm-in")
+        engine.start()
+        try:
+            sender.send(alert_bytes(obtain={"g": "Unknown value: '/dev/shm/xmrig'"}))
+            out = DetectorSchema.from_bytes(sink.recv())
+            assert dict(out.alertsObtain)["llm - verdict"] == "malicious"
+        finally:
+            engine.stop()
