@@ -122,6 +122,17 @@ class Service:
         from .utils.backend import request_platform
 
         request_platform(settings.backend)
+        # multi-host chip plane: when a coordinator is configured, join this
+        # process's devices into the global mesh BEFORE any component can
+        # initialize a jax backend. The import stays behind the check — the
+        # parallel package pulls in jax, which non-jax stages must not pay.
+        import os as _os
+
+        if (settings.coordinator_address
+                or _os.environ.get("DETECTMATE_COORDINATOR_ADDRESS")):
+            from .parallel.distributed import initialize_from_settings
+
+            initialize_from_settings(settings, self.logger)
         self._labels = dict(
             component_type=settings.component_type,
             component_id=settings.component_id or "unknown",

@@ -688,15 +688,15 @@ class JaxScorerDetector(CoreDetector):
         scores = np.asarray(scores_dev)[:real]
         threshold = self._threshold if self._threshold is not None else float("inf")
         out: List[Optional[bytes]] = []
-        if not (scores > threshold).any():
+        hits = np.flatnonzero(scores > threshold)
+        if hits.size == 0:
             return out
         from ...schemas import schemas_pb2 as _pb
 
-        for raw, score in zip(raws, scores):
-            if score > threshold:
-                msg = _pb.ParserSchema()
-                msg.ParseFromString(raw)
-                out.append(self._make_alert_pb(msg, float(score)))
+        for i in hits:  # touch only the anomalous rows (~1% of the batch)
+            msg = _pb.ParserSchema()
+            msg.ParseFromString(raws[i])
+            out.append(self._make_alert_pb(msg, float(scores[i])))
         return out
 
     def flush(self) -> List[Optional[bytes]]:
@@ -722,11 +722,43 @@ class JaxScorerDetector(CoreDetector):
         return self.flush()
 
     def _make_alert_pb(self, msg, score: float) -> bytes:
-        """Alert construction from a decoded pb2 message (anomalies only —
-        ~1% of traffic — so this path can afford the wrapper)."""
-        input_ = ParserSchema()
-        input_._msg.CopyFrom(msg)
-        return self._make_alert(input_, score)
+        """Alert construction straight on the generated pb2 classes — at a
+        1% anomaly rate over 250k+ lines/s this runs thousands of times per
+        second, and the dict-style wrapper layers (field-descriptor lookups,
+        map copies) measurably cap drain throughput. Field semantics match
+        CoreDetector.make_output exactly — pinned field-by-field by
+        test_batch_alert_full_field_parity_with_make_output."""
+        from ...schemas import SCHEMA_VERSION, schemas_pb2 as _pb
+
+        now = int(time.time())
+        out = _pb.DetectorSchema()
+        setattr(out, "__version__", SCHEMA_VERSION)
+        out.detectorID = self.name
+        out.detectorType = self.config.method_type
+        out.alertID = str(next(self._alert_ids))
+        out.detectionTimestamp = now
+        out.receivedTimestamp = now
+        if msg.logID:
+            out.logIDs.append(msg.logID)
+        ts = now
+        lfv = msg.logFormatVariables
+        for key in ("Time", "time", "timestamp"):
+            value = lfv.get(key) if lfv else None
+            if value:
+                try:
+                    ts = int(float(value))
+                except ValueError:
+                    pass
+                break
+        else:
+            if msg.receivedTimestamp:
+                ts = int(msg.receivedTimestamp)
+        out.extractedTimestamps.append(ts)
+        out.description = self.description
+        out.score = score
+        out.alertsObtain[f"{self.name} - score"] = (
+            f"anomaly score {score:.4f} > {self._threshold:.4f}")
+        return out.SerializeToString()
 
     def detect(self, input_: ParserSchema, output_: DetectorSchema) -> bool:
         """Single-message path (parity mode / tests): batch of one."""
@@ -743,14 +775,6 @@ class JaxScorerDetector(CoreDetector):
             return True
         self._count_device_lines(1)
         return False
-
-    def _make_alert(self, input_: ParserSchema, score: float) -> bytes:
-        output_ = self.make_output(input_)
-        output_["score"] = score
-        output_["alertsObtain"].update(
-            {f"{self.name} - score": f"anomaly score {score:.4f} > {self._threshold:.4f}"}
-        )
-        return output_.serialize()
 
     def _count_device_lines(self, n: int) -> None:
         from ...engine import metrics as m

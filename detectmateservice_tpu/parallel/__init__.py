@@ -8,6 +8,7 @@ from .mesh import (
     make_mesh,
     tree_shardings,
 )
+from .distributed import initialize_from_settings, process_info
 from .ring import ring_attention
 from .sharded import ShardedScorer
 
@@ -16,4 +17,5 @@ __all__ = [
     "LOGBERT_RULES", "REPLICATED_RULES",
     "batch_sharding", "make_mesh", "tree_shardings",
     "ring_attention", "ShardedScorer",
+    "initialize_from_settings", "process_info",
 ]

@@ -1,0 +1,92 @@
+"""Multi-host bootstrap: the DCN half of the two-plane comm design.
+
+SURVEY §5.8 splits distribution into planes: the *service plane* (this
+framework's pair sockets over ipc/tcp — the reference's NNG role) and the
+*chip plane* (XLA collectives). Within one host the chip plane is free; to
+span HOSTS the way the reference's deployment scales containers, JAX needs
+its distributed runtime initialized so every process contributes its local
+devices to one global mesh and XLA routes collectives over ICI within a pod
+and DCN across pods — the role NCCL/MPI bootstrap plays in GPU stacks,
+with zero hand-written collectives here.
+
+Wireup: service settings carry the coordinator address and process
+coordinates. The ``DETECTMATE_COORDINATOR_ADDRESS`` /
+``DETECTMATE_NUM_PROCESSES`` / ``DETECTMATE_PROCESS_ID`` env vars reach the
+same fields through the settings env layer (they are named exactly after
+the fields — an env name the settings model does not know would be
+REJECTED by ``extra="forbid"`` and crash every stage at startup), and are
+also honored here directly for programmatic ``ServiceSettings`` that left
+the fields unset. The scorer's ``mesh_shape`` then simply sees
+``jax.devices()`` spanning all hosts. ``initialize_from_settings`` is
+idempotent and a no-op when no coordinator is configured (single-host: the
+common case, and the only one testable in this environment — multi-host
+needs actual multiple hosts, so the seam is kept thin and std-jax so it
+carries no untested custom protocol).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+_initialized = False
+
+
+def initialize_from_settings(settings: Optional[Any] = None,
+                             logger: Optional[logging.Logger] = None) -> bool:
+    """Initialize ``jax.distributed`` from settings/env; returns whether the
+    distributed runtime is (now) live. Safe to call multiple times.
+
+    The source of the coordinator decides the source of the process
+    coordinates: a settings-borne coordinator uses the settings'
+    num_processes/process_id; an env-borne coordinator uses the env's
+    (num_processes/process_id default to 1/0 in the model, so they cannot
+    signal "unset" on their own).
+    """
+    global _initialized
+    logger = logger or logging.getLogger(__name__)
+    if _initialized:
+        return True
+
+    coordinator = (getattr(settings, "coordinator_address", None)
+                   if settings is not None else None)
+    if coordinator:
+        num_processes = int(getattr(settings, "num_processes", 1) or 1)
+        process_id = int(getattr(settings, "process_id", 0) or 0)
+    else:
+        coordinator = os.environ.get("DETECTMATE_COORDINATOR_ADDRESS") or None
+        if coordinator is None:
+            return False  # single-host deployment: nothing to do
+        num_processes = int(os.environ.get("DETECTMATE_NUM_PROCESSES") or 1)
+        process_id = int(os.environ.get("DETECTMATE_PROCESS_ID") or 0)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: process %d/%d via %s — %d global / %d "
+        "local devices", process_id, num_processes, coordinator,
+        len(jax.devices()), len(jax.local_devices()))
+    return True
+
+
+def process_info() -> dict:
+    """Report for /admin/status: this process's place in the global mesh.
+    Importless when the runtime was never initialized — non-jax stages must
+    not pay a jax import for a dict of constants."""
+    if not _initialized:
+        return {"initialized": False, "process_index": 0,
+                "process_count": 1, "local_devices": None}
+    import jax
+
+    return {
+        "initialized": True,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+    }

@@ -154,6 +154,14 @@ class ServiceSettings(BaseModel):
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 8}
     checkpoint_dir: Optional[str] = None
     profile_dir: Optional[str] = None
+    # multi-host chip plane (parallel/distributed.py): when a coordinator is
+    # set, jax.distributed joins this process's devices into the global mesh
+    # (ICI within a pod, DCN across pods). Env (via the standard settings
+    # env layer — names match the fields): DETECTMATE_COORDINATOR_ADDRESS /
+    # DETECTMATE_NUM_PROCESSES / DETECTMATE_PROCESS_ID.
+    coordinator_address: Optional[str] = None  # "host:port"
+    num_processes: int = Field(default=1, ge=1)
+    process_id: int = Field(default=0, ge=0)
 
     # -- derived identity (reference: settings.py:93-114) -----------------
     @model_validator(mode="after")

@@ -30,7 +30,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 DEMO_DIR = Path("/tmp/detectmate-demo")
-PARSER_PORT, DETECTOR_PORT, OUTPUT_PORT = 18111, 18112, 18113
+PARSER_PORT, DETECTOR_PORT, OUTPUT_PORT, LLM_PORT = 18111, 18112, 18113, 18114
 
 sys.path.insert(0, str(REPO))
 
@@ -75,6 +75,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", type=int, default=2316, help="log lines to feed")
     ap.add_argument("--detector", choices=["newvalue", "scorer"], default="newvalue")
+    ap.add_argument("--llm", action="store_true",
+                    help="insert the LLM triage stage between detector and output")
     ap.add_argument("--keep", action="store_true", help="keep the work dir")
     args = ap.parse_args()
 
@@ -93,11 +95,20 @@ def main() -> int:
     for name in ("parser_settings.yaml", "parser_config.yaml",
                  "detector_config.yaml", "scorer_config.yaml",
                  "output_settings.yaml", "output_config.yaml",
+                 "llm_settings.yaml", "llm_config.yaml",
                  "audit_templates.txt"):
         shutil.copy(REPO / "examples" / name, DEMO_DIR / name)
     detector_settings = ("detector_settings.yaml" if args.detector == "newvalue"
                         else "scorer_settings.yaml")
     shutil.copy(REPO / "examples" / detector_settings, DEMO_DIR / detector_settings)
+    if args.llm:
+        # reroute detector alerts through the triage stage
+        import yaml
+
+        det_path = DEMO_DIR / detector_settings
+        det_cfg = yaml.safe_load(det_path.read_text())
+        det_cfg["out_addr"] = ["ipc:///tmp/detectmate-demo/llm.ipc"]
+        det_path.write_text(yaml.safe_dump(det_cfg))
 
     lines = list(generate(args.n))
     expected_anomalies = sum(1 for _, a in lines if a)
@@ -110,6 +121,8 @@ def main() -> int:
         procs.append(launch(DEMO_DIR / "parser_settings.yaml", DEMO_DIR / "parser.out"))
         procs.append(launch(DEMO_DIR / detector_settings, DEMO_DIR / "detector.out"))
         procs.append(launch(DEMO_DIR / "output_settings.yaml", DEMO_DIR / "output.out"))
+        if args.llm:
+            procs.append(launch(DEMO_DIR / "llm_settings.yaml", DEMO_DIR / "llm.out"))
         # final sink listens where the output stage dials (OutputSchema records)
         sink = factory.create("ipc:///tmp/detectmate-demo/final.ipc")
         sink.recv_timeout = 200
@@ -131,7 +144,10 @@ def main() -> int:
         wait_running(PARSER_PORT)
         wait_running(DETECTOR_PORT)
         wait_running(OUTPUT_PORT)
-        print("[demo] all three services running; feeding...")
+        if args.llm:
+            wait_running(LLM_PORT)
+        print(f"[demo] all {'four' if args.llm else 'three'} services running; "
+              "feeding...")
 
         ingress = factory.create_output("ipc:///tmp/detectmate-demo/parser.ipc")
         t0 = time.perf_counter()
@@ -176,7 +192,7 @@ def main() -> int:
         print("[demo] RESULT:", "OK" if ok else "NO ALERTS (unexpected)")
         return 0 if ok else 1
     finally:
-        for port in (PARSER_PORT, DETECTOR_PORT, OUTPUT_PORT):
+        for port in (PARSER_PORT, DETECTOR_PORT, OUTPUT_PORT, LLM_PORT):
             try:
                 admin(port, "shutdown")
             except Exception:

@@ -1,0 +1,137 @@
+"""Multi-host bootstrap (parallel/distributed.py): the DCN half of the
+two-plane comm design (SURVEY §5.8 — jax.distributed plays the NCCL/MPI
+bootstrap role; XLA owns the collectives).
+
+A real multi-host run needs multiple hosts; what IS testable here: the
+no-coordinator no-op, knob resolution (settings vs env), and a REAL
+``jax.distributed.initialize`` with num_processes=1 against a local
+coordinator, in a subprocess so this pytest process's backend state stays
+untouched.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestKnobResolution:
+    def test_no_coordinator_is_a_noop(self):
+        from detectmateservice_tpu.parallel import distributed
+
+        assert distributed.initialize_from_settings(settings=None) is False
+        assert distributed.process_info()["process_count"] == 1
+
+    def test_settings_coordinator_uses_settings_coords(self, monkeypatch):
+        """A settings-borne coordinator takes ALL coordinates from settings —
+        env coordinates must not half-apply."""
+        from detectmateservice_tpu.parallel import distributed
+
+        captured = {}
+
+        class FakeDistributed:
+            @staticmethod
+            def initialize(coordinator_address, num_processes, process_id):
+                captured.update(addr=coordinator_address, n=num_processes,
+                                pid=process_id)
+
+        import jax
+
+        monkeypatch.setattr(jax, "distributed", FakeDistributed)
+        monkeypatch.setattr(distributed, "_initialized", False)
+        monkeypatch.setenv("DETECTMATE_COORDINATOR_ADDRESS", "env-host:1")
+        monkeypatch.setenv("DETECTMATE_NUM_PROCESSES", "9")
+
+        class S:
+            coordinator_address = "settings-host:2"
+            num_processes = 4
+            process_id = 3
+
+        assert distributed.initialize_from_settings(S()) is True
+        assert captured == {"addr": "settings-host:2", "n": 4, "pid": 3}
+        monkeypatch.setattr(distributed, "_initialized", False)
+
+    def test_env_coordinator_uses_env_coords(self, monkeypatch):
+        """An env-borne coordinator takes the coordinates from env too (the
+        model's 1/0 defaults cannot signal 'unset')."""
+        from detectmateservice_tpu.parallel import distributed
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        captured = {}
+
+        class FakeDistributed:
+            @staticmethod
+            def initialize(coordinator_address, num_processes, process_id):
+                captured.update(addr=coordinator_address, n=num_processes,
+                                pid=process_id)
+
+        import jax
+
+        monkeypatch.setattr(jax, "distributed", FakeDistributed)
+        monkeypatch.setattr(distributed, "_initialized", False)
+        monkeypatch.setenv("DETECTMATE_COORDINATOR_ADDRESS", "10.0.0.9:8476")
+        monkeypatch.setenv("DETECTMATE_NUM_PROCESSES", "2")
+        monkeypatch.setenv("DETECTMATE_PROCESS_ID", "1")
+        # a real programmatic settings object with the fields left at their
+        # defaults — the documented per-host env vars must still win
+        settings = ServiceSettings(engine_addr="inproc://dist-env")
+        assert distributed.initialize_from_settings(settings) is True
+        assert captured == {"addr": "10.0.0.9:8476", "n": 2, "pid": 1}
+        monkeypatch.setattr(distributed, "_initialized", False)
+
+    def test_env_vars_reach_settings_fields_via_env_layer(self, monkeypatch,
+                                                          tmp_path):
+        """The documented env names match the model fields exactly, so the
+        standard DETECTMATE_* env merge populates them — an unknown env name
+        would crash from_yaml under extra='forbid'."""
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        monkeypatch.setenv("DETECTMATE_COORDINATOR_ADDRESS", "10.1.2.3:777")
+        monkeypatch.setenv("DETECTMATE_NUM_PROCESSES", "4")
+        monkeypatch.setenv("DETECTMATE_PROCESS_ID", "2")
+        path = tmp_path / "s.yaml"
+        path.write_text("engine_addr: inproc://dist-yaml\n")
+        settings = ServiceSettings.from_yaml(str(path))
+        assert settings.coordinator_address == "10.1.2.3:777"
+        assert settings.num_processes == 4
+        assert settings.process_id == 2
+
+
+class TestRealSingleProcessInitialize:
+    def test_initialize_and_shard_over_global_devices(self, free_port):
+        """Real jax.distributed bootstrap (1-process coordinator on
+        localhost) in a subprocess: process_count reports, and a sharded
+        computation runs over the now-'global' device view."""
+        code = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from detectmateservice_tpu.parallel import distributed, make_mesh, batch_sharding
+
+class S:
+    coordinator_address = "127.0.0.1:{free_port}"
+    num_processes = 1
+    process_id = 0
+
+assert distributed.initialize_from_settings(S()) is True
+info = distributed.process_info()
+assert info["process_count"] == 1, info
+assert info["local_devices"] == 4, info
+
+import numpy as np
+mesh = make_mesh({{"data": 4}})
+sharding = batch_sharding(mesh, "data")
+x = jax.device_put(np.arange(16.0).reshape(8, 2), sharding)
+total = jax.jit(lambda t: t.sum())(x)
+assert float(total) == 120.0
+print("DISTRIBUTED_OK")
+"""
+        env = dict(PYTHONPATH=str(REPO), PATH="/usr/bin:/bin:/opt/venv/bin",
+                   HOME="/root")
+        result = subprocess.run([sys.executable, "-c", code],
+                                capture_output=True, text=True, timeout=120,
+                                env=env)
+        assert "DISTRIBUTED_OK" in result.stdout, result.stderr[-1500:]

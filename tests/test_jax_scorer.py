@@ -79,6 +79,31 @@ class TestDetection:
         assert list(alert.logIDs) == ["66"]
         assert alert.score > 0
 
+    def test_batch_alert_full_field_parity_with_make_output(self, trained_detector):
+        """The batch path builds alerts straight on pb2 for speed; EVERY
+        field must match what the wrapper path (CoreDetector.make_output)
+        would produce — this is the pin that lets the two stay one contract."""
+        from detectmateservice_tpu.schemas import SCHEMA_VERSION
+
+        raw = msg("segfault <*> exploit <*>", ["0xdead", "shellcode"], log_id="9")
+        out = trained_detector.process_batch([raw])
+        out += trained_detector.flush()
+        alert = DetectorSchema.from_bytes([o for o in out if o is not None][0])
+        ref = trained_detector.make_output(ParserSchema.from_bytes(raw))
+        assert getattr(alert._msg, "__version__") == SCHEMA_VERSION
+        assert alert.detectorID == ref.detectorID == "JaxScorerDetector"
+        assert alert.detectorType == ref.detectorType == "jax_scorer"
+        assert list(alert.logIDs) == list(ref.logIDs) == ["9"]
+        # msg() carries Time=1700000000 -> the extract_timestamp chain
+        assert list(alert.extractedTimestamps) == [1700000000]
+        assert alert.description == ref.description
+        assert alert.detectionTimestamp > 1_700_000_000
+        assert alert.receivedTimestamp == alert.detectionTimestamp
+        assert alert.score > 0
+        obtain = dict(alert.alertsObtain)
+        assert "JaxScorerDetector - score" in obtain
+        assert "anomaly score" in obtain["JaxScorerDetector - score"]
+
     def test_small_batch_host_path_returns_immediately(self, trained_detector):
         # batches ≤ host_score_max_batch score on the CPU twin and come back
         # in the same call — the sparse-traffic latency contract
