@@ -243,13 +243,18 @@ class Service:
 
     def _create_status_report(self) -> Dict[str, Any]:
         """Status JSON shape pinned by the reference
-        (reference: core.py:280-297,386-421)."""
+        (reference: core.py:280-297,386-421); the ``distributed`` block is a
+        TPU-build addition reporting this process's place in the global mesh
+        (parallel/distributed.py — stays importless on non-jax stages)."""
+        from .parallel.distributed import process_info
+
         return {
             "status": {
                 "component_type": self.settings.component_type,
                 "component_id": self.settings.component_id,
                 "running": self.engine.running,
             },
+            "distributed": process_info(),
             "settings": self.settings.model_dump(mode="json"),
             "configs": self.config_manager.get() if self.config_manager else {},
         }

@@ -32,7 +32,7 @@ from ..common.detector import BufferMode, CoreDetector, CoreDetectorConfig
 
 class JaxScorerDetectorConfig(CoreDetectorConfig):
     method_type: str = "jax_scorer"
-    model: str = "mlp"                # "mlp" | "logbert"
+    model: str = "mlp"                # "mlp" | "gru" | "logbert"
     vocab_size: int = 32768
     seq_len: int = 32
     dim: int = 128
@@ -189,6 +189,13 @@ class JaxScorerDetector(CoreDetector):
                 vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
                 heads=cfg.heads, seq_len=cfg.seq_len, score_topk=cfg.score_topk,
             ))
+        elif cfg.model == "gru":
+            from ...models.gru import GRUScorer, GRUScorerConfig
+
+            self._scorer = GRUScorer(GRUScorerConfig(
+                vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
+                seq_len=cfg.seq_len, score_topk=cfg.score_topk,
+            ))
         elif cfg.model == "mlp":
             from ...models.mlp import MLPScorer, MLPScorerConfig
 
@@ -279,9 +286,15 @@ class JaxScorerDetector(CoreDetector):
         self._host_warm_thread.start()
 
     def _put(self, array: np.ndarray):
+        """Upload a token batch in the narrow wire format (halving upload
+        bytes halves the dominant hot-path cost — models.tokenizer
+        narrow_tokens has the rule; the jitted impls cast back on device)."""
         import jax
 
-        return jax.device_put(array, self._device)
+        from ...models.tokenizer import narrow_tokens
+
+        return jax.device_put(narrow_tokens(array, self.config.vocab_size),
+                              self._device)
 
     def _score_dev(self, tokens: np.ndarray):
         """Dispatch scoring for [n, S] tokens; returns the device array

@@ -1,9 +1,11 @@
 from .tokenizer import HashTokenizer, PAD_ID, MASK_ID, CLS_ID
 from .mlp import MLPScorer, MLPScorerConfig, EmbedMLPModel
+from .gru import GRUScorer, GRUScorerConfig, GRULM
 from .logbert import LogBERTScorer, LogBERTConfig, LogBERT
 
 __all__ = [
     "HashTokenizer", "PAD_ID", "MASK_ID", "CLS_ID",
     "MLPScorer", "MLPScorerConfig", "EmbedMLPModel",
+    "GRUScorer", "GRUScorerConfig", "GRULM",
     "LogBERTScorer", "LogBERTConfig", "LogBERT",
 ]

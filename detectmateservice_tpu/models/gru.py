@@ -1,0 +1,148 @@
+"""DeepLog-style recurrent (GRU) next-token anomaly scorer (flax).
+
+Third rung of the scorer ladder (mlp → gru → logbert). A causal next-token
+language model over the hashed token stream: each position's token is
+predicted from the learned prefix state, so the anomaly score is the true
+autoregressive NLL of the sequence — the DeepLog formulation — rather than
+the bag (mlp) or masked-LM pseudo-NLL (logbert). The reference has no
+accelerator or sequence model at all (SURVEY.md §0 "no training, no
+GPU/accelerator code"); this family exists because recurrent scorers catch
+*order* anomalies (a valid token in the wrong place) that the bag model is
+blind to, at ~1/4 of the transformer's FLOPs for short log sequences.
+
+TPU-first design notes:
+* fixed [B, S] int32 inputs; the time loop is ``flax.linen.RNN`` (lax.scan
+  under jit — traced once, no Python-level unrolling, static shapes),
+* per-step matmuls are [B, D]x[D, 3D] — batched and MXU-tiled; bfloat16
+  activations with fp32 logits/log-softmax accumulation,
+* weight-tied output head (``embed.attend``) keeps HBM traffic at one
+  embedding table,
+* the scan carries [B, D] per layer — tiny versus the transformer's
+  [B, S, S] attention intermediates, so very large micro-batches fit.
+
+Interface-compatible with MLPScorer/LogBERTScorer (score / train_step /
+_score_impl / _token_nlls_impl / _normscore_impl / init), so the detector
+(`library/detectors/jax_scorer.py`) and parallel.ShardedScorer compose with
+it unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from .logbert import positional_z_max, token_nll
+from .tokenizer import PAD_ID
+
+
+@dataclasses.dataclass(frozen=True)
+class GRUScorerConfig:
+    vocab_size: int = 32768
+    dim: int = 128
+    depth: int = 1                    # stacked GRU layers
+    seq_len: int = 32
+    dtype: Any = jnp.bfloat16
+    learning_rate: float = 2e-3
+    # 0 = mean NLL over observed tokens; k > 0 = mean of the k most
+    # surprising (same knob as LogBERTConfig.score_topk)
+    score_topk: int = 0
+
+
+class GRULM(nn.Module):
+    config: GRUScorerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, S, V] fp32 causal next-token logits.
+
+        Position t's logits are computed from tokens[<t] plus a learned BOS
+        embedding, so every position (including 0) has a real prediction and
+        the per-position NLLs line up 1:1 with the input tokens — the same
+        alignment contract positional_z_max and the calibration pass assume.
+        """
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         name="tok_embed")
+        bos = self.param("bos_embed", nn.initializers.normal(0.02), (cfg.dim,))
+        emb = embed(tokens)                      # [B, S, D]
+        # teacher-forced shift-right: the input at step t is token t-1
+        x = jnp.concatenate(
+            [jnp.broadcast_to(bos.astype(cfg.dtype),
+                              (tokens.shape[0], 1, cfg.dim)),
+             emb[:, :-1]], axis=1)
+        for i in range(cfg.depth):
+            cell = nn.GRUCell(features=cfg.dim, dtype=cfg.dtype,
+                              name=f"gru_{i}")
+            x = nn.RNN(cell, name=f"rnn_{i}")(x)  # lax.scan over time
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        return embed.attend(x.astype(jnp.float32))  # weight-tied head
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token NLL over all non-PAD positions (scalar)."""
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
+    mask = (tokens != PAD_ID).astype(jnp.float32)
+    return -(tok_lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+class GRUScorer:
+    """Bundles model/optimizer with jit-compiled score and train steps."""
+
+    name = "gru"
+
+    def __init__(self, config: Optional[GRUScorerConfig] = None):
+        self.config = config or GRUScorerConfig()
+        self.model = GRULM(self.config)
+        self.optimizer = optax.adamw(self.config.learning_rate)
+        self._score = jax.jit(self._score_impl)
+        self._train = jax.jit(self._train_impl)
+        self._token_nlls = jax.jit(self._token_nlls_impl)
+        self._normscore = jax.jit(self._normscore_impl)
+
+    def init(self, rng: jax.Array) -> Tuple[Any, Any]:
+        dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
+        params = self.model.init(rng, dummy)
+        return params, self.optimizer.init(params)
+
+    # -- jitted impls ---------------------------------------------------
+    def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
+        # tokens may arrive as uint16 (half-width wire format); int32 inside
+        tokens = tokens.astype(jnp.int32)
+        return token_nll(self.model.apply(params, tokens), tokens,
+                         topk=self.config.score_topk)
+
+    def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
+        """[B, S] per-position autoregressive NLL (PAD positions → 0)."""
+        tokens = tokens.astype(jnp.int32)
+        logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
+        tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
+        return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
+
+    def _normscore_impl(self, params, tokens: jax.Array,
+                        mu: jax.Array, sigma: jax.Array) -> jax.Array:
+        tokens = tokens.astype(jnp.int32)
+        return positional_z_max(self._token_nlls_impl(params, tokens),
+                                tokens, mu, sigma)
+
+    def _train_impl(self, params, opt_state, rng, tokens):
+        del rng  # teacher forcing is deterministic; no corruption step
+        tokens = tokens.astype(jnp.int32)
+
+        def loss_fn(p):
+            return causal_lm_loss(self.model.apply(p, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # -- public API -----------------------------------------------------
+    def score(self, params, tokens) -> jax.Array:
+        return self._score(params, tokens)
+
+    def train_step(self, params, opt_state, rng, tokens):
+        return self._train(params, opt_state, rng, tokens)

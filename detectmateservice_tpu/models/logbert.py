@@ -159,22 +159,27 @@ class LogBERTScorer:
 
     # -- jitted impls ---------------------------------------------------
     def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
+        # tokens may arrive as uint16 (half-width wire format); int32 inside
+        tokens = tokens.astype(jnp.int32)
         return token_nll(self.model.apply(params, tokens), tokens,
                          topk=self.config.score_topk)
 
     def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
         """[B, S] per-position NLL (PAD positions → 0)."""
+        tokens = tokens.astype(jnp.int32)
         logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
         tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
         return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
 
     def _normscore_impl(self, params, tokens: jax.Array,
                         mu: jax.Array, sigma: jax.Array) -> jax.Array:
+        tokens = tokens.astype(jnp.int32)
         return positional_z_max(self._token_nlls_impl(params, tokens),
                                 tokens, mu, sigma)
 
     def _train_impl(self, params, opt_state, rng, tokens):
         cfg = self.config
+        tokens = tokens.astype(jnp.int32)
 
         def loss_fn(p):
             mask_rng, _ = jax.random.split(rng)

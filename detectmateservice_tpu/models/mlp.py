@@ -78,10 +78,14 @@ class MLPScorer:
         return params, self.optimizer.init(params)
 
     def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
+        # tokens may arrive as uint16 (the half-width wire format the
+        # detector uploads to cut host→device bandwidth); compute in int32
+        tokens = tokens.astype(jnp.int32)
         return bag_nll(self.model.apply(params, tokens), tokens)
 
     def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
         """[B, S] per-position NLL under the bag context distribution."""
+        tokens = tokens.astype(jnp.int32)
         logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
         tok_lp = jnp.take_along_axis(logprobs, tokens, axis=-1)  # [B, S]
         return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
@@ -90,11 +94,13 @@ class MLPScorer:
                         mu: jax.Array, sigma: jax.Array) -> jax.Array:
         from .logbert import positional_z_max
 
+        tokens = tokens.astype(jnp.int32)
         return positional_z_max(self._token_nlls_impl(params, tokens),
                                 tokens, mu, sigma)
 
     def _train_impl(self, params, opt_state, rng, tokens):
         del rng  # no stochastic corruption in the bag model
+        tokens = tokens.astype(jnp.int32)
 
         def loss_fn(p):
             return bag_nll(self.model.apply(p, tokens), tokens).mean()

@@ -1,21 +1,53 @@
-from .mesh import (
-    AXIS_DATA,
-    AXIS_MODEL,
-    AXIS_SEQ,
-    LOGBERT_RULES,
-    REPLICATED_RULES,
-    batch_sharding,
-    make_mesh,
-    tree_shardings,
-)
-from .distributed import initialize_from_settings, process_info
-from .ring import ring_attention
-from .sharded import ShardedScorer
+"""Chip-plane parallelism layer (mesh, sharded execution, ring attention,
+multi-host bootstrap).
 
-__all__ = [
-    "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ",
-    "LOGBERT_RULES", "REPLICATED_RULES",
-    "batch_sharding", "make_mesh", "tree_shardings",
-    "ring_attention", "ShardedScorer",
-    "initialize_from_settings", "process_info",
-]
+Exports resolve lazily (PEP 562): ``mesh``/``sharded``/``ring`` import jax at
+module level, but ``distributed`` is deliberately importless until a
+coordinator is configured — non-jax pipeline stages (parsers, output writers)
+read ``process_info`` through this package on every /admin/status call and
+must not pay a jax import for it.
+"""
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "AXIS_DATA": "mesh",
+    "AXIS_MODEL": "mesh",
+    "AXIS_SEQ": "mesh",
+    "LOGBERT_RULES": "mesh",
+    "REPLICATED_RULES": "mesh",
+    "batch_sharding": "mesh",
+    "make_mesh": "mesh",
+    "tree_shardings": "mesh",
+    "initialize_from_settings": "distributed",
+    "process_info": "distributed",
+    "ring_attention": "ring",
+    "ShardedScorer": "sharded",
+}
+
+__all__ = list(_EXPORTS)
+
+if TYPE_CHECKING:  # static analyzers see the real symbols
+    from .distributed import initialize_from_settings, process_info  # noqa: F401
+    from .mesh import (  # noqa: F401
+        AXIS_DATA,
+        AXIS_MODEL,
+        AXIS_SEQ,
+        LOGBERT_RULES,
+        REPLICATED_RULES,
+        batch_sharding,
+        make_mesh,
+        tree_shardings,
+    )
+    from .ring import ring_attention  # noqa: F401
+    from .sharded import ShardedScorer  # noqa: F401
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f".{module}", __name__), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value

@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..models.tokenizer import narrow_tokens
 from .mesh import (
     AXIS_DATA,
     LOGBERT_RULES,
@@ -43,6 +44,11 @@ class ShardedScorer:
         self.mesh = mesh if mesh is not None else make_mesh()
         if rules is None:
             rules = LOGBERT_RULES if getattr(scorer, "name", "") == "logbert" else REPLICATED_RULES
+        # token batches travel in the narrow wire format (uint16 when the
+        # vocab fits — models.tokenizer.narrow_tokens has the one rule); the
+        # jitted impls cast back to int32 on device
+        self._vocab_size = getattr(getattr(scorer, "config", None),
+                                   "vocab_size", 1 << 31)
         params, opt_state = scorer.init(rng if rng is not None else jax.random.PRNGKey(0))
         self._param_sharding = tree_shardings(self.mesh, params, rules)
         self._opt_sharding = tree_shardings(self.mesh, opt_state, rules)
@@ -75,14 +81,15 @@ class ShardedScorer:
         return int(self.mesh.shape.get(AXIS_DATA, 1))
 
     def _pad_batch(self, tokens: np.ndarray) -> Tuple[np.ndarray, int]:
-        """Pad the batch to a multiple of the data-axis size."""
+        """Pad the batch to a multiple of the data-axis size (and narrow to
+        the wire dtype — see __init__)."""
         n = len(tokens)
         dp = self.data_parallelism
         padded = ((n + dp - 1) // dp) * dp
         if padded != n:
             pad = np.zeros((padded - n,) + tokens.shape[1:], tokens.dtype)
             tokens = np.concatenate([tokens, pad])
-        return tokens, n
+        return narrow_tokens(tokens, self._vocab_size), n
 
     def score(self, tokens: np.ndarray) -> np.ndarray:
         tokens, n = self._pad_batch(np.asarray(tokens))
@@ -123,7 +130,8 @@ class ShardedScorer:
             # final batch on a data=8 mesh); a plain slice would come up
             # short and crash the sharded device_put
             tokens = tokens[np.arange(padded) % n]
-        tokens = jax.device_put(tokens, self._batch_sharding)
+        tokens = jax.device_put(narrow_tokens(tokens, self._vocab_size),
+                                self._batch_sharding)
         self.params, self.opt_state, loss = self._train(
             self.params, self.opt_state, rng, tokens
         )

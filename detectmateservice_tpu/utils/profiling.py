@@ -20,7 +20,11 @@ def _machine_fingerprint() -> str:
     import hashlib
     import platform as plt
 
-    parts = [plt.machine(), plt.system()]
+    # the leading salt versions the cache *format policy*: entries written
+    # before jax_persistent_cache_enable_xla_caches="none" embed XLA:CPU AOT
+    # blobs whose loader spews machine-feature warnings on every hit; bumping
+    # the salt orphans them instead of reloading them forever
+    parts = ["v2", plt.machine(), plt.system()]
     try:
         import jax
 

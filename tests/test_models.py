@@ -1,4 +1,4 @@
-"""Scorer model tests: tokenizer, MLP autoencoder, LogBERT."""
+"""Scorer model tests: tokenizer, MLP autoencoder, GRU LM, LogBERT."""
 import jax
 import numpy as np
 import pytest
@@ -6,6 +6,8 @@ import pytest
 from detectmateservice_tpu.models import (
     CLS_ID,
     PAD_ID,
+    GRUScorer,
+    GRUScorerConfig,
     HashTokenizer,
     LogBERTConfig,
     LogBERTScorer,
@@ -66,8 +68,15 @@ def logbert():
     return scorer, params, opt
 
 
+@pytest.fixture(scope="module")
+def gru():
+    scorer = GRUScorer(GRUScorerConfig(vocab_size=512, dim=32, depth=1, seq_len=8))
+    params, opt = scorer.init(jax.random.PRNGKey(0))
+    return scorer, params, opt
+
+
 class TestScorers:
-    @pytest.mark.parametrize("fixture", ["mlp", "logbert"])
+    @pytest.mark.parametrize("fixture", ["mlp", "gru", "logbert"])
     def test_score_shape_and_dtype(self, fixture, request):
         scorer, params, _ = request.getfixturevalue(fixture)
         tokens = np.random.randint(3, 512, (5, 8)).astype(np.int32)
@@ -75,7 +84,7 @@ class TestScorers:
         assert scores.shape == (5,)
         assert np.isfinite(scores).all()
 
-    @pytest.mark.parametrize("fixture", ["mlp", "logbert"])
+    @pytest.mark.parametrize("fixture", ["mlp", "gru", "logbert"])
     def test_train_step_reduces_loss(self, fixture, request):
         scorer, params, opt = request.getfixturevalue(fixture)
         tokens = np.random.randint(3, 512, (16, 8)).astype(np.int32)
@@ -123,3 +132,50 @@ class TestScorers:
         # identical content, same padding → identical score (sanity)
         scores_b = float(np.asarray(scorer.score(params, a.copy()))[0])
         assert scores_a == pytest.approx(scores_b)
+
+    def test_gru_separates_normal_from_anomalous(self):
+        scorer = GRUScorer(GRUScorerConfig(vocab_size=2048, dim=48, depth=1,
+                                           seq_len=12))
+        params, opt = scorer.init(jax.random.PRNGKey(0))
+        tok = HashTokenizer(vocab_size=2048, seq_len=12)
+        normal = tok.encode_batch(
+            [f"user u{i % 6} login ok from host{i % 4}" for i in range(128)]
+        )
+        weird = tok.encode_batch(["kernel panic stack smash exploit shell"] * 8)
+        rng = jax.random.PRNGKey(1)
+        for _ in range(6):
+            for s in range(0, 128, 32):
+                rng, r = jax.random.split(rng)
+                params, opt, _ = scorer.train_step(params, opt, r, normal[s:s + 32])
+        sn = np.asarray(scorer.score(params, normal[:32]))
+        sw = np.asarray(scorer.score(params, weird))
+        assert sw.mean() > sn.mean() + 3 * sn.std()
+
+    def test_gru_detects_order_anomaly(self):
+        """The recurrent family's distinguishing capability: the SAME tokens
+        in a never-seen order must score higher than the trained order — a
+        signal the bag (mlp) model is blind to by construction."""
+        scorer = GRUScorer(GRUScorerConfig(vocab_size=2048, dim=48, depth=1,
+                                           seq_len=8))
+        params, opt = scorer.init(jax.random.PRNGKey(0))
+        tok = HashTokenizer(vocab_size=2048, seq_len=8)
+        ordered = tok.encode_batch(["open read write close"] * 64)
+        rng = jax.random.PRNGKey(1)
+        for _ in range(40):
+            rng, r = jax.random.split(rng)
+            params, opt, _ = scorer.train_step(params, opt, r, ordered[:32])
+        fwd = tok.encode_batch(["open read write close"])
+        rev = tok.encode_batch(["close write read open"])
+        s_fwd = float(np.asarray(scorer.score(params, fwd))[0])
+        s_rev = float(np.asarray(scorer.score(params, rev))[0])
+        assert s_rev > s_fwd + 0.5
+
+    def test_gru_token_nlls_align_with_positions(self, gru):
+        """Per-position NLLs must be PAD-masked and position-aligned (the
+        contract the positional-z calibration relies on)."""
+        scorer, params, _ = gru
+        tokens = np.array([[2, 5, 7, 9, PAD_ID, PAD_ID, PAD_ID, PAD_ID]], np.int32)
+        nlls = np.asarray(scorer._token_nlls(params, tokens))
+        assert nlls.shape == (1, 8)
+        assert (nlls[0, 4:] == 0).all()      # PAD positions contribute 0
+        assert (nlls[0, :4] > 0).all()       # real positions have real NLL
