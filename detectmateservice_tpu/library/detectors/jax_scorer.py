@@ -36,9 +36,13 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     vocab_size: int = 32768
     seq_len: int = 32
     dim: int = 128
-    depth: int = 2                    # logbert only
+    depth: int = 2                    # logbert/gru layers
     heads: int = 4                    # logbert only
-    score_topk: int = 0               # logbert only: 0=mean NLL, k>0=top-k mean
+    score_topk: int = 0               # logbert/gru: 0=mean NLL, k>0=top-k mean
+    # logbert attention path: "auto" (flash kernel on TPU for long
+    # sequences, fused einsum otherwise) | "einsum" | "flash" | "blockwise"
+    # | "ring" (sequence-parallel over the mesh_shape 'seq' axis)
+    attn_impl: str = "auto"
     data_use_training: int = 256
     train_epochs: int = 3
     # small training buffers still get enough optimizer steps to converge
@@ -188,6 +192,7 @@ class JaxScorerDetector(CoreDetector):
             self._scorer = LogBERTScorer(LogBERTConfig(
                 vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
                 heads=cfg.heads, seq_len=cfg.seq_len, score_topk=cfg.score_topk,
+                attn_impl=cfg.attn_impl,
             ))
         elif cfg.model == "gru":
             from ...models.gru import GRUScorer, GRUScorerConfig
@@ -808,7 +813,7 @@ class JaxScorerDetector(CoreDetector):
         silently accepting them would mis-calibrate detection."""
         super().validate_reconfigure(new_config)
         frozen = ("model", "vocab_size", "seq_len", "dim", "depth", "heads",
-                  "score_topk", "score_norm", "mesh_shape")
+                  "score_topk", "score_norm", "mesh_shape", "attn_impl")
         for field in frozen:
             if getattr(new_config, field) != getattr(self.config, field):
                 raise LibraryError(

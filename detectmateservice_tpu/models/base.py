@@ -1,0 +1,133 @@
+"""Shared scorer scaffolding: jit wiring, init, and the per-position NLL
+scoring contract the detector and parallel.ShardedScorer program against.
+
+Every scorer family (mlp / gru / logbert) exposes the same surface —
+``init``, ``score``, ``train_step``, and the jitted ``_score_impl`` /
+``_token_nlls_impl`` / ``_normscore_impl`` — so the execution layers are
+model-agnostic. The wire-format contract lives here exactly once: token
+batches may arrive as uint16 (the half-width upload format that halves the
+dominant tunneled-TPU transfer cost) and every impl casts back to int32 as
+its first op.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .tokenizer import PAD_ID
+
+
+def token_nll(logits: jax.Array, tokens: jax.Array, topk: int = 0) -> jax.Array:
+    """Per-sequence NLL of the observed non-PAD tokens → [B] fp32.
+
+    This is the anomaly score: a model trained on normal traffic assigns high
+    NLL (= surprise) to unseen token patterns. ``topk > 0`` averages only the
+    k most surprising tokens instead of all of them — a log line that is
+    normal except for one injected value should score on the anomaly, not
+    have it diluted across the other ~30 tokens.
+    """
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
+    mask = (tokens != PAD_ID).astype(jnp.float32)
+    nll = -tok_lp * mask  # PAD positions contribute 0
+    if topk > 0:
+        k = min(topk, nll.shape[-1])
+        top = jax.lax.top_k(nll, k)[0]
+        denom = jnp.minimum(jnp.maximum(mask.sum(-1), 1.0), float(k))
+        return top.sum(-1) / denom
+    return nll.sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+
+
+def positional_z_max(nlls: jax.Array, tokens: jax.Array,
+                     mu: jax.Array, sigma: jax.Array) -> jax.Array:
+    """Per-position-normalized anomaly score: max over positions of
+    ``(NLL - mu_pos) / sigma_pos`` → [B] fp32.
+
+    ``mu``/``sigma`` [S] are calibrated on training traffic. High-entropy
+    positions (random pids, timestamps) get large sigma and self-suppress;
+    low-entropy positions (process names, paths) get small sigma, so an
+    unseen value there produces a large z — the signal a plain sequence-mean
+    NLL dilutes across the other ~30 tokens. All-PAD rows score 0.
+    """
+    mask = tokens != PAD_ID
+    z = (nlls - mu) / sigma
+    z = jnp.where(mask, z, -jnp.inf)
+    zmax = jnp.max(z, axis=-1)
+    # -inf only means an all-PAD row (score 0); +inf is a maximally
+    # anomalous token (NLL overflow) and must stay an alert, not become 0
+    return jnp.where(jnp.isneginf(zmax), 0.0, zmax)
+
+
+class ScorerBase:
+    """Owns the optimizer, jit wiring, and public score/train surface.
+
+    Subclasses provide ``name``, ``_build_model()``, ``_train_impl`` and the
+    three scoring impls (or inherit them from SequenceScorerBase).
+    """
+
+    name = "base"
+
+    def __init__(self, config: Any):
+        self.config = config
+        self.model = self._build_model()
+        self.optimizer = optax.adamw(config.learning_rate)
+        self._score = jax.jit(self._score_impl)
+        self._train = jax.jit(self._train_impl)
+        self._token_nlls = jax.jit(self._token_nlls_impl)
+        self._normscore = jax.jit(self._normscore_impl)
+
+    # -- subclass hooks -------------------------------------------------
+    def _build_model(self):
+        raise NotImplementedError
+
+    def _train_impl(self, params, opt_state, rng, tokens):
+        raise NotImplementedError
+
+    def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def _normscore_impl(self, params, tokens: jax.Array,
+                        mu: jax.Array, sigma: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    # -- shared surface -------------------------------------------------
+    def init(self, rng: jax.Array) -> Tuple[Any, Any]:
+        dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
+        params = self.model.init(rng, dummy)
+        return params, self.optimizer.init(params)
+
+    def score(self, params, tokens) -> jax.Array:
+        return self._score(params, tokens)
+
+    def train_step(self, params, opt_state, rng, tokens):
+        return self._train(params, opt_state, rng, tokens)
+
+
+class SequenceScorerBase(ScorerBase):
+    """Scoring impls for models with per-position [B, S, V] logits (gru,
+    logbert): anomaly score = (top-k) mean NLL of the observed tokens."""
+
+    def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
+        # tokens may arrive as uint16 (half-width wire format); int32 inside
+        tokens = tokens.astype(jnp.int32)
+        return token_nll(self.model.apply(params, tokens), tokens,
+                         topk=getattr(self.config, "score_topk", 0))
+
+    def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
+        """[B, S] per-position NLL (PAD positions → 0)."""
+        tokens = tokens.astype(jnp.int32)
+        logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
+        tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
+        return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
+
+    def _normscore_impl(self, params, tokens: jax.Array,
+                        mu: jax.Array, sigma: jax.Array) -> jax.Array:
+        tokens = tokens.astype(jnp.int32)
+        return positional_z_max(self._token_nlls_impl(params, tokens),
+                                tokens, mu, sigma)

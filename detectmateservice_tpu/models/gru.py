@@ -28,14 +28,14 @@ it unchanged.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import optax
 
-from .logbert import positional_z_max, token_nll
+from .base import SequenceScorerBase
 from .tokenizer import PAD_ID
 
 
@@ -90,44 +90,17 @@ def causal_lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return -(tok_lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
-class GRUScorer:
-    """Bundles model/optimizer with jit-compiled score and train steps."""
+class GRUScorer(SequenceScorerBase):
+    """Causal GRU LM scorer (jit wiring + NLL scoring from
+    SequenceScorerBase; this class owns only the model and its loss)."""
 
     name = "gru"
 
     def __init__(self, config: Optional[GRUScorerConfig] = None):
-        self.config = config or GRUScorerConfig()
-        self.model = GRULM(self.config)
-        self.optimizer = optax.adamw(self.config.learning_rate)
-        self._score = jax.jit(self._score_impl)
-        self._train = jax.jit(self._train_impl)
-        self._token_nlls = jax.jit(self._token_nlls_impl)
-        self._normscore = jax.jit(self._normscore_impl)
+        super().__init__(config or GRUScorerConfig())
 
-    def init(self, rng: jax.Array) -> Tuple[Any, Any]:
-        dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
-        params = self.model.init(rng, dummy)
-        return params, self.optimizer.init(params)
-
-    # -- jitted impls ---------------------------------------------------
-    def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
-        # tokens may arrive as uint16 (half-width wire format); int32 inside
-        tokens = tokens.astype(jnp.int32)
-        return token_nll(self.model.apply(params, tokens), tokens,
-                         topk=self.config.score_topk)
-
-    def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
-        """[B, S] per-position autoregressive NLL (PAD positions → 0)."""
-        tokens = tokens.astype(jnp.int32)
-        logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
-        tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
-        return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
-
-    def _normscore_impl(self, params, tokens: jax.Array,
-                        mu: jax.Array, sigma: jax.Array) -> jax.Array:
-        tokens = tokens.astype(jnp.int32)
-        return positional_z_max(self._token_nlls_impl(params, tokens),
-                                tokens, mu, sigma)
+    def _build_model(self) -> GRULM:
+        return GRULM(self.config)
 
     def _train_impl(self, params, opt_state, rng, tokens):
         del rng  # teacher forcing is deterministic; no corruption step
@@ -139,10 +112,3 @@ class GRUScorer:
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
-
-    # -- public API -----------------------------------------------------
-    def score(self, params, tokens) -> jax.Array:
-        return self._score(params, tokens)
-
-    def train_step(self, params, opt_state, rng, tokens):
-        return self._train(params, opt_state, rng, tokens)

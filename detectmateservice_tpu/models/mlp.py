@@ -12,13 +12,14 @@ embeddings and draw low probability, so novelty shows up directly as surprise
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import optax
 
+from .base import ScorerBase, positional_z_max
 from .tokenizer import PAD_ID
 
 
@@ -58,24 +59,18 @@ def bag_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return -(tok_lp * mask).sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
 
 
-class MLPScorer:
-    """Same score/train surface as LogBERTScorer — the detector is agnostic."""
+class MLPScorer(ScorerBase):
+    """Bag-of-tokens scorer. Jit wiring/init/score/train_step come from
+    ScorerBase; the impls are custom because the model emits ONE context
+    distribution per sequence ([B, V] logits), not per-position [B, S, V]."""
 
     name = "mlp"
 
     def __init__(self, config: Optional[MLPScorerConfig] = None):
-        self.config = config or MLPScorerConfig()
-        self.model = EmbedMLPModel(self.config)
-        self.optimizer = optax.adamw(self.config.learning_rate)
-        self._score = jax.jit(self._score_impl)
-        self._train = jax.jit(self._train_impl)
-        self._token_nlls = jax.jit(self._token_nlls_impl)
-        self._normscore = jax.jit(self._normscore_impl)
+        super().__init__(config or MLPScorerConfig())
 
-    def init(self, rng: jax.Array) -> Tuple[Any, Any]:
-        dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
-        params = self.model.init(rng, dummy)
-        return params, self.optimizer.init(params)
+    def _build_model(self) -> EmbedMLPModel:
+        return EmbedMLPModel(self.config)
 
     def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
         # tokens may arrive as uint16 (the half-width wire format the
@@ -92,8 +87,6 @@ class MLPScorer:
 
     def _normscore_impl(self, params, tokens: jax.Array,
                         mu: jax.Array, sigma: jax.Array) -> jax.Array:
-        from .logbert import positional_z_max
-
         tokens = tokens.astype(jnp.int32)
         return positional_z_max(self._token_nlls_impl(params, tokens),
                                 tokens, mu, sigma)
@@ -108,9 +101,3 @@ class MLPScorer:
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
-
-    def score(self, params, tokens) -> jax.Array:
-        return self._score(params, tokens)
-
-    def train_step(self, params, opt_state, rng, tokens):
-        return self._train(params, opt_state, rng, tokens)

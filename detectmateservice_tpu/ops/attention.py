@@ -10,6 +10,8 @@ above it the pallas kernel avoids materializing the [S, T] logits in HBM.
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Optional
 
 import jax
@@ -20,6 +22,24 @@ import jax.numpy as jnp
 # fp32 logits are also 1 GB/batch-head and OOM first); below this the einsum
 # path stays — one MXU tile, nothing for a kernel to save
 FLASH_MIN_SEQ = 2048
+
+# (mesh, batch_axis, seq_axis) for impl="ring" — set by the execution layer
+# (parallel.ShardedScorer) around tracing so the *model* stays mesh-agnostic:
+# the same LogBERT module scores single-device, dp×tp, or sequence-parallel
+# purely by who wraps the call
+_RING_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "dm_ring_attention_ctx", default=None)
+
+
+@contextlib.contextmanager
+def ring_context(mesh, batch_axis: Optional[str] = None, axis_name: str = "seq"):
+    """Make ``impl="ring"`` resolvable inside model code traced under this
+    scope. Tracing-time only — compiled executables keep the mesh baked in."""
+    token = _RING_CTX.set((mesh, batch_axis, axis_name))
+    try:
+        yield
+    finally:
+        _RING_CTX.reset(token)
 
 
 def attention(
@@ -32,12 +52,26 @@ def attention(
     """Route to the right attention implementation.
 
     ``impl``: "auto" (flash on TPU for long sequences, einsum otherwise),
-    "einsum", "flash", or "blockwise". The mask here is the scorer's
-    PAD-key form ([B, T]); the einsum/blockwise paths broadcast it."""
+    "einsum", "flash", "blockwise", or "ring" (sequence-parallel exact
+    attention over the mesh provided via ``ring_context``). The mask here is
+    the scorer's PAD-key form ([B, T]); einsum/blockwise broadcast it, ring
+    uses it as per-shard key validity."""
     t = k.shape[2]
     if impl == "auto":
         on_tpu = any(d.platform == "tpu" for d in jax.devices())
         impl = "flash" if (on_tpu and t >= FLASH_MIN_SEQ) else "einsum"
+    if impl == "ring":
+        ctx = _RING_CTX.get()
+        if ctx is None:
+            raise ValueError(
+                "attention impl='ring' needs a sequence mesh: run the model "
+                "through parallel.ShardedScorer with a 'seq' mesh axis (or "
+                "wrap the call in ops.attention.ring_context)")
+        mesh, batch_axis, axis_name = ctx
+        from ..parallel.ring import ring_attention
+
+        return ring_attention(q, k, v, mesh, kv_valid=key_mask,
+                              axis_name=axis_name, batch_axis=batch_axis)
     if impl == "flash":
         from .flash import flash_attention
 

@@ -85,12 +85,14 @@ def tree_shardings(mesh: Mesh, tree: Any,
 
     def _one(path, leaf):
         spec = partition_spec_for(_path_str(path), rules)
-        # validate divisibility; replicate on mismatch rather than crash
+        # replicate rather than crash when a rule references a mesh axis this
+        # mesh doesn't have (e.g. LOGBERT_RULES on a data×seq mesh with no
+        # 'model' axis) or when the axis doesn't divide the param dim
         if hasattr(leaf, "shape"):
             for dim, axis in zip(leaf.shape, tuple(spec) + (None,) * 8):
                 if axis is None:
                     continue
-                if dim % mesh.shape[axis] != 0:
+                if axis not in mesh.shape or dim % mesh.shape[axis] != 0:
                     spec = P()
                     break
         return NamedSharding(mesh, spec)

@@ -24,21 +24,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.attention import blockwise_attention_step
 
 
-def _ring_attention_shard(q, k, v, kv_valid, axis_name: str):
+def _ring_attention_shard(q, k, v, kv_valid, axis_name: str,
+                          vary_axes: tuple = ()):
     """Per-device body. q/k/v: [B, H, Sl, D] local shards; kv_valid: [B, Sl]
-    bool validity (PAD masking) for the local key shard."""
+    bool validity (PAD masking) for the local key shard.
+
+    The hop loop is ``lax.scan`` (not fori_loop) so the whole ring is
+    reverse-mode differentiable — ppermute's transpose is the inverted
+    permutation — which is what lets the flagship *training* step run under a
+    sequence-parallel mesh, not just inference."""
     n = jax.lax.axis_size(axis_name)
     b, h, s_local, d = q.shape
 
-    # mark the accumulators as device-varying over the ring axis so the scan
-    # carry type matches (jax >= 0.8 shard_map vma check)
-    vary = lambda t: jax.lax.pcast(t, (axis_name,), to="varying")
+    # mark the accumulators as device-varying over every manually-mapped
+    # mesh axis (ring axis + optional batch axis) so the scan carry type
+    # matches (jax >= 0.8 shard_map vma check)
+    vary = lambda t: jax.lax.pcast(t, vary_axes or (axis_name,), to="varying")
     acc = vary(jnp.zeros((b, h, s_local, d), jnp.float32))
     row_max = vary(jnp.full((b, h, s_local), jnp.finfo(jnp.float32).min, jnp.float32))
     row_sum = vary(jnp.zeros((b, h, s_local), jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(i, carry):
+    def body(carry, _):
         acc, row_max, row_sum, k_blk, v_blk, valid_blk = carry
         mask = jnp.broadcast_to(valid_blk[:, None, None, :], (b, h, s_local, s_local))
         acc, row_max, row_sum = blockwise_attention_step(
@@ -48,10 +55,10 @@ def _ring_attention_shard(q, k, v, kv_valid, axis_name: str):
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         valid_blk = jax.lax.ppermute(valid_blk, axis_name, perm)
-        return acc, row_max, row_sum, k_blk, v_blk, valid_blk
+        return (acc, row_max, row_sum, k_blk, v_blk, valid_blk), None
 
-    acc, row_max, row_sum, *_ = jax.lax.fori_loop(
-        0, n, body, (acc, row_max, row_sum, k, v, kv_valid)
+    (acc, row_max, row_sum, *_), _ = jax.lax.scan(
+        body, (acc, row_max, row_sum, k, v, kv_valid), None, length=n
     )
     return (acc / jnp.maximum(row_sum[..., None], 1e-30)).astype(q.dtype)
 
@@ -61,18 +68,23 @@ def ring_attention(
     mesh: Mesh,
     kv_valid: Optional[jax.Array] = None,
     axis_name: str = "seq",
+    batch_axis: Optional[str] = None,
 ) -> jax.Array:
     """Exact attention with q/k/v sharded on the sequence dim of ``mesh``.
 
     q/k/v: [B, H, S, D] global; S must divide by mesh.shape[axis_name].
     kv_valid: optional [B, S] bool (False = PAD key, excluded everywhere).
+    ``batch_axis`` names a mesh axis to shard the batch dim over as well
+    (dp×sp: each data-replica row runs its own independent ring).
     """
     if kv_valid is None:
         kv_valid = jnp.ones((q.shape[0], q.shape[2]), dtype=bool)
-    spec_qkv = P(None, None, axis_name, None)
-    spec_valid = P(None, axis_name)
+    spec_qkv = P(batch_axis, None, axis_name, None)
+    spec_valid = P(batch_axis, axis_name)
+    vary_axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
     fn = jax.shard_map(
-        partial(_ring_attention_shard, axis_name=axis_name),
+        partial(_ring_attention_shard, axis_name=axis_name,
+                vary_axes=vary_axes),
         mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_valid),
         out_specs=spec_qkv,

@@ -18,9 +18,9 @@ import numpy as np
 from ..models.tokenizer import narrow_tokens
 from .mesh import (
     AXIS_DATA,
+    AXIS_SEQ,
     LOGBERT_RULES,
     REPLICATED_RULES,
-    batch_sharding,
     make_mesh,
     tree_shardings,
 )
@@ -44,17 +44,49 @@ class ShardedScorer:
         self.mesh = mesh if mesh is not None else make_mesh()
         if rules is None:
             rules = LOGBERT_RULES if getattr(scorer, "name", "") == "logbert" else REPLICATED_RULES
+        # sequence parallelism (long-context): a 'seq' mesh axis shards the
+        # token/activation sequence dim; the model's attention runs as ring
+        # attention over that axis (ops.attention impl="ring", resolved via
+        # the ring_context this wrapper sets around tracing). Each 'data' row
+        # runs its own independent ring.
+        self._seq_axis = AXIS_SEQ if AXIS_SEQ in self.mesh.shape else None
+        if self._seq_axis is not None:
+            seq_size = int(self.mesh.shape[AXIS_SEQ])
+            seq_len = getattr(getattr(scorer, "config", None), "seq_len", None)
+            if seq_len is not None and seq_len % seq_size != 0:
+                raise ValueError(
+                    f"seq_len {seq_len} must divide by the seq mesh axis "
+                    f"({seq_size}) for sequence-parallel scoring")
         # token batches travel in the narrow wire format (uint16 when the
         # vocab fits — models.tokenizer.narrow_tokens has the one rule); the
         # jitted impls cast back to int32 on device
         self._vocab_size = getattr(getattr(scorer, "config", None),
                                    "vocab_size", 1 << 31)
-        params, opt_state = scorer.init(rng if rng is not None else jax.random.PRNGKey(0))
+        self._data_axis = AXIS_DATA if AXIS_DATA in self.mesh.shape else None
+        # init also traces the model (flax shape inference) so it needs the
+        # ring context on a seq mesh — but with the batch axis REPLICATED:
+        # flax init runs on a [1, S] dummy, and a batch of 1 cannot shard
+        # over a data axis of 2+
+        init_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if self._seq_axis is None:
+            params, opt_state = scorer.init(init_rng)
+        else:
+            from ..ops.attention import ring_context
+
+            with ring_context(self.mesh, batch_axis=None,
+                              axis_name=self._seq_axis):
+                params, opt_state = scorer.init(init_rng)
         self._param_sharding = tree_shardings(self.mesh, params, rules)
         self._opt_sharding = tree_shardings(self.mesh, opt_state, rules)
         self.params = jax.device_put(params, self._param_sharding)
         self.opt_state = jax.device_put(opt_state, self._opt_sharding)
-        self._batch_sharding = batch_sharding(self.mesh, AXIS_DATA)
+        # tokens are [B, S]: batch over 'data' when present, sequence over
+        # 'seq' when present — so activations start out seq-sharded and the
+        # ring's shard_map needs no initial reshard
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._batch_sharding = NamedSharding(
+            self.mesh, P(self._data_axis, self._seq_axis))
 
         self._score = jax.jit(
             scorer._score_impl,
@@ -80,6 +112,18 @@ class ShardedScorer:
     def data_parallelism(self) -> int:
         return int(self.mesh.shape.get(AXIS_DATA, 1))
 
+    def _traced(self, fn, *args):
+        """Invoke a jitted fn; on a seq mesh, tracing happens inside
+        ring_context so the model's ``attention(impl="ring")`` resolves to
+        this mesh. Trace-time only: cached executions skip the context."""
+        if self._seq_axis is None:
+            return fn(*args)
+        from ..ops.attention import ring_context
+
+        with ring_context(self.mesh, batch_axis=self._data_axis,
+                          axis_name=self._seq_axis):
+            return fn(*args)
+
     def _pad_batch(self, tokens: np.ndarray) -> Tuple[np.ndarray, int]:
         """Pad the batch to a multiple of the data-axis size (and narrow to
         the wire dtype — see __init__)."""
@@ -94,7 +138,7 @@ class ShardedScorer:
     def score(self, tokens: np.ndarray) -> np.ndarray:
         tokens, n = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return np.asarray(self._score(self.params, tokens))[:n]
+        return np.asarray(self._traced(self._score, self.params, tokens))[:n]
 
     def score_device(self, tokens: np.ndarray) -> jax.Array:
         """Asynchronous scoring: dispatch and return the device array without
@@ -103,19 +147,19 @@ class ShardedScorer:
         overlap readback with the next batch's featurization."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return self._score(self.params, tokens)
+        return self._traced(self._score, self.params, tokens)
 
     def token_nlls_device(self, tokens: np.ndarray) -> jax.Array:
         """[n, S] → [n_padded, S] per-position NLLs on device."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return self._token_nlls(self.params, tokens)
+        return self._traced(self._token_nlls, self.params, tokens)
 
     def normscore_device(self, tokens: np.ndarray, mu, sigma) -> jax.Array:
         """Per-position-normalized scores (models.logbert.positional_z_max)."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return self._normscore(self.params, tokens, mu, sigma)
+        return self._traced(self._normscore, self.params, tokens, mu, sigma)
 
     def train_step(self, rng: jax.Array, tokens: np.ndarray) -> float:
         # pad by wrapping real rows, NOT zeros: synthetic all-PAD rows would
@@ -132,7 +176,7 @@ class ShardedScorer:
             tokens = tokens[np.arange(padded) % n]
         tokens = jax.device_put(narrow_tokens(tokens, self._vocab_size),
                                 self._batch_sharding)
-        self.params, self.opt_state, loss = self._train(
-            self.params, self.opt_state, rng, tokens
+        self.params, self.opt_state, loss = self._traced(
+            self._train, self.params, self.opt_state, rng, tokens
         )
         return float(loss)

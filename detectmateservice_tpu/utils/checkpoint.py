@@ -6,6 +6,7 @@ in-memory only there; "add real model-state checkpoint (orbax-style)").
 from __future__ import annotations
 
 import json
+import threading
 from pathlib import Path
 from typing import Any, Dict, Tuple
 
@@ -13,14 +14,23 @@ import orbax.checkpoint as ocp
 
 _META = "meta.json"
 
+# orbax's in-process save machinery (async manager, tensorstore context,
+# per-process metadata) is not safe under concurrent saves from multiple
+# threads EVEN to distinct directories (observed: "No ArrayMetadata found
+# for process_index=0 in ... .orbax-checkpoint-tmp" under a checkpoint
+# stress test). Saves are rare control-plane ops; serializing them costs
+# nothing and makes concurrent external checkpoint callers safe.
+_SAVE_LOCK = threading.Lock()
+
 
 def save_scorer_state(directory: str, params: Any, opt_state: Any,
                       meta: Dict[str, Any]) -> None:
     path = Path(directory).absolute()
     path.mkdir(parents=True, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path / "params", params, force=True)
-        ckptr.save(path / "opt_state", opt_state, force=True)
+    with _SAVE_LOCK:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path / "params", params, force=True)
+            ckptr.save(path / "opt_state", opt_state, force=True)
     (path / _META).write_text(json.dumps(meta))
 
 
