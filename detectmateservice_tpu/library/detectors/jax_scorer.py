@@ -97,6 +97,7 @@ class JaxScorerDetector(CoreDetector):
         self.config: JaxScorerDetectorConfig
         from ...models.tokenizer import HashTokenizer
 
+        self._validate_static_config()
         self._tokenizer = HashTokenizer(
             vocab_size=self.config.vocab_size, seq_len=self.config.seq_len
         )
@@ -134,6 +135,23 @@ class JaxScorerDetector(CoreDetector):
         from collections import deque
 
         self._inflight = deque()
+
+    def _validate_static_config(self) -> None:
+        """Reject bad enum-ish config at CONSTRUCTION (no jax import needed):
+        ops/attention's router silently falls through to einsum for unknown
+        strings, so a typo ('rign') would quietly run the wrong
+        implementation while the operator believes sequence-parallel
+        attention is active. Re-checked in _ensure_scorer for reconfigure."""
+        cfg = self.config
+        if cfg.score_norm not in ("none", "position"):
+            raise LibraryError(
+                f"unknown score_norm {cfg.score_norm!r}; expected 'none' or 'position'")
+        if cfg.attn_impl not in ("auto", "einsum", "flash", "blockwise", "ring"):
+            raise LibraryError(
+                f"unknown attn_impl {cfg.attn_impl!r}; expected 'auto', "
+                "'einsum', 'flash', 'blockwise', or 'ring'")
+        if cfg.model not in ("mlp", "gru", "logbert"):
+            raise LibraryError(f"unknown scorer model {cfg.model!r}")
 
     # -- lifecycle ------------------------------------------------------
     def setup_io(self) -> None:
@@ -183,9 +201,7 @@ class JaxScorerDetector(CoreDetector):
 
         enable_compilation_cache()
         cfg = self.config
-        if cfg.score_norm not in ("none", "position"):
-            raise LibraryError(
-                f"unknown score_norm {cfg.score_norm!r}; expected 'none' or 'position'")
+        self._validate_static_config()
         if cfg.model == "logbert":
             from ...models.logbert import LogBERTConfig, LogBERTScorer
 
@@ -231,7 +247,7 @@ class JaxScorerDetector(CoreDetector):
         # params pinned in device memory once (HBM residency; north-star item)
         self._params = jax.device_put(params, self._device)
         self._opt_state = jax.device_put(opt_state, self._device)
-        if cfg.host_score_max_batch > 0:
+        if cfg.host_score_max_batch > 0 and self._host_scoring_possible():
             try:
                 self._cpu_device = jax.devices("cpu")[0]
                 self._host_score = jax.jit(self._scorer._score_impl,
@@ -240,6 +256,26 @@ class JaxScorerDetector(CoreDetector):
                                                device=self._cpu_device)
             except Exception:
                 self._cpu_device = None  # no CPU backend: accelerator-only
+
+    def _host_scoring_possible(self) -> bool:
+        """Whether the model can run on the host CPU twin at all: the pallas
+        flash kernel is TPU-only (jitting it for the CPU backend fails at
+        trace time) and ring attention is bound to the accelerator mesh, so
+        those attention configs are device-only and small batches ride the
+        device path instead."""
+        cfg = self.config
+        if cfg.model != "logbert":
+            return True
+        if cfg.attn_impl in ("flash", "ring"):
+            return False
+        if cfg.attn_impl == "auto":
+            # auto picks flash on TPU for long sequences — and the decision
+            # is made while tracing for the CPU device too (it checks the
+            # platform of jax.devices(), not the jit target)
+            from ...ops.attention import FLASH_MIN_SEQ
+
+            return cfg.seq_len < FLASH_MIN_SEQ
+        return True
 
     def _sync_host_params(self) -> None:
         """Mirror the current params onto the host CPU backend (one transfer,

@@ -20,25 +20,34 @@ import optax
 from .tokenizer import PAD_ID
 
 
+def reduce_nlls(nlls: jax.Array, mask: jax.Array, topk: int = 0) -> jax.Array:
+    """[B, S] per-position NLLs (PAD = 0) + fp32 mask → [B] sequence score.
+
+    ``topk > 0`` averages only the k most surprising tokens instead of all
+    of them — a log line that is normal except for one injected value should
+    score on the anomaly, not have it diluted across the other ~30 tokens.
+    The single home of this reduction: token_nll (calibration/tests) and
+    SequenceScorerBase._score_impl (the chunked hot path) both call it, so
+    the two can never desynchronize.
+    """
+    if topk > 0:
+        k = min(topk, nlls.shape[-1])
+        top = jax.lax.top_k(nlls, k)[0]
+        denom = jnp.minimum(jnp.maximum(mask.sum(-1), 1.0), float(k))
+        return top.sum(-1) / denom
+    return nlls.sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+
+
 def token_nll(logits: jax.Array, tokens: jax.Array, topk: int = 0) -> jax.Array:
     """Per-sequence NLL of the observed non-PAD tokens → [B] fp32.
 
-    This is the anomaly score: a model trained on normal traffic assigns high
-    NLL (= surprise) to unseen token patterns. ``topk > 0`` averages only the
-    k most surprising tokens instead of all of them — a log line that is
-    normal except for one injected value should score on the anomaly, not
-    have it diluted across the other ~30 tokens.
+    This is the anomaly score: a model trained on normal traffic assigns
+    high NLL (= surprise) to unseen token patterns.
     """
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
     mask = (tokens != PAD_ID).astype(jnp.float32)
-    nll = -tok_lp * mask  # PAD positions contribute 0
-    if topk > 0:
-        k = min(topk, nll.shape[-1])
-        top = jax.lax.top_k(nll, k)[0]
-        denom = jnp.minimum(jnp.maximum(mask.sum(-1), 1.0), float(k))
-        return top.sum(-1) / denom
-    return nll.sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+    return reduce_nlls(-tok_lp * mask, mask, topk)  # PAD positions are 0
 
 
 def positional_z_max(nlls: jax.Array, tokens: jax.Array,
@@ -110,21 +119,63 @@ class ScorerBase:
 
 
 class SequenceScorerBase(ScorerBase):
-    """Scoring impls for models with per-position [B, S, V] logits (gru,
-    logbert): anomaly score = (top-k) mean NLL of the observed tokens."""
+    """Scoring impls for models with per-position predictions (gru, logbert):
+    anomaly score = (top-k) mean NLL of the observed tokens.
+
+    NLLs are computed in **sequence chunks** against the model's [B, S, D]
+    hidden states (``model.hidden``) instead of taking the [B, S, V] logits
+    tensor from ``__call__``: at V=32k a 16k-row micro-batch's logits alone
+    are 64 GB — far past HBM — while the chunked path's high-water mark is
+    B×Sc×V with Sc chosen to fit. Training keeps the direct logits path
+    (train batches are small); scoring is where the big batches live.
+    """
+
+    # fp32 elements the per-chunk logits may occupy (~1 GB); the largest
+    # divisor of S that fits becomes the chunk length
+    _CHUNK_ELEMENT_BUDGET = 1 << 28
 
     def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
         # tokens may arrive as uint16 (half-width wire format); int32 inside
         tokens = tokens.astype(jnp.int32)
-        return token_nll(self.model.apply(params, tokens), tokens,
-                         topk=getattr(self.config, "score_topk", 0))
+        nlls = self._token_nlls_impl(params, tokens)
+        mask = (tokens != PAD_ID).astype(jnp.float32)
+        return reduce_nlls(nlls, mask, getattr(self.config, "score_topk", 0))
 
     def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
-        """[B, S] per-position NLL (PAD positions → 0)."""
+        """[B, S] per-position NLL (PAD positions → 0), chunked over S."""
         tokens = tokens.astype(jnp.int32)
-        logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
-        tok_lp = jnp.take_along_axis(logprobs, tokens[..., None], axis=-1)[..., 0]
-        return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
+        dtype = getattr(self.config, "dtype", jnp.bfloat16)
+        # bf16 multiplies with fp32 accumulation (MXU-native); identical
+        # formulation to the models' __call__ head so full and chunked
+        # paths agree bit-for-bit
+        hidden = self.model.apply(params, tokens, method="hidden").astype(dtype)
+        emb = params["params"]["tok_embed"]["embedding"].astype(dtype)
+        b, s, d = hidden.shape
+        v = emb.shape[0]
+        sc = max(1, min(s, self._CHUNK_ELEMENT_BUDGET // max(1, b * v)))
+        while s % sc:
+            sc -= 1
+        n_chunks = s // sc
+        if n_chunks == 1:
+            logits = jnp.einsum("bsd,vd->bsv", hidden, emb,
+                                preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, tokens[..., None], axis=-1)[..., 0]
+            return -(tgt - lse) * (tokens != PAD_ID).astype(jnp.float32)
+        h = hidden.reshape(b, n_chunks, sc, d).transpose(1, 0, 2, 3)
+        t = tokens.reshape(b, n_chunks, sc).transpose(1, 0, 2)
+
+        def step(carry, ht):
+            h_c, t_c = ht
+            logits = jnp.einsum("bsd,vd->bsv", h_c, emb,
+                                preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+            return carry, tgt - lse  # [B, Sc] log-probs
+
+        _, lp = jax.lax.scan(step, None, (h, t))
+        lp = lp.transpose(1, 0, 2).reshape(b, s)
+        return -lp * (tokens != PAD_ID).astype(jnp.float32)
 
     def _normscore_impl(self, params, tokens: jax.Array,
                         mu: jax.Array, sigma: jax.Array) -> jax.Array:

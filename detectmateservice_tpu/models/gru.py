@@ -55,31 +55,42 @@ class GRUScorerConfig:
 class GRULM(nn.Module):
     config: GRUScorerConfig
 
-    @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
-        """[B, S] int32 → [B, S, V] fp32 causal next-token logits.
+    def setup(self) -> None:
+        cfg = self.config
+        self.tok_embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.bos_embed = self.param(
+            "bos_embed", nn.initializers.normal(0.02), (cfg.dim,))
+        self.rnns = [nn.RNN(nn.GRUCell(features=cfg.dim, dtype=cfg.dtype))
+                     for _ in range(cfg.depth)]
+        self.final_ln = nn.LayerNorm(dtype=cfg.dtype)
 
-        Position t's logits are computed from tokens[<t] plus a learned BOS
+    def hidden(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, S, D] fp32 causal hidden states (pre-head).
+
+        Position t's state is computed from tokens[<t] plus a learned BOS
         embedding, so every position (including 0) has a real prediction and
         the per-position NLLs line up 1:1 with the input tokens — the same
         alignment contract positional_z_max and the calibration pass assume.
-        """
+        Exposed separately for the chunked NLL path (models/base.py)."""
         cfg = self.config
-        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                         name="tok_embed")
-        bos = self.param("bos_embed", nn.initializers.normal(0.02), (cfg.dim,))
-        emb = embed(tokens)                      # [B, S, D]
+        emb = self.tok_embed(tokens)             # [B, S, D]
         # teacher-forced shift-right: the input at step t is token t-1
         x = jnp.concatenate(
-            [jnp.broadcast_to(bos.astype(cfg.dtype),
+            [jnp.broadcast_to(self.bos_embed.astype(cfg.dtype),
                               (tokens.shape[0], 1, cfg.dim)),
              emb[:, :-1]], axis=1)
-        for i in range(cfg.depth):
-            cell = nn.GRUCell(features=cfg.dim, dtype=cfg.dtype,
-                              name=f"gru_{i}")
-            x = nn.RNN(cell, name=f"rnn_{i}")(x)  # lax.scan over time
-        x = nn.LayerNorm(dtype=cfg.dtype)(x)
-        return embed.attend(x.astype(jnp.float32))  # weight-tied head
+        for rnn in self.rnns:
+            x = rnn(x)                           # lax.scan over time
+        return self.final_ln(x).astype(jnp.float32)
+
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, S, V] fp32 causal next-token logits
+        (weight-tied einsum head, bf16 multiplies / fp32 accumulation —
+        see LogBERT.__call__)."""
+        cfg = self.config
+        return jnp.einsum("bsd,vd->bsv", self.hidden(tokens).astype(cfg.dtype),
+                          self.tok_embed.embedding.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
 
 
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:

@@ -74,21 +74,42 @@ class Block(nn.Module):
 class LogBERT(nn.Module):
     config: LogBERTConfig
 
-    @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
-        """[B, S] int32 → [B, S, V] fp32 logits."""
+    def setup(self) -> None:
         cfg = self.config
-        pad_mask = tokens != PAD_ID
-        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")
-        pos = self.param(
+        self.tok_embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.pos_embed = self.param(
             "pos_embed", nn.initializers.normal(0.02), (cfg.seq_len, cfg.dim)
         )
-        x = embed(tokens) + pos[None, : tokens.shape[1]].astype(cfg.dtype)
-        for i in range(cfg.depth):
-            x = Block(cfg, name=f"block_{i}")(x, pad_mask)
-        x = nn.LayerNorm(dtype=cfg.dtype)(x)
-        logits = embed.attend(x.astype(jnp.float32))  # weight-tied output head
-        return logits
+        self.blocks = [Block(cfg) for _ in range(cfg.depth)]
+        self.final_ln = nn.LayerNorm(dtype=cfg.dtype)
+
+    def hidden(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, S, D] fp32 final hidden states (pre-head).
+
+        Exposed separately (``apply(..., method="hidden")``) so the scorer
+        can compute NLLs in sequence chunks without ever materializing the
+        [B, S, V] logits tensor — at V=32k and large micro-batches that
+        tensor alone exceeds HBM (models/base.py chunked NLL)."""
+        cfg = self.config
+        pad_mask = tokens != PAD_ID
+        x = self.tok_embed(tokens) + self.pos_embed[
+            None, : tokens.shape[1]].astype(cfg.dtype)
+        for blk in self.blocks:
+            x = blk(x, pad_mask)
+        return self.final_ln(x).astype(jnp.float32)
+
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, S, V] fp32 logits (weight-tied head).
+
+        The head is an explicit einsum with bf16 multiplies and fp32
+        accumulation (MXU-native) rather than ``Embed.attend`` (bf16
+        accumulation): fp32 logits keep the loss numerics stable and the
+        formulation matches the chunked scoring path bit-for-bit.
+        """
+        cfg = self.config
+        return jnp.einsum("bsd,vd->bsv", self.hidden(tokens).astype(cfg.dtype),
+                          self.tok_embed.embedding.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
 
 
 def masked_lm_loss(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:

@@ -14,6 +14,17 @@ import orbax.checkpoint as ocp
 
 _META = "meta.json"
 
+# Param-tree layout version, stamped into every checkpoint's meta.json.
+# Bump when a model refactor renames the flax param paths (v2: the
+# compact→setup() restructure renamed block_i→blocks_i, LayerNorm_0→final_ln,
+# rnn_i/gru_i→rnns_i/cell). Restore against a different version fails with a
+# clear message instead of orbax's opaque missing-key error.
+TREE_VERSION = 2
+
+
+class CheckpointFormatError(RuntimeError):
+    """Checkpoint param-tree layout does not match this build."""
+
 # orbax's in-process save machinery (async manager, tensorstore context,
 # per-process metadata) is not safe under concurrent saves from multiple
 # threads EVEN to distinct directories (observed: "No ArrayMetadata found
@@ -31,14 +42,24 @@ def save_scorer_state(directory: str, params: Any, opt_state: Any,
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(path / "params", params, force=True)
             ckptr.save(path / "opt_state", opt_state, force=True)
-    (path / _META).write_text(json.dumps(meta))
+    (path / _META).write_text(json.dumps({**meta, "tree_version": TREE_VERSION}))
 
 
 def load_scorer_state(directory: str, params_template: Any,
                       opt_state_template: Any) -> Tuple[Any, Any, Dict[str, Any]]:
     path = Path(directory).absolute()
+    # meta first: a tree-version mismatch must produce an actionable error,
+    # not orbax's missing-key traceback halfway through the restore
+    meta = json.loads((path / _META).read_text())
+    found = meta.get("tree_version", 1)
+    if found != TREE_VERSION:
+        raise CheckpointFormatError(
+            f"checkpoint at {path} has param-tree version {found}, this "
+            f"build expects {TREE_VERSION}; the flax module layout changed "
+            "(param paths were renamed), so this checkpoint cannot be "
+            "restored directly — refit the scorer, or migrate the "
+            "checkpoint by renaming its param keys to the new layout")
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(path / "params", params_template)
         opt_state = ckptr.restore(path / "opt_state", opt_state_template)
-    meta = json.loads((path / _META).read_text())
     return params, opt_state, meta

@@ -168,6 +168,49 @@ class TestCheckpoint:
         assert isinstance(out, list)
 
 
+class TestConfigValidation:
+    def test_unknown_attn_impl_fails_at_construction(self):
+        """ops/attention's router silently falls through to einsum for
+        unknown strings, so a typo must be caught at configure time."""
+        from detectmateservice_tpu.library.common.core import LibraryError
+
+        with pytest.raises(LibraryError, match="attn_impl"):
+            JaxScorerDetector(config=scorer_config(model="logbert",
+                                                   attn_impl="rign"))
+
+    def test_flash_attn_disables_host_twin(self):
+        """The pallas flash kernel is TPU-only; a flash-configured logbert
+        must not build the CPU scoring twin it cannot compile."""
+        det = JaxScorerDetector(config=scorer_config(
+            model="logbert", depth=1, heads=2, attn_impl="flash",
+            host_score_max_batch=8))
+        assert not det._host_scoring_possible()
+
+    def test_einsum_attn_keeps_host_twin(self):
+        det = JaxScorerDetector(config=scorer_config(
+            model="logbert", depth=1, heads=2, attn_impl="einsum",
+            host_score_max_batch=8))
+        det._ensure_scorer()
+        assert det._cpu_device is not None
+
+
+class TestCheckpointTreeVersion:
+    def test_old_tree_version_fails_with_clear_error(self, tmp_path,
+                                                     trained_detector):
+        import json
+
+        from detectmateservice_tpu.utils.checkpoint import CheckpointFormatError
+
+        trained_detector.save_checkpoint(str(tmp_path / "ckpt"))
+        meta_path = tmp_path / "ckpt" / "meta.json"
+        meta = json.loads(meta_path.read_text())
+        meta.pop("tree_version")  # simulate a pre-restructure checkpoint
+        meta_path.write_text(json.dumps(meta))
+        fresh = JaxScorerDetector(config=scorer_config())
+        with pytest.raises(CheckpointFormatError, match="tree version"):
+            fresh.load_checkpoint(str(tmp_path / "ckpt"))
+
+
 class TestSingleMessageTraining:
     def test_per_message_training_populates_buffer_and_alerts(self):
         # engine_batch_size=1 parity mode: every message goes through

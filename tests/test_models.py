@@ -170,6 +170,28 @@ class TestScorers:
         s_rev = float(np.asarray(scorer.score(params, rev))[0])
         assert s_rev > s_fwd + 0.5
 
+    @pytest.mark.parametrize("fixture", ["gru", "logbert"])
+    def test_chunked_nlls_match_full_logits(self, fixture, request, monkeypatch):
+        """The chunked NLL path (sequence chunks against hidden states; what
+        keeps huge micro-batches inside HBM) must match the full [B, S, V]
+        logits computation exactly."""
+        from detectmateservice_tpu.models.base import SequenceScorerBase, token_nll
+
+        scorer, params, _ = request.getfixturevalue(fixture)
+        tokens = np.random.randint(3, 512, (4, 8)).astype(np.int32)
+        tokens[:, -2:] = PAD_ID
+        full_logits = scorer.model.apply(params, tokens)
+        want_nlls = np.asarray(-jax.numpy.take_along_axis(
+            jax.nn.log_softmax(full_logits, -1), jax.numpy.asarray(tokens)[..., None],
+            -1)[..., 0] * (tokens != PAD_ID))
+        want_score = np.asarray(token_nll(full_logits, jax.numpy.asarray(tokens)))
+        # force multi-chunk: budget of one position's logits per step
+        monkeypatch.setattr(SequenceScorerBase, "_CHUNK_ELEMENT_BUDGET", 4 * 512)
+        got_nlls = np.asarray(scorer._token_nlls_impl(params, tokens))
+        got_score = np.asarray(scorer._score_impl(params, tokens))
+        np.testing.assert_allclose(got_nlls, want_nlls, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(got_score, want_score, rtol=1e-5, atol=1e-5)
+
     def test_gru_token_nlls_align_with_positions(self, gru):
         """Per-position NLLs must be PAD-masked and position-aligned (the
         contract the positional-z calibration relies on)."""
