@@ -35,6 +35,7 @@ from typing import List, Optional, Protocol, runtime_checkable
 
 from ..settings import ServiceSettings
 from . import metrics as m
+from .framing import FramingError, pack_batch, unpack_batch
 from .socket import (
     EngineSocket,
     EngineSocketFactory,
@@ -72,6 +73,13 @@ class BatchProcessor(Protocol):
 
 _RETRY_SLEEP_S = 0.01   # reference: engine.py:291
 _STOP_JOIN_S = 2.0      # reference: engine.py:320
+
+
+def _count_lines(data: bytes) -> int:
+    """The reference's newline line-count rule (engine.py:213): newline
+    count, plus one for a final unterminated line, minimum 1. One home for
+    the expression so read/written/dropped metrics can't desynchronize."""
+    return max(1, data.count(b"\n") + (0 if data.endswith(b"\n") else 1))
 
 
 class Engine:
@@ -193,6 +201,29 @@ class Engine:
         return self._running
 
     # -- hot loop -------------------------------------------------------
+    def _expand_frame(self, raw: bytes, read_b, read_l, err_c) -> List[bytes]:
+        """One wire frame → its messages. Batch frames (framing.py) are
+        auto-detected by magic — the 0xD7 lead byte cannot open a valid
+        protobuf message — so a sender that packs and one that doesn't can
+        share this engine. Read metrics count wire bytes once per frame and
+        lines per contained message (the reference's newline rule)."""
+        read_b.inc(len(raw))
+        try:
+            msgs = unpack_batch(raw)
+        except FramingError as exc:
+            err_c.inc()
+            self.logger.error("corrupt batch frame dropped: %s", exc)
+            return []
+        if msgs is None:
+            msgs = [raw]
+        else:
+            # packed empties get the same fate as plain empty frames (the
+            # loop's `if not raw` / `if nxt` guards): silently skipped
+            msgs = [msg for msg in msgs if msg]
+        for msg in msgs:
+            read_l.inc(_count_lines(msg))
+        return msgs
+
     def _run_loop(self) -> None:
         read_b = m.DATA_READ_BYTES().labels(**self._labels)
         read_l = m.DATA_READ_LINES().labels(**self._labels)
@@ -201,6 +232,13 @@ class Engine:
         batch_fn = getattr(self.processor, "process_batch", None)
         use_batches = batch_size > 1 and callable(batch_fn)
         batch_timeout_s = self.settings.engine_batch_timeout_ms / 1000.0
+        if self.settings.engine_frame_batch > 1 and not use_batches:
+            # results arrive at _send_results one at a time in this mode, so
+            # nothing ever packs — say so instead of silently underdelivering
+            self.logger.warning(
+                "engine_frame_batch=%d has no effect without micro-batching "
+                "(engine_batch_size > 1 and a batch-capable component)",
+                self.settings.engine_frame_batch)
 
         # flush is wired for EVERY processor (not just batched ones): a
         # single-message component may also hold time-windowed state it emits
@@ -234,9 +272,7 @@ class Engine:
                       and callable(drain_fn) else flush_fn)
                 if callable(fn):
                     try:
-                        for out in fn():
-                            if out is not None:
-                                self._send_to_outputs(out)
+                        self._send_results(fn())
                     except Exception as exc:
                         err_c.inc()
                         self.logger.error("idle drain raised: %s", exc)
@@ -249,25 +285,27 @@ class Engine:
                 continue
             if not raw:
                 continue
-            read_b.inc(len(raw))
-            read_l.inc(max(1, raw.count(b"\n") + (0 if raw.endswith(b"\n") else 1)))
+            msgs = self._expand_frame(raw, read_b, read_l, err_c)
+            if not msgs:
+                continue
 
             if not use_batches:
-                try:
-                    out = self.processor.process(raw)
-                except Exception as exc:
-                    err_c.inc()
-                    self.logger.error("process() raised: %s", exc)
-                    continue
-                if out is None:
-                    continue
-                self._send_to_outputs(out)
+                for msg_raw in msgs:
+                    try:
+                        out = self.processor.process(msg_raw)
+                    except Exception as exc:
+                        err_c.inc()
+                        self.logger.error("process() raised: %s", exc)
+                        continue
+                    if out is not None:
+                        self._send_results([out])
                 continue
 
             # micro-batch mode: drain what arrived within the window. The
             # native transport's recv_many takes a whole burst per GIL
-            # crossing; other sockets fall back to one recv per frame.
-            batch = [raw]
+            # crossing; other sockets fall back to one recv per frame. A
+            # packed frame may carry the whole batch in one recv.
+            batch = msgs
             deadline = time.monotonic() + batch_timeout_s
             recv_many = getattr(self._pair_sock, "recv_many", None)
             saved_timeout = None if callable(recv_many) else self._pair_sock.recv_timeout
@@ -286,10 +324,7 @@ class Engine:
                     break
                 for nxt in frames:
                     if nxt:
-                        read_b.inc(len(nxt))
-                        read_l.inc(max(1, nxt.count(b"\n")
-                                       + (0 if nxt.endswith(b"\n") else 1)))
-                        batch.append(nxt)
+                        batch.extend(self._expand_frame(nxt, read_b, read_l, err_c))
             if saved_timeout is not None:
                 self._pair_sock.recv_timeout = saved_timeout
             try:
@@ -298,9 +333,7 @@ class Engine:
                 err_c.inc(len(batch))
                 self.logger.error("process_batch() raised: %s", exc)
                 continue
-            for out in outs:  # in-order, per-message None filtering
-                if out is not None:
-                    self._send_to_outputs(out)
+            self._send_results(outs)  # in-order, per-message None filtering
 
         # loop exiting (stop requested): drain the pipeline before sockets
         # close — flush_final (when provided) also waits out work the
@@ -308,19 +341,38 @@ class Engine:
         final_fn = getattr(self.processor, "flush_final", None) or flush_fn
         if callable(final_fn):
             try:
-                for out in final_fn():
-                    if out is not None:
-                        self._send_to_outputs(out)
+                self._send_results(final_fn())
             except Exception as exc:
                 self.logger.error("flush at stop raised: %s", exc)
 
     # -- fan-out --------------------------------------------------------
-    def _send_to_outputs(self, data: bytes) -> bool:
+    def _send_results(self, outs) -> None:
+        """Fan out processor results, packing ``engine_frame_batch`` of them
+        per wire frame when configured (>1). Packing amortizes the
+        per-message socket cost that otherwise caps the stage-to-stage rate;
+        the default of 1 keeps the wire single-message for reference-style
+        peers. Downstream framework engines auto-detect either format."""
+        pending = [o for o in outs if o is not None]
+        frame_batch = getattr(self.settings, "engine_frame_batch", 1)
+        if frame_batch <= 1:
+            for out in pending:
+                self._send_to_outputs(out)
+            return
+        for start in range(0, len(pending), frame_batch):
+            chunk = pending[start:start + frame_batch]
+            if len(chunk) == 1:
+                self._send_to_outputs(chunk[0])
+            else:
+                self._send_to_outputs(pack_batch(chunk),
+                                      lines=sum(map(_count_lines, chunk)))
+
+    def _send_to_outputs(self, data: bytes, lines: Optional[int] = None) -> bool:
         written_b = m.DATA_WRITTEN_BYTES().labels(**self._labels)
         written_l = m.DATA_WRITTEN_LINES().labels(**self._labels)
         dropped_b = m.DATA_DROPPED_BYTES().labels(**self._labels)
         dropped_l = m.DATA_DROPPED_LINES().labels(**self._labels)
-        lines = max(1, data.count(b"\n") + (0 if data.endswith(b"\n") else 1))
+        if lines is None:
+            lines = _count_lines(data)
 
         if not self._out_socks:
             # no outputs: reply on the input pair socket (reference: engine.py:249-259)

@@ -1,0 +1,85 @@
+"""Batch frames: many serialized messages in one wire frame.
+
+The per-message socket cost (zmq enqueue + GIL crossing + syscall amortization)
+caps a single Python sender at ~80k sends/s (measured via
+scripts/bench_service.py) — far below what the TPU detector sustains
+(445k+ lines/s). Packing K messages per frame amortizes that cost K-fold on
+both ends; this is SURVEY.md §7 hard part #3 ("batch *frames* before
+crossing into Python") applied to the whole service mesh, not just ingest.
+
+Wire format (version 1):
+
+    0xD7 'D' 'M' 0x01 | varint n | n × (varint len | len bytes)
+
+The first byte 0xD7 decodes as protobuf field 26 / wire type 7 — wire type 7
+does not exist, so no valid protobuf message (all pipeline schemas are
+protobuf) can begin with it: receivers can safely auto-detect batch frames
+and stay wire-compatible with single-message peers. Senders only emit batch
+frames when ``engine_frame_batch > 1`` is configured, so interop with
+reference-style peers is the default.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+MAGIC = b"\xd7DM\x01"
+
+
+class FramingError(ValueError):
+    """A frame carried the batch magic but its body was malformed."""
+
+
+def _put_varint(out: bytearray, value: int) -> None:
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return
+
+
+def _get_varint(data: bytes, pos: int) -> tuple:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise FramingError("truncated varint in batch frame")
+        byte = data[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise FramingError("varint overflow in batch frame")
+
+
+def pack_batch(messages: List[bytes]) -> bytes:
+    """Pack serialized messages into one batch frame."""
+    out = bytearray(MAGIC)
+    _put_varint(out, len(messages))
+    for msg in messages:
+        _put_varint(out, len(msg))
+        out += msg
+    return bytes(out)
+
+
+def unpack_batch(data: bytes) -> Optional[List[bytes]]:
+    """Batch frame → messages; None when ``data`` is a plain single message
+    (no magic). Raises FramingError on a corrupt batch body."""
+    if not data.startswith(MAGIC):
+        return None
+    count, pos = _get_varint(data, len(MAGIC))
+    messages: List[bytes] = []
+    for _ in range(count):
+        length, pos = _get_varint(data, pos)
+        end = pos + length
+        if end > len(data):
+            raise FramingError("truncated message in batch frame")
+        messages.append(data[pos:end])
+        pos = end
+    if pos != len(data):
+        raise FramingError("trailing bytes after batch frame body")
+    return messages

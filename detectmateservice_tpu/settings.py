@@ -146,6 +146,11 @@ class ServiceSettings(BaseModel):
     # contract; > 1 enables micro-batched dispatch to the accelerator.
     engine_batch_size: int = Field(default=1, ge=1, le=4096)
     engine_batch_timeout_ms: float = Field(default=2.0, ge=0.0)
+    # pack up to N results per outgoing wire frame (engine/framing.py):
+    # amortizes the per-message socket cost that caps stage-to-stage rates
+    # (~80k msg/s per Python sender, measured). 1 = single-message wire,
+    # compatible with reference-style peers; receivers auto-detect either.
+    engine_frame_batch: int = Field(default=1, ge=1, le=8192)
     # transport_backend selects the data-plane implementation: "native" is
     # the in-tree C++ transport (native/transport), "zmq" the Python pyzmq
     # backend; both are wire-compatible. "auto" prefers native when built.

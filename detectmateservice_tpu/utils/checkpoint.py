@@ -14,12 +14,14 @@ import orbax.checkpoint as ocp
 
 _META = "meta.json"
 
-# Param-tree layout version, stamped into every checkpoint's meta.json.
-# Bump when a model refactor renames the flax param paths (v2: the
-# compact→setup() restructure renamed block_i→blocks_i, LayerNorm_0→final_ln,
-# rnn_i/gru_i→rnns_i/cell). Restore against a different version fails with a
+# Param-tree layout versions, stamped into every checkpoint's meta.json and
+# checked on restore — PER MODEL FAMILY, because a layout bump in one family
+# must not reject still-compatible checkpoints of another. v2 = the
+# compact→setup() restructure (renamed block_i→blocks_i, LayerNorm_0→
+# final_ln, rnn_i/gru_i→rnns_i/cell); mlp was untouched and stays v1, so
+# pre-restructure mlp checkpoints keep restoring. A mismatch fails with a
 # clear message instead of orbax's opaque missing-key error.
-TREE_VERSION = 2
+MODEL_TREE_VERSIONS = {"mlp": 1, "gru": 2, "logbert": 2}
 
 
 class CheckpointFormatError(RuntimeError):
@@ -35,30 +37,33 @@ _SAVE_LOCK = threading.Lock()
 
 
 def save_scorer_state(directory: str, params: Any, opt_state: Any,
-                      meta: Dict[str, Any]) -> None:
+                      meta: Dict[str, Any], tree_version: int = 1) -> None:
     path = Path(directory).absolute()
     path.mkdir(parents=True, exist_ok=True)
     with _SAVE_LOCK:
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(path / "params", params, force=True)
             ckptr.save(path / "opt_state", opt_state, force=True)
-    (path / _META).write_text(json.dumps({**meta, "tree_version": TREE_VERSION}))
+    (path / _META).write_text(json.dumps({**meta, "tree_version": tree_version}))
 
 
 def load_scorer_state(directory: str, params_template: Any,
-                      opt_state_template: Any) -> Tuple[Any, Any, Dict[str, Any]]:
+                      opt_state_template: Any,
+                      expected_tree_version: int = 1,
+                      ) -> Tuple[Any, Any, Dict[str, Any]]:
     path = Path(directory).absolute()
     # meta first: a tree-version mismatch must produce an actionable error,
     # not orbax's missing-key traceback halfway through the restore
     meta = json.loads((path / _META).read_text())
     found = meta.get("tree_version", 1)
-    if found != TREE_VERSION:
+    if found != expected_tree_version:
         raise CheckpointFormatError(
             f"checkpoint at {path} has param-tree version {found}, this "
-            f"build expects {TREE_VERSION}; the flax module layout changed "
-            "(param paths were renamed), so this checkpoint cannot be "
-            "restored directly — refit the scorer, or migrate the "
-            "checkpoint by renaming its param keys to the new layout")
+            f"build expects {expected_tree_version} for this model family; "
+            "the flax module layout changed (param paths were renamed), so "
+            "this checkpoint cannot be restored directly — refit the "
+            "scorer, or migrate the checkpoint by renaming its param keys "
+            "to the new layout")
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(path / "params", params_template)
         opt_state = ckptr.restore(path / "opt_state", opt_state_template)

@@ -152,6 +152,81 @@ class TestEngineLoopInproc:
             Engine(make_settings("inproc://e8"), object(), inproc_factory)
 
 
+class TestBatchFraming:
+    def test_pack_unpack_roundtrip(self):
+        from detectmateservice_tpu.engine.framing import pack_batch, unpack_batch
+
+        msgs = [b"", b"a", b"x" * 300, bytes(range(256))]
+        assert unpack_batch(pack_batch(msgs)) == msgs
+
+    def test_plain_message_passes_through(self):
+        from detectmateservice_tpu.engine.framing import unpack_batch
+
+        # protobuf payloads can never start with the 0xD7 magic byte
+        assert unpack_batch(b"\x0aplain protobuf-ish") is None
+        assert unpack_batch(b"") is None
+
+    def test_corrupt_batch_raises(self):
+        from detectmateservice_tpu.engine.framing import (
+            FramingError, pack_batch, unpack_batch)
+
+        frame = pack_batch([b"hello", b"world"])
+        with pytest.raises(FramingError):
+            unpack_batch(frame[:-3])  # truncated body
+        with pytest.raises(FramingError):
+            unpack_batch(frame + b"x")  # trailing junk
+
+    def test_engine_unpacks_ingress_batch_frames(self, inproc_factory):
+        """A packed ingress frame is expanded into per-message processing
+        (single-message processor mode)."""
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        settings = make_settings("inproc://fr1")
+        engine = Engine(settings, SimpleProcessor(), inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://fr1")
+        client.recv_timeout = 2000
+        client.send(pack_batch([b"abc", b"de", b"f"]))
+        got = [client.recv() for _ in range(3)]
+        assert got == [b"cba", b"ed", b"f"]
+        engine.stop()
+
+    def test_engine_packs_fanout_when_configured(self, inproc_factory):
+        """engine_frame_batch > 1 packs results; a receiver unpacks them."""
+        from detectmateservice_tpu.engine.framing import pack_batch, unpack_batch
+
+        sub = inproc_factory.create("inproc://fr2out")
+        sub.recv_timeout = 2000
+        settings = make_settings("inproc://fr2", ["inproc://fr2out"],
+                                 engine_batch_size=8, engine_frame_batch=8)
+        proc = BatchDoubler()
+        engine = Engine(settings, proc, inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://fr2")
+        client.send(pack_batch([b"m%d" % i for i in range(6)]))
+        frame = sub.recv()
+        msgs = unpack_batch(frame)
+        assert msgs == [b"M%d" % i for i in range(6)]
+        engine.stop()
+
+    def test_frame_batch_default_keeps_single_message_wire(self, inproc_factory):
+        from detectmateservice_tpu.engine.framing import unpack_batch
+
+        sub = inproc_factory.create("inproc://fr3out")
+        sub.recv_timeout = 2000
+        settings = make_settings("inproc://fr3", ["inproc://fr3out"],
+                                 engine_batch_size=8)  # frame_batch default 1
+        engine = Engine(settings, BatchDoubler(), inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://fr3")
+        for i in range(3):
+            client.send(b"m%d" % i)
+        got = [sub.recv() for _ in range(3)]
+        assert got == [b"M0", b"M1", b"M2"]
+        assert all(unpack_batch(g) is None for g in got)
+        engine.stop()
+
+
 class TestEngineMicroBatch:
     def test_batch_mode_preserves_order_and_filtering(self, inproc_factory):
         settings = make_settings(

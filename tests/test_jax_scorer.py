@@ -195,8 +195,8 @@ class TestConfigValidation:
 
 
 class TestCheckpointTreeVersion:
-    def test_old_tree_version_fails_with_clear_error(self, tmp_path,
-                                                     trained_detector):
+    def test_mismatched_tree_version_fails_with_clear_error(
+            self, tmp_path, trained_detector):
         import json
 
         from detectmateservice_tpu.utils.checkpoint import CheckpointFormatError
@@ -204,11 +204,27 @@ class TestCheckpointTreeVersion:
         trained_detector.save_checkpoint(str(tmp_path / "ckpt"))
         meta_path = tmp_path / "ckpt" / "meta.json"
         meta = json.loads(meta_path.read_text())
-        meta.pop("tree_version")  # simulate a pre-restructure checkpoint
+        meta["tree_version"] = 99  # a layout this build does not know
         meta_path.write_text(json.dumps(meta))
         fresh = JaxScorerDetector(config=scorer_config())
         with pytest.raises(CheckpointFormatError, match="tree version"):
             fresh.load_checkpoint(str(tmp_path / "ckpt"))
+
+    def test_pre_restructure_mlp_checkpoint_still_loads(self, tmp_path,
+                                                        trained_detector):
+        """The setup() restructure did not touch mlp's param tree, so a
+        version-1 (no tree_version key) mlp checkpoint must keep restoring
+        — the version gate is per model family, not global."""
+        import json
+
+        trained_detector.save_checkpoint(str(tmp_path / "ckpt"))
+        meta_path = tmp_path / "ckpt" / "meta.json"
+        meta = json.loads(meta_path.read_text())
+        meta.pop("tree_version")  # exactly what a pre-v2 checkpoint looks like
+        meta_path.write_text(json.dumps(meta))
+        fresh = JaxScorerDetector(config=scorer_config())
+        fresh.load_checkpoint(str(tmp_path / "ckpt"))
+        assert fresh._fitted
 
 
 class TestSingleMessageTraining:
