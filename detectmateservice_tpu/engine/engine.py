@@ -327,13 +327,18 @@ class Engine:
                         batch.extend(self._expand_frame(nxt, read_b, read_l, err_c))
             if saved_timeout is not None:
                 self._pair_sock.recv_timeout = saved_timeout
-            try:
-                outs = batch_fn(batch)
-            except Exception as exc:
-                err_c.inc(len(batch))
-                self.logger.error("process_batch() raised: %s", exc)
-                continue
-            self._send_results(outs)  # in-order, per-message None filtering
+            # a packed ingress frame can carry more messages than
+            # engine_batch_size; re-chunk so the component never sees a batch
+            # beyond the configured cap (its memory/latency contract)
+            for start in range(0, len(batch), batch_size):
+                chunk = batch[start:start + batch_size]
+                try:
+                    outs = batch_fn(chunk)
+                except Exception as exc:
+                    err_c.inc(len(chunk))
+                    self.logger.error("process_batch() raised: %s", exc)
+                    continue
+                self._send_results(outs)  # in-order, per-message None filter
 
         # loop exiting (stop requested): drain the pipeline before sockets
         # close — flush_final (when provided) also waits out work the

@@ -911,22 +911,23 @@ class JaxScorerDetector(CoreDetector):
                               self.state_dict(), tree_version=version)
 
     def load_checkpoint(self, directory: str) -> None:
-        from ...utils.checkpoint import MODEL_TREE_VERSIONS, load_scorer_state
+        from ...utils.checkpoint import (COMPATIBLE_TREE_VERSIONS,
+                                         load_scorer_state)
 
         self._ensure_scorer()
-        version = MODEL_TREE_VERSIONS.get(self.config.model, 1)
+        accepted = COMPATIBLE_TREE_VERSIONS.get(self.config.model, {1})
         if self._sharded is not None:
             # restore against the sharded targets so each leaf comes back
             # with its mesh placement intact
             params, opt_state, meta = load_scorer_state(
                 directory, self._sharded.params, self._sharded.opt_state,
-                expected_tree_version=version,
+                accepted_tree_versions=accepted,
             )
             self._sharded.params, self._sharded.opt_state = params, opt_state
         else:
             params, opt_state, meta = load_scorer_state(
                 directory, self._params, self._opt_state,
-                expected_tree_version=version,
+                accepted_tree_versions=accepted,
             )
             self._params, self._opt_state = params, opt_state
         self._trained = int(meta.get("trained", 0))

@@ -192,3 +192,88 @@ class MatcherParser(CoreComponent):
             raise LibraryError(f"{self.name}: cannot deserialize LogSchema: {exc}") from exc
         parsed = self.parse_line(input_.get("log") or "", log_id=input_.get("logID") or "")
         return parsed.serialize() if parsed is not None else None
+
+    def process_batch(self, batch: List[bytes]) -> List[Optional[bytes]]:
+        """Batched hot path (what the engine's micro-batch mode calls):
+        identical field semantics to ``process`` — pinned by
+        test_process_batch_matches_process — but built straight on the
+        generated pb2 classes. The dict-style wrapper's field-descriptor
+        lookups were ~40% of the per-line budget (11 assignments/message);
+        at pipeline rates that overhead IS the parser stage's ceiling."""
+        from os import urandom
+
+        from ...schemas import SCHEMA_VERSION, schemas_pb2 as _pb
+
+        outs: List[Optional[bytes]] = []
+        method_type = self.config.method_type
+        name = self.name
+        time_format = self.config.time_format
+        format_re = self._format_re
+        format_names = self._format_names
+        have_templates = bool(self._templates)
+        decode_errors = 0
+        for data in batch:
+            msg = _pb.LogSchema()
+            try:
+                msg.ParseFromString(data)
+            except Exception:
+                decode_errors += 1  # surfaced below; containment per message
+                outs.append(None)
+                continue
+            log_line = msg.log
+            if not log_line.strip():
+                outs.append(None)
+                continue
+            header_vars = {}
+            content = log_line
+            if format_re is not None:
+                found = format_re.match(log_line)
+                if found:
+                    header_vars = dict(zip(format_names, found.groups()))
+                    content = header_vars.get("Content", log_line)
+            if time_format and "Time" in header_vars:
+                try:
+                    parsed_t = time.strptime(header_vars["Time"], time_format)
+                    header_vars["Time"] = str(int(time.mktime(parsed_t)))
+                except ValueError:
+                    pass
+            event_id, template, variables = (
+                self.match_templates(content) if have_templates else (-1, "", [])
+            )
+            now = int(time.time())
+            out = _pb.ParserSchema()
+            setattr(out, "__version__", SCHEMA_VERSION)
+            out.parserType = method_type
+            out.parserID = name
+            out.EventID = event_id
+            out.template = template
+            if variables:
+                out.variables.extend(variables)
+            # same 32-hex-char opaque unique id as parse_line's uuid4().hex,
+            # minus the UUID-object construction (~15% of the loop budget)
+            out.parsedLogID = urandom(16).hex()
+            # unconditional assignment on purpose: these are explicit-presence
+            # (optional) fields, and parse_line always assigns them — an
+            # empty logID must still serialize its presence bit for
+            # byte-parity with the single-message path
+            out.logID = msg.logID
+            out.log = name  # reference quirk: parser name, not the line
+            for key, value in header_vars.items():
+                out.logFormatVariables[key] = value if value is not None else ""
+            out.receivedTimestamp = now
+            out.parsedTimestamp = now
+            outs.append(out.SerializeToString())
+        if decode_errors:
+            # the single-message path raises LibraryError per message, which
+            # the engine logs and counts in processing_errors_total — batched
+            # decode failures must be just as visible, not silent filtering
+            import logging
+
+            from ...engine import metrics as m
+
+            m.PROCESSING_ERRORS().labels(
+                component_type=method_type, component_id=name).inc(decode_errors)
+            logging.getLogger(__name__).error(
+                "%s: %d undecodable LogSchema message(s) dropped from batch "
+                "of %d", name, decode_errors, len(batch))
+        return outs

@@ -18,10 +18,12 @@ _META = "meta.json"
 # checked on restore — PER MODEL FAMILY, because a layout bump in one family
 # must not reject still-compatible checkpoints of another. v2 = the
 # compact→setup() restructure (renamed block_i→blocks_i, LayerNorm_0→
-# final_ln, rnn_i/gru_i→rnns_i/cell); mlp was untouched and stays v1, so
-# pre-restructure mlp checkpoints keep restoring. A mismatch fails with a
-# clear message instead of orbax's opaque missing-key error.
+# final_ln, rnn_i/gru_i→rnns_i/cell); mlp was untouched, so BOTH v1 and v2
+# stamps restore for mlp (one intermediate build stamped a global v2 on
+# every family). A mismatch fails with a clear message instead of orbax's
+# opaque missing-key error.
 MODEL_TREE_VERSIONS = {"mlp": 1, "gru": 2, "logbert": 2}
+COMPATIBLE_TREE_VERSIONS = {"mlp": {1, 2}, "gru": {2}, "logbert": {2}}
 
 
 class CheckpointFormatError(RuntimeError):
@@ -49,21 +51,21 @@ def save_scorer_state(directory: str, params: Any, opt_state: Any,
 
 def load_scorer_state(directory: str, params_template: Any,
                       opt_state_template: Any,
-                      expected_tree_version: int = 1,
+                      accepted_tree_versions=frozenset({1}),
                       ) -> Tuple[Any, Any, Dict[str, Any]]:
     path = Path(directory).absolute()
     # meta first: a tree-version mismatch must produce an actionable error,
     # not orbax's missing-key traceback halfway through the restore
     meta = json.loads((path / _META).read_text())
     found = meta.get("tree_version", 1)
-    if found != expected_tree_version:
+    if found not in accepted_tree_versions:
         raise CheckpointFormatError(
             f"checkpoint at {path} has param-tree version {found}, this "
-            f"build expects {expected_tree_version} for this model family; "
-            "the flax module layout changed (param paths were renamed), so "
-            "this checkpoint cannot be restored directly — refit the "
-            "scorer, or migrate the checkpoint by renaming its param keys "
-            "to the new layout")
+            f"build accepts {sorted(accepted_tree_versions)} for this model "
+            "family; the flax module layout changed (param paths were "
+            "renamed), so this checkpoint cannot be restored directly — "
+            "refit the scorer, or migrate the checkpoint by renaming its "
+            "param keys to the new layout")
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(path / "params", params_template)
         opt_state = ckptr.restore(path / "opt_state", opt_state_template)

@@ -209,6 +209,23 @@ class TestBatchFraming:
         assert msgs == [b"M%d" % i for i in range(6)]
         engine.stop()
 
+    def test_oversized_ingress_frame_rechunked_to_batch_size(self, inproc_factory):
+        """A packed frame larger than engine_batch_size must be re-chunked:
+        the component's process_batch never sees a batch beyond the cap."""
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        settings = make_settings("inproc://fr4", engine_batch_size=4)
+        proc = BatchDoubler()
+        engine = Engine(settings, proc, inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://fr4")
+        client.recv_timeout = 2000
+        client.send(pack_batch([b"m%d" % i for i in range(11)]))
+        got = [client.recv() for _ in range(11)]
+        assert got == [b"M%d" % i for i in range(11)]  # order preserved
+        assert max(proc.batch_sizes) <= 4
+        engine.stop()
+
     def test_frame_batch_default_keeps_single_message_wire(self, inproc_factory):
         from detectmateservice_tpu.engine.framing import unpack_batch
 

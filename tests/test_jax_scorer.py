@@ -210,17 +210,22 @@ class TestCheckpointTreeVersion:
         with pytest.raises(CheckpointFormatError, match="tree version"):
             fresh.load_checkpoint(str(tmp_path / "ckpt"))
 
-    def test_pre_restructure_mlp_checkpoint_still_loads(self, tmp_path,
-                                                        trained_detector):
-        """The setup() restructure did not touch mlp's param tree, so a
-        version-1 (no tree_version key) mlp checkpoint must keep restoring
-        — the version gate is per model family, not global."""
+    @pytest.mark.parametrize("stamp", ["absent", 2])
+    def test_compatible_mlp_checkpoints_still_load(self, tmp_path,
+                                                   trained_detector, stamp):
+        """The setup() restructure did not touch mlp's param tree, so both a
+        version-1 (no tree_version key) mlp checkpoint AND one stamped with
+        the interim global v2 must keep restoring — the gate is a per-family
+        compatibility SET, not a single number."""
         import json
 
         trained_detector.save_checkpoint(str(tmp_path / "ckpt"))
         meta_path = tmp_path / "ckpt" / "meta.json"
         meta = json.loads(meta_path.read_text())
-        meta.pop("tree_version")  # exactly what a pre-v2 checkpoint looks like
+        if stamp == "absent":
+            meta.pop("tree_version")
+        else:
+            meta["tree_version"] = stamp
         meta_path.write_text(json.dumps(meta))
         fresh = JaxScorerDetector(config=scorer_config())
         fresh.load_checkpoint(str(tmp_path / "ckpt"))

@@ -81,6 +81,55 @@ class TestMatcherParser:
         with pytest.raises(Exception):
             MatcherParser(config=bad)
 
+    def test_process_batch_matches_process(self, tmp_path):
+        """The pb2-direct batched hot path must be field-equivalent to the
+        single-message wrapper path (only parsedLogID and timestamps may
+        legitimately differ)."""
+        templates = tmp_path / "templates.txt"
+        templates.write_text("user <*> logged in from <*>\n")
+        config = {"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": "<IP> - <Content>", "time_format": None,
+            "params": {"lowercase": True, "path_templates": str(templates)},
+        }}}
+        parser = MatcherParser(config=config)
+        raws = [
+            LogSchema(logID=str(i),
+                      log=f"10.0.0.{i} - User u{i} logged in from 1.2.3.{i}"
+                      ).serialize()
+            for i in range(5)
+        ] + [LogSchema(log="").serialize(),           # filtered
+             LogSchema(logID="x", log="unmatchable").serialize()]
+        batched = parser.process_batch(raws)
+        singles = [parser.process(r) for r in raws]
+        assert len(batched) == len(singles)
+        for got, want in zip(batched, singles):
+            assert (got is None) == (want is None)
+            if got is None:
+                continue
+            a = ParserSchema.from_bytes(got)
+            b = ParserSchema.from_bytes(want)
+            for field in ("parserType", "parserID", "EventID", "template",
+                          "variables", "logID", "log", "logFormatVariables"):
+                assert str(a.get(field)) == str(b.get(field)), field
+            assert len(a["parsedLogID"]) == 32  # 16-byte hex unique id
+
+    def test_process_batch_counts_decode_errors(self):
+        """Corrupt frames in a batch are dropped VISIBLY: error counter +
+        log, matching the single-message path's LibraryError handling."""
+        from detectmateservice_tpu.engine import metrics as m
+
+        parser = MatcherParser(config=parser_config())
+        counter = m.PROCESSING_ERRORS().labels(
+            component_type=parser.config.method_type, component_id=parser.name)
+        before = counter._value.get()
+        outs = parser.process_batch([
+            b"\xff\xff not protobuf",
+            LogSchema(logID="1", log=nginx_line("/ok")).serialize(),
+        ])
+        assert outs[0] is None and outs[1] is not None
+        assert counter._value.get() == before + 1
+
 
 def nvd_config(training=2, alert_once=False):
     return {"detectors": {"NewValueDetector": {
