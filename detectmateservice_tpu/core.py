@@ -66,9 +66,12 @@ class LibraryComponentProcessor:
     def process_batch(self, batch):
         """Batched dispatch for accelerator-backed components; falls back to a
         per-message loop so any component works under micro-batching."""
-        for data in batch:
-            self._processed_b.inc(len(data))
-            self._processed_l.inc(max(1, data.count(b"\n") + (0 if data.endswith(b"\n") else 1)))
+        # aggregated counter updates: per-message .inc() calls were a
+        # measurable slice of the per-message service floor at 100k+ rates
+        self._processed_b.inc(sum(map(len, batch)))
+        self._processed_l.inc(sum(
+            max(1, data.count(b"\n") + (0 if data.endswith(b"\n") else 1))
+            for data in batch))
         self._batch_hist.observe(len(batch))
         with self._duration.time():
             if self.component is None:
@@ -168,6 +171,10 @@ class Service:
             self.library_component = loader.load_component(
                 self._component_path, component_config
             )
+            # component-side error counts must land in THIS service's
+            # processing_errors_total series (same labels the engine uses),
+            # not a parallel series keyed by class name
+            self.library_component.metrics_labels = dict(self._labels)
 
         self.processor = LibraryComponentProcessor(self.library_component, self._labels)
         self.engine = Engine(settings, self.processor, socket_factory, self.logger)

@@ -158,6 +158,25 @@ class CoreComponent:
                 f"config must be a dict or CoreConfig, got {type(config).__name__}"
             )
         self.config = config
+        # the hosting Service overwrites this with ITS metric labels
+        # (settings.component_type / component_id) so component-side error
+        # counts land in the same processing_errors_total series the engine
+        # uses for single-message failures — dashboards keyed on the
+        # service's component_id must see batched failures too
+        self.metrics_labels: Dict[str, str] = dict(
+            component_type=getattr(config, "method_type", self.category),
+            component_id=self.name)
+
+    def count_processing_errors(self, n: int, what: str) -> None:
+        """Count + log n per-message failures the component contained
+        (batched paths swallow per-message errors instead of raising)."""
+        import logging
+
+        from ...engine import metrics as m
+
+        m.PROCESSING_ERRORS().labels(**self.metrics_labels).inc(n)
+        logging.getLogger(type(self).__module__).error(
+            "%s: %d %s dropped", self.name, n, what)
 
     def process(self, data: bytes) -> Optional[bytes]:
         """Process one message; ``None`` filters it (no output is sent)."""

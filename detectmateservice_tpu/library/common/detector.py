@@ -244,8 +244,9 @@ class CoreDetector(CoreComponent):
 
     @staticmethod
     def extract_timestamp(input_: ParserSchema) -> Optional[int]:
+        lfv = input_["logFormatVariables"]  # live map container, no copy
         for key in ("Time", "time", "timestamp"):
-            value = dict(input_["logFormatVariables"]).get(key)
+            value = lfv.get(key)
             if value:
                 try:
                     return int(float(value))
@@ -269,8 +270,8 @@ class CoreDetector(CoreComponent):
     def field_value(input_: ParserSchema, var: Union[Variable, HeaderVariable]) -> Optional[str]:
         """Resolve a watched field's value from a parsed message."""
         if isinstance(var, HeaderVariable) or isinstance(var.pos, str):
-            return dict(input_["logFormatVariables"]).get(str(var.pos))
-        variables = list(input_["variables"])
+            return input_["logFormatVariables"].get(str(var.pos))  # no copy
+        variables = input_["variables"]
         if 0 <= var.pos < len(variables):
             return variables[var.pos]
         return None

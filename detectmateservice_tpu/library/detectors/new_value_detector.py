@@ -41,6 +41,7 @@ class NewValueDetector(CoreDetector):
         self.config: NewValueDetectorConfig
         # (scope, instance, label) -> set of seen values
         self._seen: Dict[Tuple[str, str, str], Set[str]] = {}
+        self._plan_cache: Dict[Any, list] = {}  # event_id -> watch plan
 
     # ------------------------------------------------------------------
     def _watched(self, input_: ParserSchema):
@@ -71,6 +72,134 @@ class NewValueDetector(CoreDetector):
             output_["alertsObtain"].update(alerts)
             return True
         return False
+
+    # -- batched hot path (engine micro-batch mode) ----------------------
+    def apply_config(self) -> None:
+        super().apply_config()
+        self._plan_cache = {}  # reconfigure may change the watched fields
+
+    def _watch_plan(self, event_id) -> list:
+        """Prebuilt (key, scope, label, kind, pos) list for one event id.
+
+        ``iter_scopes`` + ``field_value`` walk pydantic config models per
+        message; the watched-field set only changes on reconfigure, so the
+        batched path resolves it once per event id (cache cleared by
+        apply_config). kind: True = header (by name), False = positional."""
+        plan = []
+        for inst_name, inst in self.config.global_.items():
+            for label, var in inst.get_all().items():
+                header = not isinstance(var.pos, int)
+                plan.append((("Global", inst_name, label), "Global", label,
+                             header, str(var.pos) if header else var.pos))
+        if event_id is not None:
+            scope = f"Event {event_id}"
+            for inst_name, inst in self.config.event_instances(event_id).items():
+                for label, var in inst.get_all().items():
+                    header = not isinstance(var.pos, int)
+                    plan.append(((scope, inst_name, label), scope, label,
+                                 header, str(var.pos) if header else var.pos))
+        return plan
+
+    def process_batch(self, batch) -> list:
+        """Batched engine contract, field-equivalent to ``process`` (pinned
+        by test_process_batch_matches_process): decodes straight into pb2,
+        resolves watched values off the live message, and builds an alert
+        only for hits — the per-message alert skeleton the wrapper path
+        builds and usually throws away was most of the per-line budget."""
+        if self._buffer is not None:  # FIXED/windowed: parity path handles it
+            return [self.process(d) for d in batch]
+        from ...schemas import schemas_pb2 as _pb
+
+        cfg = self.config
+        seen_map = self._seen
+        alert_once = cfg.alert_once
+        plans = self._plan_cache
+        outs: list = []
+        decode_errors = 0
+        for data in batch:
+            msg = _pb.ParserSchema()
+            try:
+                msg.ParseFromString(data)
+            except Exception:
+                decode_errors += 1
+                outs.append(None)
+                continue
+            event_id = msg.EventID if msg.HasField("EventID") else None
+            plan = plans.get(event_id)
+            if plan is None:
+                plan = plans[event_id] = self._watch_plan(event_id)
+            training = self._trained < cfg.data_use_training
+            if training:
+                self._trained += 1
+            score = 0.0
+            alerts = None
+            lfv = msg.logFormatVariables
+            variables = msg.variables
+            n_vars = len(variables)
+            for key, scope, label, header, pos in plan:
+                if header:
+                    value = lfv.get(pos)
+                else:
+                    value = variables[pos] if 0 <= pos < n_vars else None
+                if value is None:
+                    continue
+                seen = seen_map.get(key)
+                if seen is None:
+                    seen = seen_map.setdefault(key, set())
+                if training:
+                    seen.add(value)
+                elif value not in seen:
+                    score += 1.0
+                    if alerts is None:
+                        alerts = {}
+                    alerts[f"{scope} - {label}"] = f"Unknown value: '{value}'"
+                    if alert_once:
+                        seen.add(value)
+            if training or alerts is None:
+                outs.append(None)
+                continue
+            outs.append(self._make_alert_pb(msg, score, alerts))
+        if decode_errors:
+            self.count_processing_errors(decode_errors,
+                                         "undecodable ParserSchema message(s)")
+        return outs
+
+    def _make_alert_pb(self, msg, score: float, alerts: Dict[str, str]) -> bytes:
+        """Alert built straight on pb2 — field-for-field what make_output +
+        detect's mutations produce on the wrapper path."""
+        import time as _time
+
+        from ...schemas import SCHEMA_VERSION, schemas_pb2 as _pb
+
+        now = int(_time.time())
+        out = _pb.DetectorSchema()
+        setattr(out, "__version__", SCHEMA_VERSION)
+        out.detectorID = self.name
+        out.detectorType = self.config.method_type
+        out.alertID = str(next(self._alert_ids))
+        out.detectionTimestamp = now
+        out.receivedTimestamp = now
+        if msg.logID:
+            out.logIDs.append(msg.logID)
+        ts = now
+        lfv = msg.logFormatVariables
+        for key in ("Time", "time", "timestamp"):
+            value = lfv.get(key) if lfv else None
+            if value:
+                try:
+                    ts = int(float(value))
+                except ValueError:
+                    ts = now
+                break
+        else:
+            if msg.HasField("receivedTimestamp") and msg.receivedTimestamp:
+                ts = int(msg.receivedTimestamp)
+        out.extractedTimestamps.append(ts)
+        out.description = self.description
+        out.score = score
+        for k, v in alerts.items():
+            out.alertsObtain[k] = v
+        return out.SerializeToString()
 
     # -- state checkpointing (TPU-build addition, closes SURVEY §5.4) ----
     def state_dict(self) -> Dict[str, Any]:

@@ -151,6 +151,12 @@ class ServiceSettings(BaseModel):
     # (~80k msg/s per Python sender, measured). 1 = single-message wire,
     # compatible with reference-style peers; receivers auto-detect either.
     engine_frame_batch: int = Field(default=1, ge=1, le=8192)
+    # fan-out under backpressure: "drop" = the reference contract (bounded
+    # retries with 10 ms sleeps, then drop + count — engine.py:286-296);
+    # "block" = flow control (send blocks until the peer drains), the right
+    # mode INSIDE a high-rate pipeline where a slower downstream stage must
+    # throttle its upstream instead of losing data in 100 ms retry windows.
+    out_backpressure: str = Field(default="drop", pattern="^(drop|block)$")
     # transport_backend selects the data-plane implementation: "native" is
     # the in-tree C++ transport (native/transport), "zmq" the Python pyzmq
     # backend; both are wire-compatible. "auto" prefers native when built.

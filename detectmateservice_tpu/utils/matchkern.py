@@ -81,6 +81,23 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
     ]
     lib.dm_match_templates.restype = ctypes.c_int
+    lib.dm_match_extract.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.dm_match_extract.restype = ctypes.c_int
+    lib.dm_match_extract_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+    ]
     return lib
 
 
@@ -158,21 +175,101 @@ class TemplateMatcher:
         self._counts_p = counts.ctypes.data_as(_I32P)
         self._starts_p = starts.ctypes.data_as(_U8P)
         self._ends_p = ends.ctypes.data_as(_U8P)
+        self._max_caps = max(1, int(counts.max()) if len(counts) else 1)
+        # one reusable capture buffer per matcher: the engine loop is the
+        # only caller on the hot path (per-thread reuse is safe there); the
+        # buffer is reallocated per call ONLY if a caller races, via the
+        # ctypes-level copy in np.ctypeslib — keep it simple: allocate in
+        # match() when contention is possible is not worth 200 ns, reuse.
+        self._caps = np.empty(2 * self._max_caps, dtype=np.int32)
+        self._caps_p = self._caps.ctypes.data_as(_I32P)
+        self._ncaps = np.zeros(1, dtype=np.int32)
+        self._ncaps_p = self._ncaps.ctypes.data_as(_I32P)
 
     def match(self, line: str) -> Tuple[int, List[str]]:
-        """Return (0-based template index, wildcard captures) or (-1, [])."""
+        """Return (0-based template index, wildcard captures) or (-1, []).
+
+        Captures come from the C scan's byte spans (dm_match_extract) —
+        slicing instead of lazy-group regex matching, which was the parser
+        hot path's ceiling (~45k lines/s on 8-wildcard templates). Falls
+        back to the regex extractor on capture-buffer overflow or when a
+        span splits a multi-byte character (possible only when a template
+        literal's bytes occur mid-character)."""
         raw = line.encode("utf-8")
-        idx = _lib.dm_match_templates(
+        idx = _lib.dm_match_extract(
             raw, len(raw),
             self._seg_blob, self._seg_offsets_p,
-            self._counts_p,
-            self._starts_p,
-            self._ends_p,
+            self._counts_p, self._starts_p, self._ends_p,
             len(self._templates),
+            self._caps_p, self._max_caps, self._ncaps_p,
         )
-        if idx < 0:
+        if idx == -1:
             return -1, []
-        found = self._extract_res[idx].match(line)
-        if found is None:  # byte-level scan matched but char-level regex differs
+        if idx >= 0:
+            n = int(self._ncaps[0])
+            caps = self._caps
+            try:
+                return idx, [raw[caps[2 * k]:caps[2 * k + 1]].decode("utf-8")
+                             for k in range(n)]
+            except UnicodeDecodeError:
+                pass  # span split a multibyte char: regex fallback below
+            found = self._extract_res[idx].match(line)
+            if found is None:
+                return -1, []
+            return idx, [g for g in found.groups() if g is not None]
+        # idx == -2: more captures than the buffer (cannot happen with the
+        # per-template max sizing, but the C contract allows it) — rematch
+        idx2 = _lib.dm_match_templates(
+            raw, len(raw), self._seg_blob, self._seg_offsets_p,
+            self._counts_p, self._starts_p, self._ends_p,
+            len(self._templates))
+        if idx2 < 0:
             return -1, []
-        return idx, [g for g in found.groups() if g is not None]
+        found = self._extract_res[idx2].match(line)
+        if found is None:
+            return -1, []
+        return idx2, [g for g in found.groups() if g is not None]
+
+    def match_batch(self, lines: List[str]) -> List[Tuple[int, List[str]]]:
+        """Batch variant of ``match``: ONE ctypes crossing for the whole
+        micro-batch (the per-call overhead was ~20 µs/line, larger than the
+        scan itself). Returns one (idx, captures) pair per line."""
+        n = len(lines)
+        if n == 0:
+            return []
+        raws = [line.encode("utf-8") for line in lines]
+        blob, offsets = _pack(raws)
+        idx_out = np.empty(n, dtype=np.int32)
+        ncaps = np.empty(n, dtype=np.int32)
+        caps = np.empty((n, 2 * self._max_caps), dtype=np.int32)
+        _lib.dm_match_extract_batch(
+            blob, offsets.ctypes.data_as(_I64P), n,
+            self._seg_blob, self._seg_offsets_p,
+            self._counts_p, self._starts_p, self._ends_p,
+            len(self._templates),
+            idx_out.ctypes.data_as(_I32P), caps.ctypes.data_as(_I32P),
+            ncaps.ctypes.data_as(_I32P), self._max_caps,
+        )
+        # plain-list views: numpy scalar indexing costs ~200 ns/access and
+        # the assembly loop below does ~18 accesses per line
+        idx_list = idx_out.tolist()
+        ncaps_list = ncaps.tolist()
+        caps_list = caps.tolist()
+        results: List[Tuple[int, List[str]]] = []
+        for i in range(n):
+            idx = idx_list[i]
+            if idx == -1:
+                results.append((-1, []))
+                continue
+            if idx >= 0:
+                raw = raws[i]
+                row = caps_list[i]
+                try:
+                    results.append((idx, [
+                        raw[row[2 * k]:row[2 * k + 1]].decode("utf-8")
+                        for k in range(ncaps_list[i])]))
+                    continue
+                except UnicodeDecodeError:
+                    pass  # span split a multibyte char: regex fallback
+            results.append(self.match(lines[i]))  # slow-path fallback
+        return results

@@ -18,6 +18,10 @@
  *   dm_match_templates — normalized line vs <*> wildcard templates
  *     (first match wins, literal segments matched in order, anchored
  *     prefix/suffix) -> template index.
+ *   dm_match_extract — dm_match_templates plus the wildcard capture byte
+ *     spans of the winning template, so Python slices instead of running
+ *     a lazy-group regex (the regex was the parser stage's hot-path
+ *     ceiling at ~45k lines/s on 8-wildcard templates).
  */
 #include <stdint.h>
 #include <stdlib.h>
@@ -224,6 +228,16 @@ int dm_match_templates(const uint8_t *line, int line_len,
         const uint8_t *pos = line;
         const uint8_t *end = line + line_len;
         int okflag = 1;
+        if (n_segs == 1 && !starts_wild[t] && !ends_wild[t]) {
+            /* wildcard-free template: whole-line equality, not prefix —
+             * 'connection closed' must not claim 'connection closed by x' */
+            int seg_len = (int)(seg_offsets[seg_idx + 1] - seg_offsets[seg_idx]);
+            if (line_len == seg_len &&
+                memcmp(line, seg_data + seg_offsets[seg_idx], (size_t)seg_len) == 0)
+                return t;
+            seg_idx += 1;
+            continue;
+        }
         for (int s = 0; s < n_segs && okflag; s++) {
             const uint8_t *seg = seg_data + seg_offsets[seg_idx + s];
             int seg_len = (int)(seg_offsets[seg_idx + s + 1] - seg_offsets[seg_idx + s]);
@@ -252,4 +266,124 @@ int dm_match_templates(const uint8_t *line, int line_len,
         seg_idx += n_segs; /* offsets are one global prefix array */
     }
     return -1;
+}
+
+/* Match + extract: like dm_match_templates, but for the winning template
+ * fills caps[2k]=start, caps[2k+1]=end (byte offsets into `line`) for each
+ * wildcard gap between consecutive segments. Capture semantics mirror the
+ * extraction regex "^s0(.*?)s1(.*?)...(.*)slast$": middle segments match at
+ * their leftmost position after the previous match, an anchored last
+ * segment matches at the line end, and empty boundary segments (from a
+ * template starting/ending with <*>) capture from the line start / to the
+ * line end. Returns the template index, -1 for no match, or -2 when the
+ * winner has more captures than max_caps (caller falls back to the regex).
+ */
+static int match_extract_one(const uint8_t *line, int line_len,
+                             const uint8_t *seg_data, const int64_t *seg_offsets,
+                             const int32_t *seg_counts, const uint8_t *starts_wild,
+                             const uint8_t *ends_wild, int n_templates,
+                             int32_t *caps, int max_caps, int32_t *n_caps_out) {
+    int64_t seg_idx = 0;
+    for (int t = 0; t < n_templates; t++) {
+        int n_segs = seg_counts[t];
+        const uint8_t *pos = line;
+        const uint8_t *end = line + line_len;
+        const uint8_t *prev_end = line;
+        int okflag = 1;
+        int nc = 0;
+        int overflow = 0;
+        if (n_segs == 1 && !starts_wild[t] && !ends_wild[t]) {
+            /* wildcard-free template: whole-line equality (see
+             * dm_match_templates) — zero captures on match */
+            int seg_len = (int)(seg_offsets[seg_idx + 1] - seg_offsets[seg_idx]);
+            if (line_len == seg_len &&
+                memcmp(line, seg_data + seg_offsets[seg_idx], (size_t)seg_len) == 0) {
+                *n_caps_out = 0;
+                return t;
+            }
+            seg_idx += 1;
+            continue;
+        }
+        for (int s = 0; s < n_segs && okflag; s++) {
+            const uint8_t *seg = seg_data + seg_offsets[seg_idx + s];
+            int seg_len = (int)(seg_offsets[seg_idx + s + 1] - seg_offsets[seg_idx + s]);
+            const uint8_t *mstart;
+            if (seg_len == 0) {
+                /* empty boundary segment: zero-length match at pos, or at
+                 * the line end when it is the trailing segment */
+                mstart = (s == n_segs - 1) ? end : pos;
+            } else if (s == 0 && !starts_wild[t]) {
+                if (end - pos < seg_len || memcmp(pos, seg, (size_t)seg_len) != 0) {
+                    okflag = 0;
+                    break;
+                }
+                mstart = pos;
+            } else if (s == n_segs - 1 && !ends_wild[t]) {
+                if (pos > end - seg_len ||
+                    memcmp(end - seg_len, seg, (size_t)seg_len) != 0) {
+                    okflag = 0;
+                    break;
+                }
+                mstart = end - seg_len;
+            } else {
+                const uint8_t *found = NULL;
+                for (const uint8_t *q = pos; q + seg_len <= end; q++) {
+                    if (memcmp(q, seg, (size_t)seg_len) == 0) { found = q; break; }
+                }
+                if (!found) { okflag = 0; break; }
+                mstart = found;
+            }
+            if (s > 0) {
+                if (nc < max_caps) {
+                    caps[2 * nc] = (int32_t)(prev_end - line);
+                    caps[2 * nc + 1] = (int32_t)(mstart - line);
+                } else {
+                    overflow = 1;
+                }
+                nc++;
+            }
+            prev_end = mstart + seg_len;
+            pos = prev_end;
+        }
+        if (okflag) {
+            if (overflow) return -2;
+            *n_caps_out = nc;
+            return t;
+        }
+        seg_idx += n_segs;
+    }
+    *n_caps_out = 0;
+    return -1;
+}
+
+int dm_match_extract(const uint8_t *line, int line_len,
+                     const uint8_t *seg_data, const int64_t *seg_offsets,
+                     const int32_t *seg_counts, const uint8_t *starts_wild,
+                     const uint8_t *ends_wild, int n_templates,
+                     int32_t *caps, int max_caps, int32_t *n_caps_out) {
+    return match_extract_one(line, line_len, seg_data, seg_offsets, seg_counts,
+                             starts_wild, ends_wild, n_templates,
+                             caps, max_caps, n_caps_out);
+}
+
+/* Batch variant: one ctypes crossing for a whole engine micro-batch (the
+ * per-call ctypes overhead was ~20 us/line — larger than the scan itself).
+ * lines = concatenated line bytes, line_offsets = n_lines+1 prefix offsets;
+ * outputs: idx_out[i] (template index / -1 / -2), ncaps_out[i], and
+ * caps_out[i * 2*max_caps ...] byte spans RELATIVE to each line's start. */
+void dm_match_extract_batch(const uint8_t *lines, const int64_t *line_offsets,
+                            int n_lines,
+                            const uint8_t *seg_data, const int64_t *seg_offsets,
+                            const int32_t *seg_counts, const uint8_t *starts_wild,
+                            const uint8_t *ends_wild, int n_templates,
+                            int32_t *idx_out, int32_t *caps_out,
+                            int32_t *ncaps_out, int max_caps) {
+    for (int i = 0; i < n_lines; i++) {
+        const uint8_t *line = lines + line_offsets[i];
+        int line_len = (int)(line_offsets[i + 1] - line_offsets[i]);
+        idx_out[i] = match_extract_one(
+            line, line_len, seg_data, seg_offsets, seg_counts, starts_wild,
+            ends_wild, n_templates,
+            caps_out + (size_t)i * 2 * max_caps, max_caps, ncaps_out + i);
+    }
 }

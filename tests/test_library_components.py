@@ -114,6 +114,68 @@ class TestMatcherParser:
                 assert str(a.get(field)) == str(b.get(field)), field
             assert len(a["parsedLogID"]) == 32  # 16-byte hex unique id
 
+    def test_wildcard_free_template_requires_whole_line(self, tmp_path):
+        """A constant template must match the WHOLE line, not a prefix —
+        'connection closed' must not claim 'connection closed by 1.2.3.4'
+        (that belongs to the wildcard template after it). Pins native and
+        pure-Python agreement."""
+        templates = tmp_path / "templates.txt"
+        templates.write_text("connection closed\nconnection closed by <*>\n")
+        config = {"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": None, "time_format": None,
+            "params": {"lowercase": True, "path_templates": str(templates)},
+        }}}
+        parser = MatcherParser(config=config)
+        assert parser.match_templates("connection closed") == (
+            1, "connection closed", [])
+        eid, _, variables = parser.match_templates("connection closed by 1.2.3.4")
+        assert (eid, variables) == (2, ["1.2.3.4"])
+        # pure-Python fallback agrees
+        parser._native = None
+        assert parser.match_templates("connection closed")[0] == 1
+        eid2, _, vars2 = parser.match_templates("connection closed by 1.2.3.4")
+        assert (eid2, vars2) == (2, ["1.2.3.4"])
+
+    def test_nvd_process_batch_matches_process(self):
+        """NewValueDetector's pb2-direct batched path must produce exactly
+        the alerts (and Nones) the single-message wrapper path does —
+        including training-phase filtering, event+global scopes, header and
+        positional variables."""
+        def mk():
+            return NewValueDetector(config={"detectors": {"NewValueDetector": {
+                "method_type": "new_value_detector", "auto_config": False,
+                "data_use_training": 6,
+                "events": {1: {"inst": {"variables": [{"pos": 0}]}}},
+                "global": {"g": {"variables": [{"pos": 1}],
+                                 "header_variables": [{"pos": "Host"}]}},
+            }}})
+
+        def pmsg(u, ip, host, log_id):
+            return ParserSchema(
+                EventID=1, template="user <*> from <*>", variables=[u, ip],
+                logID=log_id,
+                logFormatVariables={"Time": "1700000000", "Host": host},
+            ).serialize()
+
+        stream = [pmsg(f"u{i % 3}", f"ip{i % 2}", f"h{i % 2}", str(i))
+                  for i in range(8)]
+        stream.append(pmsg("mallory", "ip-evil", "h0", "evil"))
+        stream.append(pmsg("u0", "ip0", "h0", "benign"))
+        singles = [mk().process(m) for m in []]  # silence lints
+        a, b = mk(), mk()
+        singles = [a.process(m) for m in stream]
+        batched = b.process_batch(stream)
+        assert [o is None for o in singles] == [o is None for o in batched]
+        for x, y in zip(singles, batched):
+            if x is None:
+                continue
+            da, db = DetectorSchema.from_bytes(x), DetectorSchema.from_bytes(y)
+            for field in ("detectorID", "detectorType", "logIDs", "score",
+                          "description", "alertsObtain"):
+                assert str(da.get(field)) == str(db.get(field)), field
+            assert list(da["extractedTimestamps"]) == list(db["extractedTimestamps"])
+
     def test_process_batch_counts_decode_errors(self):
         """Corrupt frames in a batch are dropped VISIBLY: error counter +
         log, matching the single-message path's LibraryError handling."""
