@@ -100,6 +100,11 @@ class Engine:
         )
         self._running = False
         self._stop_event = threading.Event()
+        # drain-then-close deadline, set ONCE when the first blocked send
+        # observes the stop flag and shared by every message drained after it
+        # — an aggregate budget, so N pending messages at stop cannot stack
+        # N × out_stop_drain_ms past the 2 s stop-join deadline
+        self._stop_drain_deadline: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._sockets_closed = False
         self._labels = dict(
@@ -160,6 +165,7 @@ class Engine:
                 raise
             self._sockets_closed = False
         self._stop_event.clear()
+        self._stop_drain_deadline = None
         self._running = True
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
@@ -205,9 +211,16 @@ class Engine:
         """One wire frame → its messages. Batch frames (framing.py) are
         auto-detected by magic — the 0xD7 lead byte cannot open a valid
         protobuf message — so a sender that packs and one that doesn't can
-        share this engine. Read metrics count wire bytes once per frame and
-        lines per contained message (the reference's newline rule)."""
+        share this engine. The engine itself is schema-agnostic: a pipeline
+        carrying non-protobuf payloads must set
+        ``engine_frame_autodetect: false`` (settings.py) or a payload that
+        happens to start with the magic would be mis-split. Read metrics
+        count wire bytes once per frame and lines per contained message
+        (the reference's newline rule)."""
         read_b.inc(len(raw))
+        if not getattr(self.settings, "engine_frame_autodetect", True):
+            read_l.inc(_count_lines(raw))
+            return [raw]
         try:
             msgs = unpack_batch(raw)
         except FramingError as exc:
@@ -393,48 +406,80 @@ class Engine:
                 dropped_l.inc(lines)
                 return False
 
-        blocking = self.settings.out_backpressure == "block"
         any_ok = False
         wrote_once = False
+
+        def mark_sent() -> None:
+            nonlocal any_ok, wrote_once
+            any_ok = True
+            if not wrote_once:
+                # written counted once per message, dropped once per
+                # socket (reference: docs/prometheus.md:46-47)
+                written_b.inc(len(data))
+                written_l.inc(lines)
+                wrote_once = True
+
+        if self.settings.out_backpressure == "block":
+            # Flow-control mode: wait for peers instead of the
+            # drop-after-retries reference contract — inside a high-rate
+            # pipeline a slower downstream throttles its upstream. The wait
+            # is a 1 ms-poll loop over ALL not-yet-sent sockets, NOT a raw
+            # blocking send, for two reasons: (a) the engine must stay
+            # stoppable while a peer stalls (a thread stuck in zmq send
+            # would make stop() raise and leak sockets); (b) skip-and-retry
+            # delivery — a single stalled peer must not head-of-line-block
+            # healthy peers in a multi-output fan-out. Note ingest still
+            # pauses until every peer accepts (that IS the flow control),
+            # so a cyclic blocking topology (A blocks on B, B on A) can
+            # deadlock until stop — wire cycles with "drop" on one edge.
+            # Stop is drain-then-close: pending sends share ONE
+            # ``out_stop_drain_ms`` window starting when the stop flag is
+            # first observed — aggregate, so a multi-message final flush
+            # stays inside the 2 s stop-join deadline.
+            pending_socks = list(self._out_socks)
+            while pending_socks:
+                if not self._running or self._stop_event.is_set():
+                    if self._stop_drain_deadline is None:
+                        self._stop_drain_deadline = (
+                            time.monotonic()
+                            + self.settings.out_stop_drain_ms / 1000.0)
+                    if time.monotonic() >= self._stop_drain_deadline:
+                        break
+                still: List[EngineSocket] = []
+                for sock in pending_socks:
+                    try:
+                        sock.send(data, block=False)
+                    except TransportAgain:
+                        still.append(sock)
+                        continue
+                    except TransportError as exc:
+                        self.logger.warning("output send failed hard: %s", exc)
+                        dropped_b.inc(len(data))
+                        dropped_l.inc(lines)
+                        continue
+                    mark_sent()
+                if len(still) == len(pending_socks):
+                    time.sleep(0.001)
+                pending_socks = still
+            for _ in pending_socks:  # stop-drain deadline expired
+                dropped_b.inc(len(data))
+                dropped_l.inc(lines)
+            return any_ok
+
         for sock in self._out_socks:
             sent = False
-            if blocking:
-                # flow-control mode: wait for the peer instead of the
-                # drop-after-retries reference contract — inside a high-rate
-                # pipeline a slower downstream throttles its upstream. The
-                # wait is a 1 ms-poll loop, NOT a raw blocking send: the
-                # engine must stay stoppable while a peer stalls (a thread
-                # stuck in zmq send would make stop() raise and leak
-                # sockets), and the message is dropped+counted at stop.
-                while self._running and not self._stop_event.is_set():
-                    try:
-                        sock.send(data, block=False)
-                        sent = True
-                        break
-                    except TransportAgain:
-                        time.sleep(0.001)
-                    except TransportError as exc:
-                        self.logger.warning("output send failed hard: %s", exc)
-                        break
-            else:
-                for _ in range(self.settings.engine_retry_count):
-                    try:
-                        sock.send(data, block=False)
-                        sent = True
-                        break
-                    except TransportAgain:
-                        time.sleep(_RETRY_SLEEP_S)
-                    except TransportError as exc:
-                        self.logger.warning("output send failed hard: %s", exc)
-                        break
+            for _ in range(self.settings.engine_retry_count):
+                try:
+                    sock.send(data, block=False)
+                    sent = True
+                    break
+                except TransportAgain:
+                    time.sleep(_RETRY_SLEEP_S)
+                except TransportError as exc:
+                    self.logger.warning("output send failed hard: %s", exc)
+                    break
             if sent:
-                any_ok = True
-                if not wrote_once:
-                    # written counted once per message, dropped once per
-                    # socket (reference: docs/prometheus.md:46-47)
-                    written_b.inc(len(data))
-                    written_l.inc(lines)
-                    wrote_once = True
+                mark_sent()
             else:
                 dropped_b.inc(len(data))
                 dropped_l.inc(lines)

@@ -250,7 +250,9 @@ class CoreDetector(CoreComponent):
             if value:
                 try:
                     return int(float(value))
-                except ValueError:
+                except (ValueError, OverflowError):
+                    # '1e400'/'inf' must mean "no timestamp", not an exception
+                    # that escapes process() and drops unrelated messages
                     return None
         if input_.get("receivedTimestamp"):
             return int(input_["receivedTimestamp"])

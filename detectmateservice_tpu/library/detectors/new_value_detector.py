@@ -18,6 +18,7 @@ docs/getting_started.md:505-510:
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Optional, Set, Tuple
 
 from ...schemas import DetectorSchema, ParserSchema
@@ -116,6 +117,7 @@ class NewValueDetector(CoreDetector):
         plans = self._plan_cache
         outs: list = []
         decode_errors = 0
+        build_errors = 0
         for data in batch:
             msg = _pb.ParserSchema()
             try:
@@ -158,10 +160,23 @@ class NewValueDetector(CoreDetector):
             if training or alerts is None:
                 outs.append(None)
                 continue
-            outs.append(self._make_alert_pb(msg, score, alerts))
+            try:
+                outs.append(self._make_alert_pb(msg, score, alerts))
+            except Exception:
+                # one poisoned message must cost one message, never the chunk;
+                # counted separately from decode errors — this is a
+                # post-decode alert-construction failure, and mislabeling it
+                # "undecodable" would send the operator chasing the wire
+                build_errors += 1
+                logging.getLogger(__name__).exception(
+                    "alert construction failed for decodable message")
+                outs.append(None)
         if decode_errors:
             self.count_processing_errors(decode_errors,
                                          "undecodable ParserSchema message(s)")
+        if build_errors:
+            self.count_processing_errors(build_errors,
+                                         "alert-construction failure(s)")
         return outs
 
     def _make_alert_pb(self, msg, score: float, alerts: Dict[str, str]) -> bytes:
@@ -188,7 +203,9 @@ class NewValueDetector(CoreDetector):
             if value:
                 try:
                     ts = int(float(value))
-                except ValueError:
+                except (ValueError, OverflowError):
+                    # OverflowError: attacker-controllable '1e400'/'inf' must
+                    # degrade to now, not escape and sink the whole batch
                     ts = now
                 break
         else:

@@ -165,7 +165,9 @@ class MatcherParser(CoreComponent):
             try:
                 parsed = time.strptime(header_vars["Time"], self.config.time_format)
                 header_vars["Time"] = str(int(time.mktime(parsed)))
-            except ValueError:
+            except (ValueError, OverflowError, OSError):
+                # mktime can raise OverflowError/OSError on out-of-range years;
+                # a bad Time keeps its raw string, never aborts the batch
                 pass
         return header_vars, content
 

@@ -151,12 +151,25 @@ class ServiceSettings(BaseModel):
     # (~80k msg/s per Python sender, measured). 1 = single-message wire,
     # compatible with reference-style peers; receivers auto-detect either.
     engine_frame_batch: int = Field(default=1, ge=1, le=8192)
+    # ingress batch-frame auto-detection rests on every pipeline payload
+    # being protobuf (no valid protobuf message starts with the 0xD7 magic —
+    # wire type 7 does not exist). A pipeline carrying NON-protobuf payloads
+    # that could legitimately begin with b"\xd7DM\x01" (UTF-8 "×DM…") must
+    # disable detection or such a payload would be mis-split/dropped.
+    engine_frame_autodetect: bool = True
     # fan-out under backpressure: "drop" = the reference contract (bounded
     # retries with 10 ms sleeps, then drop + count — engine.py:286-296);
     # "block" = flow control (send blocks until the peer drains), the right
     # mode INSIDE a high-rate pipeline where a slower downstream stage must
     # throttle its upstream instead of losing data in 100 ms retry windows.
     out_backpressure: str = Field(default="drop", pattern="^(drop|block)$")
+    # drain-then-close: in "block" mode a stop() no longer abandons the
+    # in-flight message immediately — pending sends share ONE window of this
+    # many milliseconds (starting when the stop flag is first observed by a
+    # blocked send) to land before being dropped+counted. Aggregate across
+    # all messages the final flush emits, so the le=1500 cap keeps it under
+    # the engine's 2 s stop-join deadline.
+    out_stop_drain_ms: float = Field(default=250.0, ge=0.0, le=1500.0)
     # transport_backend selects the data-plane implementation: "native" is
     # the in-tree C++ transport (native/transport), "zmq" the Python pyzmq
     # backend; both are wire-compatible. "auto" prefers native when built.

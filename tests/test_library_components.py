@@ -176,6 +176,42 @@ class TestMatcherParser:
                 assert str(da.get(field)) == str(db.get(field)), field
             assert list(da["extractedTimestamps"]) == list(db["extractedTimestamps"])
 
+    def test_mktime_overflow_contained(self, monkeypatch):
+        """time.mktime can raise OverflowError/OSError on out-of-range years
+        on some platforms (advisor round-2 low finding): the line must keep
+        its raw Time and parse, and one bad line must not abort the batch.
+        This platform's glibc mktime accepts year 1, so the failure is
+        injected."""
+        import time as _time
+
+        import detectmateservice_tpu.library.parsers.template_matcher as tm
+
+        config = {"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": "<Time> <Content>", "time_format": "%Y",
+            "params": {"lowercase": False, "remove_spaces": False,
+                       "remove_punctuation": False, "path_templates": None},
+        }}}
+        parser = MatcherParser(config=config)
+
+        real_mktime = _time.mktime
+
+        def exploding_mktime(t):
+            if t.tm_year == 1234:
+                raise OverflowError("mktime argument out of range")
+            return real_mktime(t)
+
+        monkeypatch.setattr(tm.time, "mktime", exploding_mktime)
+        out = parser.process(LogSchema(logID="1", log="1234 boom").serialize())
+        assert out is not None
+        assert dict(ParserSchema.from_bytes(out).logFormatVariables)["Time"] == "1234"
+        outs = parser.process_batch([
+            LogSchema(logID="1", log="1234 boom").serialize(),
+            LogSchema(logID="2", log="2026 fine").serialize(),
+        ])
+        assert outs[0] is not None and outs[1] is not None
+        assert dict(ParserSchema.from_bytes(outs[1]).logFormatVariables)["Time"] != "2026"
+
     def test_process_batch_counts_decode_errors(self):
         """Corrupt frames in a batch are dropped VISIBLY: error counter +
         log, matching the single-message path's LibraryError handling."""
@@ -259,6 +295,49 @@ class TestNewValueDetector:
     def test_empty_config_never_alerts(self):
         det = NewValueDetector()
         assert det.process(parsed("/anything")) is None
+
+    def test_overflow_time_degrades_to_now(self):
+        """Attacker-controllable Time='1e400' (float inf → OverflowError on
+        int()) must degrade to now, not escape as an exception (advisor
+        round-2 medium finding)."""
+        det = NewValueDetector(config=nvd_config(training=1))
+        det.process(parsed("/a"))
+        for poison in ("1e400", "inf", "-inf", "nan"):
+            raw = ParserSchema(
+                EventID=1, logID="p",
+                logFormatVariables={"URL": "/evil-" + poison, "Time": poison},
+            ).serialize()
+            out = det.process(raw)
+            assert out is not None, poison
+            alert = DetectorSchema.from_bytes(out)
+            assert alert.extractedTimestamps[0] > 1_500_000_000  # ≈ now
+
+    def test_poisoned_message_does_not_sink_batch(self):
+        """One poisoned message in a micro-batch costs one message, never the
+        chunk: the healthy alert in the same batch still comes out."""
+        det = NewValueDetector(config=nvd_config(training=1))
+        det.process(parsed("/a"))
+        poison = ParserSchema(
+            EventID=1, logFormatVariables={"URL": "/evil1", "Time": "1e400"},
+        ).serialize()
+        healthy = ParserSchema(
+            EventID=1, logID="h",
+            logFormatVariables={"URL": "/evil2", "Time": "1700000000"},
+        ).serialize()
+        outs = det.process_batch([poison, healthy])
+        assert len(outs) == 2
+        assert outs[0] is not None  # overflow degraded to now, alert kept
+        assert outs[1] is not None
+        alert = DetectorSchema.from_bytes(outs[1])
+        assert list(alert.logIDs) == ["h"]
+
+    def test_extract_timestamp_overflow_returns_none(self):
+        from detectmateservice_tpu.library.common.detector import CoreDetector
+
+        assert CoreDetector.extract_timestamp(
+            ParserSchema(logFormatVariables={"Time": "1e400"})) is None
+        assert CoreDetector.extract_timestamp(
+            ParserSchema(logFormatVariables={"Time": "inf"})) is None
 
 
 class TestNewValueComboDetector:
