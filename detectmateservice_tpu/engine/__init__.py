@@ -7,6 +7,7 @@ from .socket import (
     TransportError,
     TransportTimeout,
     ZmqPairSocketFactory,
+    NngTcpSocketFactory,
     InprocQueueSocketFactory,
     make_socket_factory,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "TransportError",
     "TransportTimeout",
     "ZmqPairSocketFactory",
+    "NngTcpSocketFactory",
     "InprocQueueSocketFactory",
     "make_socket_factory",
 ]

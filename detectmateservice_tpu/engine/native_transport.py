@@ -235,6 +235,10 @@ class NativePairSocketFactory:
             from .socket import TlsTcpSocketFactory
 
             return TlsTcpSocketFactory()
+        if scheme == "nng+tcp":
+            from .socket import NngTcpSocketFactory
+
+            return NngTcpSocketFactory()
         if scheme in ("ws", "inproc"):
             from .socket import ZmqPairSocketFactory
 

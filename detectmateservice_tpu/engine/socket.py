@@ -178,7 +178,7 @@ class ZmqPairSocketFactory:
     """Default factory (role of the reference's NngPairSocketFactory,
     engine_socket.py:35-78)."""
 
-    SCHEMES = ("ipc", "tcp", "inproc", "ws")
+    SCHEMES = ("ipc", "tcp", "inproc")
 
     def create(self, addr: str, logger: Optional[logging.Logger] = None,
                tls_config: Optional[object] = None) -> EngineSocket:
@@ -187,6 +187,13 @@ class ZmqPairSocketFactory:
         if scheme == "tls+tcp":
             factory = TlsTcpSocketFactory()
             return factory.create(addr, logger, tls_config)
+        if scheme == "nng+tcp":
+            return NngTcpSocketFactory().create(addr, logger, tls_config)
+        if scheme == "ws":
+            # the Python RFC6455 transport, NOT libzmq's ws (a compile-time
+            # option this image's libzmq lacks) — and wire-compatible with
+            # NNG ws peers, which zmq's ws would not be
+            return WsSocketFactory().create(addr, logger, tls_config)
         if scheme not in self.SCHEMES:
             raise TransportError(f"unsupported scheme {scheme!r} in {addr!r}")
         unlink = None
@@ -223,6 +230,12 @@ class ZmqPairSocketFactory:
         if scheme == "tls+tcp":
             factory = TlsTcpSocketFactory()
             return factory.create_output(addr, logger, tls_config, dial_timeout, buffer_size)
+        if scheme == "nng+tcp":
+            return NngTcpSocketFactory().create_output(addr, logger, tls_config,
+                                                       dial_timeout, buffer_size)
+        if scheme == "ws":
+            return WsSocketFactory().create_output(addr, logger, tls_config,
+                                                   dial_timeout, buffer_size)
         if scheme not in self.SCHEMES:
             raise TransportError(f"unsupported scheme {scheme!r} in {addr!r}")
         sock = _context().socket(zmq.DEALER)
@@ -244,7 +257,9 @@ class ZmqPairSocketFactory:
 
 
 # ---------------------------------------------------------------------------
-# tls+tcp backend: length-prefixed frames over ssl-wrapped TCP
+# framed-TCP core: length-prefixed frames over a (possibly wrapped) stream.
+# Two users: the tls+tcp backend (ssl wrap, 4-byte frames) and the NNG
+# SP-wire backend (plain TCP, SP handshake, 8-byte frames).
 # ---------------------------------------------------------------------------
 
 _FRAME_HDR = struct.Struct("!I")
@@ -252,19 +267,20 @@ _MAX_FRAME = 64 * 1024 * 1024
 
 
 class _FramedConn:
-    """One established TLS connection with 4-byte length framing."""
+    """One established stream connection with length-prefix framing."""
 
-    def __init__(self, sock: _stdsocket.socket):
+    def __init__(self, sock: _stdsocket.socket, hdr: struct.Struct = _FRAME_HDR):
         self.sock = sock
         self.send_lock = threading.Lock()
+        self._hdr = hdr
 
     def send_frame(self, data: bytes) -> None:
         with self.send_lock:
-            self.sock.sendall(_FRAME_HDR.pack(len(data)) + data)
+            self.sock.sendall(self._hdr.pack(len(data)) + data)
 
     def recv_frame(self) -> bytes:
-        hdr = self._recv_exact(_FRAME_HDR.size)
-        (length,) = _FRAME_HDR.unpack(hdr)
+        hdr = self._recv_exact(self._hdr.size)
+        (length,) = self._hdr.unpack(hdr)
         if length > _MAX_FRAME:
             raise TransportError(f"oversized frame: {length} bytes")
         return self._recv_exact(length)
@@ -285,15 +301,20 @@ class _FramedConn:
             pass
 
 
-class TlsTcpListener:
-    """Server side of tls+tcp://. Accepts any number of dialers (fan-in, like
-    many NNG dialers to one listener) and merges their frames into one recv
-    queue. Replies go to the connection the last message arrived on."""
+class FramedTcpListener:
+    """Server side of a framed-TCP transport. Accepts any number of dialers
+    (fan-in, like many NNG dialers to one listener) and merges their frames
+    into one recv queue. Replies go to the connection the last message
+    arrived on. ``prepare(raw_sock, server_side)`` turns an accepted TCP
+    connection into a ``_FramedConn`` (ssl wrap for tls+tcp, SP handshake
+    for nng+tcp) or raises to reject the peer."""
 
-    def __init__(self, host: str, port: int, ssl_ctx: ssl.SSLContext,
-                 logger: logging.Logger, buffer_size: int = 100):
+    def __init__(self, host: str, port: int, prepare,
+                 logger: logging.Logger, buffer_size: int = 100,
+                 label: str = "framed+tcp"):
         self._logger = logger
-        self._ssl_ctx = ssl_ctx
+        self._prepare = prepare
+        self._label = label
         self._rq: "queue.Queue" = queue.Queue(maxsize=max(1, buffer_size))
         self._conns: List[_FramedConn] = []
         self._conns_lock = threading.Lock()
@@ -307,9 +328,9 @@ class TlsTcpListener:
             self._listener.listen(16)
         except OSError as exc:
             self._listener.close()
-            raise TransportError(f"cannot listen on tls+tcp://{host}:{port}: {exc}") from exc
+            raise TransportError(f"cannot listen on {label}://{host}:{port}: {exc}") from exc
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True,
-                                               name="TlsAccept")
+                                               name=f"{label}-accept")
         self._accept_thread.start()
 
     @property
@@ -327,16 +348,16 @@ class TlsTcpListener:
             except OSError:
                 return
             try:
-                tls_conn = self._ssl_ctx.wrap_socket(raw_conn, server_side=True)
-            except (ssl.SSLError, OSError) as exc:
-                self._logger.warning("TLS handshake failed from %s: %s", peer, exc)
+                conn = self._prepare(raw_conn, True)
+            except (ssl.SSLError, OSError, TransportError) as exc:
+                self._logger.warning("%s handshake failed from %s: %s",
+                                     self._label, peer, exc)
                 raw_conn.close()
                 continue
-            conn = _FramedConn(tls_conn)
             with self._conns_lock:
                 self._conns.append(conn)
             threading.Thread(target=self._reader_loop, args=(conn,), daemon=True,
-                             name="TlsReader").start()
+                             name=f"{self._label}-reader").start()
 
     def _reader_loop(self, conn: _FramedConn) -> None:
         try:
@@ -353,7 +374,7 @@ class TlsTcpListener:
 
     def recv(self) -> bytes:
         if self._closed.is_set():
-            raise TransportClosed("recv on closed tls listener")
+            raise TransportClosed(f"recv on closed {self._label} listener")
         timeout = None if self._recv_timeout is None else self._recv_timeout / 1000.0
         try:
             conn, frame = self._rq.get(timeout=timeout)
@@ -364,7 +385,7 @@ class TlsTcpListener:
 
     def send(self, data: bytes, block: bool = True) -> None:
         if self._closed.is_set():
-            raise TransportClosed("send on closed tls listener")
+            raise TransportClosed(f"send on closed {self._label} listener")
         conn = self._last_conn
         if conn is None:
             with self._conns_lock:
@@ -390,16 +411,19 @@ class TlsTcpListener:
             self._conns.clear()
 
 
-class TlsTcpDialer:
-    """Client side of tls+tcp:// with background redial (parity with nng
-    dial(block=False) + reconnect, reference: engine.py:148,172-175)."""
+class FramedTcpDialer:
+    """Client side of a framed-TCP transport with background redial (parity
+    with nng dial(block=False) + reconnect, reference: engine.py:148,172-175).
+    ``prepare(raw_sock, server_side)`` performs the ssl wrap / SP handshake
+    and returns the framed connection."""
 
-    def __init__(self, host: str, port: int, ssl_ctx: ssl.SSLContext,
-                 server_name: Optional[str], logger: logging.Logger,
-                 dial_timeout_ms: Optional[int], buffer_size: int = 100):
+    def __init__(self, host: str, port: int, prepare,
+                 logger: logging.Logger,
+                 dial_timeout_ms: Optional[int], buffer_size: int = 100,
+                 label: str = "framed+tcp"):
         self._host, self._port = host, port
-        self._ssl_ctx = ssl_ctx
-        self._server_name = server_name or host
+        self._prepare = prepare
+        self._label = label
         self._logger = logger
         self._dial_timeout = (dial_timeout_ms or 1000) / 1000.0
         self._conn: Optional[_FramedConn] = None
@@ -408,7 +432,7 @@ class TlsTcpDialer:
         self._closed = threading.Event()
         self._recv_timeout: Optional[int] = None
         self._dial_thread = threading.Thread(target=self._dial_loop, daemon=True,
-                                             name="TlsDialer")
+                                             name=f"{label}-dialer")
         self._dial_thread.start()
 
     @property
@@ -430,14 +454,13 @@ class TlsTcpDialer:
             try:
                 raw = _stdsocket.create_connection((self._host, self._port),
                                                    timeout=self._dial_timeout)
-                tls = self._ssl_ctx.wrap_socket(raw, server_hostname=self._server_name)
-                conn = _FramedConn(tls)
+                conn = self._prepare(raw, False)
                 with self._conn_lock:
                     self._conn = conn
                 threading.Thread(target=self._reader_loop, args=(conn,), daemon=True,
-                                 name="TlsDialReader").start()
+                                 name=f"{self._label}-dial-reader").start()
                 backoff = 0.05
-            except (OSError, ssl.SSLError):
+            except (OSError, ssl.SSLError, TransportError):
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 1.0)
 
@@ -455,7 +478,7 @@ class TlsTcpDialer:
 
     def recv(self) -> bytes:
         if self._closed.is_set():
-            raise TransportClosed("recv on closed tls dialer")
+            raise TransportClosed(f"recv on closed {self._label} dialer")
         timeout = None if self._recv_timeout is None else self._recv_timeout / 1000.0
         try:
             return self._rq.get(timeout=timeout)
@@ -464,7 +487,7 @@ class TlsTcpDialer:
 
     def send(self, data: bytes, block: bool = True) -> None:
         if self._closed.is_set():
-            raise TransportClosed("send on closed tls dialer")
+            raise TransportClosed(f"send on closed {self._label} dialer")
         with self._conn_lock:
             conn = self._conn
         if conn is None:
@@ -517,7 +540,11 @@ class TlsTcpSocketFactory:
             ssl_ctx.load_cert_chain(tls_config.cert_key_file)
         except (OSError, ssl.SSLError) as exc:
             raise TransportError(f"cannot load TLS cert/key {tls_config.cert_key_file}: {exc}") from exc
-        return TlsTcpListener(host, port, ssl_ctx, logger)
+
+        def prepare(raw: _stdsocket.socket, server_side: bool) -> _FramedConn:
+            return _FramedConn(ssl_ctx.wrap_socket(raw, server_side=True))
+
+        return FramedTcpListener(host, port, prepare, logger, label="tls+tcp")
 
     def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
                       tls_config: Optional[object] = None,
@@ -535,9 +562,341 @@ class TlsTcpSocketFactory:
             ssl_ctx.load_verify_locations(tls_config.ca_file)
         except (OSError, ssl.SSLError) as exc:
             raise TransportError(f"cannot load TLS CA {tls_config.ca_file}: {exc}") from exc
-        server_name = getattr(tls_config, "server_name", None)
-        return TlsTcpDialer(host, port, ssl_ctx, server_name, logger, dial_timeout,
-                            buffer_size)
+        server_name = getattr(tls_config, "server_name", None) or host
+
+        def prepare(raw: _stdsocket.socket, server_side: bool) -> _FramedConn:
+            return _FramedConn(ssl_ctx.wrap_socket(raw, server_hostname=server_name))
+
+        return FramedTcpDialer(host, port, prepare, logger, dial_timeout,
+                               buffer_size, label="tls+tcp")
+
+
+# ---------------------------------------------------------------------------
+# nng+tcp backend: NNG/nanomsg SP wire protocol (Pair0 over TCP), so real
+# NNG peers — e.g. a reference-style fluentd with fluent-plugin-nng
+# (reference: container/Dockerfile_fluentd:5-9) — can dial this data plane
+# without libnng on either linking path here.
+#
+# Wire format (nanomsg TCP mapping, which NNG's tcp transport speaks):
+#   on connect, both peers send 8 bytes:  0x00 'S' 'P' 0x00  proto_be16  0x0000
+#   (Pair0's protocol number is 16); a peer whose header disagrees is
+#   rejected. After the handshake every message is
+#   uint64_be length | payload.
+# ---------------------------------------------------------------------------
+
+SP_PAIR0_PROTO = 16
+_SP_HDR = struct.Struct("!Q")  # u64 BE message length
+
+
+def sp_handshake_bytes(proto: int = SP_PAIR0_PROTO) -> bytes:
+    return b"\x00SP\x00" + struct.pack("!HH", proto, 0)
+
+
+def _sp_prepare(raw: _stdsocket.socket, server_side: bool) -> _FramedConn:
+    """Exchange and validate the SP protocol header (both directions —
+    TCP is full duplex and NNG sends immediately on connect)."""
+    raw.sendall(sp_handshake_bytes())
+    saved = raw.gettimeout()
+    raw.settimeout(5.0)  # a silent non-SP peer must not wedge the accept loop
+    try:
+        got = bytearray()
+        while len(got) < 8:
+            chunk = raw.recv(8 - len(got))
+            if not chunk:
+                raise TransportError("peer closed during SP handshake")
+            got.extend(chunk)
+    except OSError as exc:
+        raise TransportError(f"SP handshake read failed: {exc}") from exc
+    finally:
+        raw.settimeout(saved)
+    if bytes(got[:4]) != b"\x00SP\x00":
+        raise TransportError(f"not an SP peer (header {bytes(got[:4])!r})")
+    (proto, _reserved) = struct.unpack("!HH", bytes(got[4:]))
+    if proto != SP_PAIR0_PROTO:
+        raise TransportError(
+            f"SP protocol mismatch: peer speaks {proto}, want Pair0 ({SP_PAIR0_PROTO})")
+    return _FramedConn(raw, hdr=_SP_HDR)
+
+
+# ---------------------------------------------------------------------------
+# ws backend: RFC 6455 WebSocket, NNG dialect — one pipeline message per
+# binary ws message, subprotocol "pair.sp.nanomsg.org" (what NNG's ws://
+# transport speaks, reference: settings.py:31-37 lists ws among the NNG
+# schemes). Implemented over the framed-TCP listener/dialer machinery with
+# a ws "conn" in place of the length-prefix codec, so this build needs
+# neither libzmq's compile-time ws option nor libnng.
+# ---------------------------------------------------------------------------
+
+_WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+_WS_SUBPROTO = "pair.sp.nanomsg.org"
+
+
+def _ws_accept_key(key: str) -> str:
+    import base64
+    import hashlib
+
+    return base64.b64encode(
+        hashlib.sha1(key.encode() + _WS_GUID).digest()).decode()
+
+
+def _ws_xor(data: bytes, mask: bytes) -> bytes:
+    """Apply the RFC 6455 masking XOR. Data-plane hot path: every client→
+    server byte passes through this, so it must NOT be a per-byte Python
+    loop (1 interpreter op/byte ≈ seconds on a 64 MB frame). int.xor runs
+    in C over the whole buffer."""
+    n = len(data)
+    if n == 0:
+        return data
+    full = mask * (n // 4) + mask[: n % 4]
+    return (int.from_bytes(data, "little")
+            ^ int.from_bytes(full, "little")).to_bytes(n, "little")
+
+
+class _WsConn:
+    """One established WebSocket connection: binary messages in/out, control
+    frames handled inline (pong for ping, clean close). Duck-typed to the
+    ``_FramedConn`` surface the framed listener/dialer use."""
+
+    def __init__(self, sock: _stdsocket.socket, mask_outgoing: bool,
+                 initial: bytes = b""):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self._mask = mask_outgoing            # RFC 6455: clients MUST mask
+        # bytes the handshake read past the HTTP terminator (TCP may
+        # coalesce the peer's first frame with its handshake): consumed
+        # before any socket read, or the stream desyncs permanently
+        self._buf = bytearray(initial)
+
+    def send_frame(self, data: bytes) -> None:
+        n = len(data)
+        head = bytearray([0x82])              # FIN + binary opcode
+        mask_bit = 0x80 if self._mask else 0
+        if n < 126:
+            head.append(mask_bit | n)
+        elif n < 1 << 16:
+            head.append(mask_bit | 126)
+            head += struct.pack("!H", n)
+        else:
+            head.append(mask_bit | 127)
+            head += struct.pack("!Q", n)
+        if self._mask:
+            mask = os.urandom(4)
+            head += mask
+            data = _ws_xor(data, mask)
+        with self.send_lock:
+            self.sock.sendall(bytes(head) + data)
+
+    def recv_frame(self) -> bytes:
+        message = bytearray()
+        while True:
+            b0, b1 = self._recv_exact(2)
+            fin, opcode = b0 & 0x80, b0 & 0x0F
+            masked, length = b1 & 0x80, b1 & 0x7F
+            if length == 126:
+                (length,) = struct.unpack("!H", self._recv_exact(2))
+            elif length == 127:
+                (length,) = struct.unpack("!Q", self._recv_exact(8))
+            if length > _MAX_FRAME:
+                raise TransportError(f"oversized ws frame: {length} bytes")
+            mask = self._recv_exact(4) if masked else None
+            payload = self._recv_exact(length) if length else b""
+            if mask:
+                payload = _ws_xor(payload, mask)
+            if opcode == 0x9:                 # ping → pong, keep reading
+                self._send_control(0xA, payload)
+                continue
+            if opcode == 0xA:                 # unsolicited pong: ignore
+                continue
+            if opcode == 0x8:                 # close
+                try:
+                    self._send_control(0x8, payload[:2])
+                except OSError:
+                    pass
+                raise ConnectionError("ws peer closed")
+            if opcode in (0x1, 0x2, 0x0):     # text/binary/continuation
+                message += payload
+                if fin:
+                    return bytes(message)
+                continue
+            raise TransportError(f"unexpected ws opcode {opcode:#x}")
+
+    def _send_control(self, opcode: int, payload: bytes) -> None:
+        head = bytearray([0x80 | opcode])
+        mask_bit = 0x80 if self._mask else 0
+        head.append(mask_bit | len(payload))
+        if self._mask:
+            mask = os.urandom(4)
+            head += mask
+            payload = _ws_xor(payload, mask)
+        with self.send_lock:
+            self.sock.sendall(bytes(head) + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        if self._buf:
+            take = self._buf[:n]
+            del self._buf[:len(take)]
+            buf.extend(take)
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _ws_server_prepare(raw: _stdsocket.socket, path: str) -> _WsConn:
+    """Accept an HTTP Upgrade request and complete the ws handshake."""
+    saved = raw.gettimeout()
+    raw.settimeout(5.0)
+    try:
+        request = b""
+        while b"\r\n\r\n" not in request:
+            chunk = raw.recv(4096)
+            if not chunk:
+                raise TransportError("peer closed during ws handshake")
+            request += chunk
+            if len(request) > 64 * 1024:
+                raise TransportError("oversized ws handshake request")
+        # split at the terminator FIRST: TCP may coalesce the client's first
+        # frame with the request, and those bytes are frame data, not header
+        head, _, rest = request.partition(b"\r\n\r\n")
+        headers = {}
+        for line in head.split(b"\r\n")[1:]:
+            if b":" in line:
+                k, v = line.split(b":", 1)
+                # latin-1 never raises; a peer sending garbage header bytes
+                # must be rejected below, not kill the accept thread
+                headers[k.strip().lower().decode("latin-1")] = (
+                    v.strip().decode("latin-1"))
+        key = headers.get("sec-websocket-key")
+        if not key or "websocket" not in headers.get("upgrade", "").lower():
+            raise TransportError("not a websocket upgrade request")
+        offered = [p.strip() for p in
+                   headers.get("sec-websocket-protocol", "").split(",") if p.strip()]
+        try:
+            accept = _ws_accept_key(key)
+        except (ValueError, UnicodeEncodeError) as exc:
+            raise TransportError(f"bad Sec-WebSocket-Key: {exc}") from exc
+        lines = [
+            "HTTP/1.1 101 Switching Protocols",
+            "Upgrade: websocket",
+            "Connection: Upgrade",
+            f"Sec-WebSocket-Accept: {accept}",
+        ]
+        if _WS_SUBPROTO in offered:           # echo NNG's pair0 subprotocol
+            lines.append(f"Sec-WebSocket-Protocol: {_WS_SUBPROTO}")
+        raw.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+    finally:
+        raw.settimeout(saved)
+    return _WsConn(raw, mask_outgoing=False, initial=rest)
+
+
+def _ws_client_prepare(raw: _stdsocket.socket, host: str, port: int,
+                       path: str) -> _WsConn:
+    """Send the HTTP Upgrade request and validate the 101 response."""
+    import base64
+
+    key = base64.b64encode(os.urandom(16)).decode()
+    request = (
+        f"GET {path or '/'} HTTP/1.1\r\n"
+        f"Host: {host}:{port}\r\n"
+        "Upgrade: websocket\r\n"
+        "Connection: Upgrade\r\n"
+        f"Sec-WebSocket-Key: {key}\r\n"
+        "Sec-WebSocket-Version: 13\r\n"
+        f"Sec-WebSocket-Protocol: {_WS_SUBPROTO}\r\n"
+        "\r\n")
+    saved = raw.gettimeout()
+    raw.settimeout(5.0)
+    try:
+        raw.sendall(request.encode())
+        response = b""
+        while b"\r\n\r\n" not in response:
+            chunk = raw.recv(4096)
+            if not chunk:
+                raise TransportError("peer closed during ws handshake")
+            response += chunk
+            if len(response) > 64 * 1024:
+                raise TransportError("oversized ws handshake response")
+    finally:
+        raw.settimeout(saved)
+    # bytes past the terminator are the server's first frame(s) — keep them
+    head, _, rest = response.partition(b"\r\n\r\n")
+    status = head.split(b"\r\n", 1)[0]
+    if b"101" not in status:
+        raise TransportError(f"ws upgrade refused: {status.decode(errors='replace')}")
+    want = _ws_accept_key(key).encode()
+    if want not in head:
+        raise TransportError("ws handshake: bad Sec-WebSocket-Accept")
+    return _WsConn(raw, mask_outgoing=True, initial=rest)
+
+
+class WsSocketFactory:
+    """ws:// factory: RFC 6455 over the framed listener/dialer machinery,
+    independent of libzmq's compile-time ws option."""
+
+    def create(self, addr: str, logger: Optional[logging.Logger] = None,
+               tls_config: Optional[object] = None) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "ws":
+            raise TransportError(f"WsSocketFactory cannot handle scheme {scheme!r}")
+        host, port = _host_port(rest, addr)
+        path = "/" + rest.split("/", 1)[1] if "/" in rest else "/"
+
+        def prepare(raw: _stdsocket.socket, server_side: bool) -> _WsConn:
+            return _ws_server_prepare(raw, path)
+
+        return FramedTcpListener(host, port, prepare, logger, label="ws")
+
+    def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
+                      tls_config: Optional[object] = None,
+                      dial_timeout: Optional[int] = None,
+                      buffer_size: int = 100) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "ws":
+            raise TransportError(f"WsSocketFactory cannot handle scheme {scheme!r}")
+        host, port = _host_port(rest, addr)
+        path = "/" + rest.split("/", 1)[1] if "/" in rest else "/"
+
+        def prepare(raw: _stdsocket.socket, server_side: bool) -> _WsConn:
+            return _ws_client_prepare(raw, host, port, path)
+
+        return FramedTcpDialer(host, port, prepare, logger, dial_timeout,
+                               buffer_size, label="ws")
+
+
+class NngTcpSocketFactory:
+    """nng+tcp:// factory: SP Pair0 wire compatibility over plain TCP."""
+
+    def create(self, addr: str, logger: Optional[logging.Logger] = None,
+               tls_config: Optional[object] = None) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "nng+tcp":
+            raise TransportError(f"NngTcpSocketFactory cannot handle scheme {scheme!r}")
+        host, port = _host_port(rest, addr)
+        return FramedTcpListener(host, port, _sp_prepare, logger, label="nng+tcp")
+
+    def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
+                      tls_config: Optional[object] = None,
+                      dial_timeout: Optional[int] = None,
+                      buffer_size: int = 100) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "nng+tcp":
+            raise TransportError(f"NngTcpSocketFactory cannot handle scheme {scheme!r}")
+        host, port = _host_port(rest, addr)
+        return FramedTcpDialer(host, port, _sp_prepare, logger, dial_timeout,
+                               buffer_size, label="nng+tcp")
 
 
 # ---------------------------------------------------------------------------

@@ -39,20 +39,18 @@ from pydantic import (
 ENV_PREFIX = "DETECTMATE_"
 ENV_NESTED_DELIMITER = "__"
 
-SUPPORTED_SCHEMES = ("ipc", "tcp", "tls+tcp", "ws", "inproc")
+# nng+tcp is a TPU-build addition beyond the reference scheme set: the NNG
+# SP Pair0 wire protocol over plain TCP, so real NNG/fluentd peers can dial
+# this data plane (engine/socket.py NngTcpSocketFactory).
+SUPPORTED_SCHEMES = ("ipc", "tcp", "tls+tcp", "nng+tcp", "ws", "inproc")
 
 
-def _ws_available() -> bool:
-    """ws:// rides libzmq's WebSocket transport, which is a compile-time
-    option many builds (including this image's) lack. Validation fails the
-    scheme up front when the capability is absent — the alternative is a
-    runtime "Protocol not supported" AFTER settings said everything was fine."""
-    try:
-        import zmq
-
-        return bool(zmq.has("ws"))
-    except Exception:
-        return False
+# ws:// historical note: through round 2, ws rode libzmq's WebSocket
+# transport — a compile-time option this image's libzmq lacks, so settings
+# validation probed zmq.has("ws") and failed the scheme up front. Round 3
+# replaced that with an in-tree RFC 6455 transport (engine/socket.py
+# WsSocketFactory, NNG ws dialect), making the scheme unconditionally
+# available; the probe is gone.
 
 
 class SettingsError(Exception):
@@ -71,13 +69,9 @@ def _validate_addr(addr: str) -> str:
     scheme, rest = addr.split("://", 1)
     if scheme not in SUPPORTED_SCHEMES:
         raise ValueError(f"unsupported scheme {scheme!r} in {addr!r}; expected one of {SUPPORTED_SCHEMES}")
-    if scheme == "ws" and not _ws_available():
-        raise ValueError(
-            f"{addr!r}: this libzmq build has no WebSocket transport "
-            "(zmq.has('ws') is false); use tcp:// or ipc:// instead")
     if not rest:
         raise ValueError(f"address {addr!r} has an empty target")
-    if scheme in ("tcp", "tls+tcp", "ws"):
+    if scheme in ("tcp", "tls+tcp", "nng+tcp", "ws"):
         host_port = rest.split("/", 1)[0]
         if ":" not in host_port:
             raise ValueError(f"address {addr!r} requires an explicit port")
@@ -144,7 +138,7 @@ class ServiceSettings(BaseModel):
     # -- TPU-build additions ----------------------------------------------
     # engine_batch_size == 1 keeps the reference's strict per-message
     # contract; > 1 enables micro-batched dispatch to the accelerator.
-    engine_batch_size: int = Field(default=1, ge=1, le=4096)
+    engine_batch_size: int = Field(default=1, ge=1, le=16384)
     engine_batch_timeout_ms: float = Field(default=2.0, ge=0.0)
     # pack up to N results per outgoing wire frame (engine/framing.py):
     # amortizes the per-message socket cost that caps stage-to-stage rates
