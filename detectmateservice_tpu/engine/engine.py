@@ -35,7 +35,7 @@ from typing import List, Optional, Protocol, runtime_checkable
 
 from ..settings import ServiceSettings
 from . import metrics as m
-from .framing import FramingError, pack_batch, unpack_batch
+from .framing import FramingError, frame_msg_count, pack_batch, unpack_batch
 from .socket import (
     EngineSocket,
     EngineSocketFactory,
@@ -238,6 +238,34 @@ class Engine:
         read_l.inc(sum(map(_count_lines, msgs)))
         return msgs
 
+    def _collect_burst(self, deadline: float, remaining_fn, on_frame) -> None:
+        """Drain further wire frames from the input socket until ``deadline``
+        or until ``remaining_fn()`` (items still wanted, also the recv_many
+        count hint) drops to zero; ``on_frame`` consumes each non-empty
+        frame. One home for the recv_many probe and the recv-timeout
+        save/restore subtlety, shared by the classic micro-batch and the
+        fused-frame collection paths."""
+        recv_many = getattr(self._pair_sock, "recv_many", None)
+        saved_timeout = (None if callable(recv_many)
+                         else self._pair_sock.recv_timeout)
+        while remaining_fn() > 0:
+            remaining_ms = (deadline - time.monotonic()) * 1000.0
+            if remaining_ms <= 0:
+                break
+            try:
+                if callable(recv_many):
+                    frames = recv_many(remaining_fn(), max(1, int(remaining_ms)))
+                else:
+                    self._pair_sock.recv_timeout = max(1, int(remaining_ms))
+                    frames = [self._pair_sock.recv()]
+            except (TransportTimeout, TransportError):
+                break
+            for nxt in frames:
+                if nxt:
+                    on_frame(nxt)
+        if saved_timeout is not None:
+            self._pair_sock.recv_timeout = saved_timeout
+
     def _run_loop(self) -> None:
         read_b = m.DATA_READ_BYTES().labels(**self._labels)
         read_l = m.DATA_READ_LINES().labels(**self._labels)
@@ -245,6 +273,15 @@ class Engine:
         batch_size = max(1, self.settings.engine_batch_size)
         batch_fn = getattr(self.processor, "process_batch", None)
         use_batches = batch_size > 1 and callable(batch_fn)
+        # fused-frame mode: a processor exposing process_frames(frames) ->
+        # (outputs, n_messages) takes whole wire frames — frame expansion
+        # and per-message work happen inside the component (natively for
+        # the jax scorer), so the engine loop holds no per-message Python
+        # objects at all. Requires frame auto-detection semantics (the
+        # component unpacks by magic), hence the autodetect gate.
+        frames_fn = getattr(self.processor, "process_frames", None)
+        use_frames = (use_batches and callable(frames_fn)
+                      and getattr(self.settings, "engine_frame_autodetect", True))
         batch_timeout_s = self.settings.engine_batch_timeout_ms / 1000.0
         if self.settings.engine_frame_batch > 1 and not use_batches:
             # results arrive at _send_results one at a time in this mode, so
@@ -299,6 +336,36 @@ class Engine:
                 continue
             if not raw:
                 continue
+
+            if use_frames:
+                # collect the burst as whole frames (each may pack hundreds
+                # of messages); the component expands + featurizes natively.
+                # The burst is capped by ESTIMATED contained messages
+                # (frame_msg_count reads just the header varint), so the
+                # component's per-call batch cap holds to within one
+                # frame's overshoot — without it a sustained packed burst
+                # would hand the component millions of messages per call.
+                read_b.inc(len(raw))
+                frames = [raw]
+                est = [frame_msg_count(raw)]
+
+                def on_frame(nxt: bytes) -> None:
+                    read_b.inc(len(nxt))
+                    frames.append(nxt)
+                    est[0] += frame_msg_count(nxt)
+
+                self._collect_burst(time.monotonic() + batch_timeout_s,
+                                    lambda: batch_size - est[0], on_frame)
+                try:
+                    outs, _n_msgs, n_lines = frames_fn(frames)
+                except Exception as exc:
+                    err_c.inc(len(frames))
+                    self.logger.error("process_frames() raised: %s", exc)
+                    continue
+                read_l.inc(n_lines)
+                self._send_results(outs)
+                continue
+
             msgs = self._expand_frame(raw, read_b, read_l, err_c)
             if not msgs:
                 continue
@@ -320,27 +387,11 @@ class Engine:
             # crossing; other sockets fall back to one recv per frame. A
             # packed frame may carry the whole batch in one recv.
             batch = msgs
-            deadline = time.monotonic() + batch_timeout_s
-            recv_many = getattr(self._pair_sock, "recv_many", None)
-            saved_timeout = None if callable(recv_many) else self._pair_sock.recv_timeout
-            while len(batch) < batch_size:
-                remaining_ms = (deadline - time.monotonic()) * 1000.0
-                if remaining_ms <= 0:
-                    break
-                try:
-                    if callable(recv_many):
-                        frames = recv_many(batch_size - len(batch),
-                                           max(1, int(remaining_ms)))
-                    else:
-                        self._pair_sock.recv_timeout = max(1, int(remaining_ms))
-                        frames = [self._pair_sock.recv()]
-                except (TransportTimeout, TransportError):
-                    break
-                for nxt in frames:
-                    if nxt:
-                        batch.extend(self._expand_frame(nxt, read_b, read_l, err_c))
-            if saved_timeout is not None:
-                self._pair_sock.recv_timeout = saved_timeout
+            self._collect_burst(
+                time.monotonic() + batch_timeout_s,
+                lambda: batch_size - len(batch),
+                lambda nxt: batch.extend(
+                    self._expand_frame(nxt, read_b, read_l, err_c)))
             # a packed ingress frame can carry more messages than
             # engine_batch_size; re-chunk so the component never sees a batch
             # beyond the configured cap (its memory/latency contract)

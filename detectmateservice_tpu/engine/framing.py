@@ -66,6 +66,22 @@ def pack_batch(messages: List[bytes]) -> bytes:
     return bytes(out)
 
 
+def frame_msg_count(data: bytes) -> int:
+    """Cheap message-count estimate for burst sizing: the header varint of a
+    batch frame, 1 for a single message, 0 for an empty/garbled header. Does
+    NOT validate the body — use ``unpack_batch`` (or the native kernel's
+    count pass) for that."""
+    if not data:
+        return 0
+    if not data.startswith(MAGIC):
+        return 1
+    try:
+        count, _ = _get_varint(data, len(MAGIC))
+    except FramingError:
+        return 0
+    return count
+
+
 def unpack_batch(data: bytes) -> Optional[List[bytes]]:
     """Batch frame → messages; None when ``data`` is a plain single message
     (no magic). Raises FramingError on a corrupt batch body."""

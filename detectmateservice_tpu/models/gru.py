@@ -50,6 +50,8 @@ class GRUScorerConfig:
     # 0 = mean NLL over observed tokens; k > 0 = mean of the k most
     # surprising (same knob as LogBERTConfig.score_topk)
     score_topk: int = 0
+    # candidate-vocab approximate NLL (same knob as LogBERTConfig.score_vocab)
+    score_vocab: int = 0
 
 
 class GRULM(nn.Module):

@@ -43,6 +43,10 @@ class LogBERTConfig:
     # 0 = mean NLL over all observed tokens; k > 0 = mean of the k most
     # surprising tokens (sharper for single-field anomalies)
     score_topk: int = 0
+    # 0 = exact full-vocab NLL; 0 < C < vocab_size = candidate-vocab
+    # approximation (models/base.py _token_nlls_candidate): ~V/C fewer head
+    # FLOPs, the family's device bottleneck (66k → 262k lines/s at C=2048)
+    score_vocab: int = 0
     # "auto" = pallas flash kernel on TPU for long sequences, fused einsum
     # otherwise; "einsum" | "flash" | "blockwise" force a path
     attn_impl: str = "auto"

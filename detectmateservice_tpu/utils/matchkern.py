@@ -98,6 +98,19 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
     ]
+    lib.dm_count_frame_msgs.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.dm_count_frame_msgs.restype = ctypes.c_int64
+    lib.dm_featurize_frames.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int32,
+    ]
+    lib.dm_featurize_frames.restype = ctypes.c_int64
     return lib
 
 
@@ -126,6 +139,83 @@ def featurize_batch(msgs: Sequence[bytes], seq_len: int,
         seq_len, vocab_size,
     )
     return out, ok.astype(bool)
+
+
+class FrameBatch:
+    """Result of ``featurize_frames``: token rows plus lazy raw access.
+
+    ``raws[i]`` slices the original frame blob only when asked — on the hot
+    path only the ~1% anomalous messages (alert construction) and mid-fit
+    backlog entries ever materialize their bytes.
+    """
+
+    __slots__ = ("tokens", "ok", "blob", "spans", "n_corrupt_frames", "n_lines")
+
+    def __init__(self, tokens: np.ndarray, ok: np.ndarray, blob: bytes,
+                 spans: np.ndarray, n_corrupt_frames: int, n_lines: int):
+        self.tokens = tokens
+        self.ok = ok
+        self.blob = blob
+        self.spans = spans                      # [n, 2] int64 [start, end)
+        self.n_corrupt_frames = n_corrupt_frames
+        self.n_lines = n_lines                  # engine newline-rule total
+
+    def __len__(self) -> int:
+        return len(self.ok)
+
+    def raw(self, i: int) -> bytes:
+        s, e = self.spans[i]
+        return self.blob[s:e]
+
+
+class SpanRaws:
+    """List-of-bytes stand-in over (blob, spans): supports the indexing the
+    scorer's dispatch/drain path uses without materializing N bytes objects."""
+
+    __slots__ = ("blob", "spans")
+
+    def __init__(self, blob: bytes, spans: np.ndarray):
+        self.blob = blob
+        self.spans = spans
+
+    def __len__(self) -> int:
+        return len(self.spans)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return SpanRaws(self.blob, self.spans[i])
+        s, e = self.spans[i]
+        return self.blob[s:e]
+
+
+def featurize_frames(frames: Sequence[bytes], seq_len: int,
+                     vocab_size: int) -> FrameBatch:
+    """Wire frames (packed batch frames and/or single messages) → token
+    rows, ok flags, and lazy raw-byte spans — one C crossing for the whole
+    burst, no per-message Python objects."""
+    blob, offsets = _pack(frames)
+    n_frames = len(frames)
+    counts = np.zeros(n_frames, dtype=np.int32)
+    corrupt = np.zeros(n_frames, dtype=np.uint8)
+    lines = np.zeros(1, dtype=np.int64)
+    # the count pass filters packed empty messages (engine parity), so row
+    # allocations are sized by real payloads only — a sender cannot buy a
+    # token row for one wire byte
+    total = int(_lib.dm_count_frame_msgs(
+        blob, offsets.ctypes.data_as(_I64P), n_frames,
+        counts.ctypes.data_as(_I32P), corrupt.ctypes.data_as(_U8P),
+        lines.ctypes.data_as(_I64P)))
+    tokens = np.zeros((total, seq_len), dtype=np.int32)
+    ok = np.zeros(total, dtype=np.uint8)
+    spans = np.zeros((total, 2), dtype=np.int64)
+    if total:
+        _lib.dm_featurize_frames(
+            blob, offsets.ctypes.data_as(_I64P), n_frames,
+            counts.ctypes.data_as(_I32P), corrupt.ctypes.data_as(_U8P),
+            tokens.ctypes.data_as(_I32P), ok.ctypes.data_as(_U8P),
+            spans.ctypes.data_as(_I64P), seq_len, vocab_size)
+    return FrameBatch(tokens, ok.astype(bool), blob, spans,
+                      int(corrupt.sum()), int(lines[0]))
 
 
 def encode_batch(texts: Sequence[str], seq_len: int, vocab_size: int) -> np.ndarray:

@@ -196,6 +196,130 @@ int dm_featurize_batch(const uint8_t *msgs, const int64_t *offsets, int n,
     return 0;
 }
 
+/* ---------------- fused wire-frame featurization ----------------
+ *
+ * The service's packed wire format (engine/framing.py):
+ *   0xD7 'D' 'M' 0x01 | varint n | n x (varint len | len bytes)
+ * A frame without the magic is a single message. Fusing frame expansion
+ * with featurization removes the per-message Python objects (bytes slices,
+ * list appends, per-message loop) that set the ~6 us/msg service-path
+ * floor: the engine hands whole frames down, and per-message work happens
+ * entirely in C until alert construction (~1% of messages).
+ */
+
+static int frame_is_batch(const uint8_t *p, int len) {
+    return len >= 4 && p[0] == 0xD7 && p[1] == 'D' && p[2] == 'M' && p[3] == 0x01;
+}
+
+/* Newline line-count rule shared with the Python engine (_count_lines):
+ * newline count, plus one for a final unterminated line, minimum 1. */
+static int64_t count_lines_rule(const uint8_t *p, uint64_t len) {
+    int64_t nl = 0;
+    const uint8_t *q = p, *end = p + len;
+    while ((q = memchr(q, '\n', (size_t)(end - q))) != NULL) { nl++; q++; }
+    if (len == 0 || p[len - 1] != '\n') nl++;
+    return nl < 1 ? 1 : nl;
+}
+
+/* Count + validate the messages in each frame. counts[i] = NON-EMPTY
+ * messages in frame i (packed zero-length messages are filtered, matching
+ * the engine's expansion semantics — counting them would let a sender buy
+ * huge row allocations for one wire byte each); corrupt[i] = 1 when a
+ * batch frame's body is malformed (its count is then 0 — the caller falls
+ * back / counts the error). *lines_out (nullable) accumulates the engine's
+ * newline line-count rule over the counted messages so read metrics stay
+ * in one unit with the written/dropped side. Returns the total message
+ * count across valid frames. */
+int64_t dm_count_frame_msgs(const uint8_t *frames, const int64_t *frame_offsets,
+                            int n_frames, int32_t *counts, uint8_t *corrupt,
+                            int64_t *lines_out) {
+    int64_t total = 0, lines = 0;
+    for (int i = 0; i < n_frames; i++) {
+        const uint8_t *p = frames + frame_offsets[i];
+        int len = (int)(frame_offsets[i + 1] - frame_offsets[i]);
+        counts[i] = 0;
+        corrupt[i] = 0;
+        if (!frame_is_batch(p, len)) {
+            if (len > 0) {
+                counts[i] = 1;
+                total += 1;
+                lines += count_lines_rule(p, (uint64_t)len);
+            }
+            continue;
+        }
+        cursor_t c = { p + 4, p + len };
+        uint64_t n_msgs;
+        if (!read_varint(&c, &n_msgs) || n_msgs > (uint64_t)INT32_MAX) {
+            corrupt[i] = 1;
+            continue;
+        }
+        uint64_t seen = 0;
+        int64_t frame_count = 0, frame_lines = 0;
+        for (; seen < n_msgs; seen++) {
+            uint64_t mlen;
+            if (!read_varint(&c, &mlen) || (uint64_t)(c.end - c.p) < mlen) break;
+            if (mlen > 0) {
+                frame_count++;
+                frame_lines += count_lines_rule(c.p, mlen);
+            }
+            c.p += mlen;
+        }
+        if (seen != n_msgs || c.p != c.end) {  /* truncated or trailing bytes */
+            corrupt[i] = 1;
+            continue;
+        }
+        counts[i] = (int32_t)frame_count;
+        total += frame_count;
+        lines += frame_lines;
+    }
+    if (lines_out) *lines_out = lines;
+    return total;
+}
+
+/* Featurize every message of every (pre-validated) frame. Outputs, in frame
+ * order then message order: token rows, ok flags, and [start, end) byte
+ * spans into the frames blob so Python can lazily slice the raw bytes of
+ * just the anomalous messages. Caller sizes the outputs from
+ * dm_count_frame_msgs and zeroes `tokens`. Returns messages written. */
+int64_t dm_featurize_frames(const uint8_t *frames, const int64_t *frame_offsets,
+                            int n_frames, const int32_t *counts,
+                            const uint8_t *corrupt,
+                            int32_t *tokens, uint8_t *ok, int64_t *spans,
+                            int seq_len, int32_t vocab) {
+    int64_t m = 0;
+    for (int i = 0; i < n_frames; i++) {
+        const uint8_t *base = frames + frame_offsets[i];
+        int len = (int)(frame_offsets[i + 1] - frame_offsets[i]);
+        if (corrupt[i] || counts[i] == 0) continue;
+        if (!frame_is_batch(base, len)) {
+            ok[m] = (uint8_t)featurize_one(base, len,
+                                           tokens + m * seq_len, seq_len,
+                                           (uint32_t)vocab);
+            spans[2 * m] = frame_offsets[i];
+            spans[2 * m + 1] = frame_offsets[i + 1];
+            m++;
+            continue;
+        }
+        cursor_t c = { base + 4, base + len };
+        uint64_t n_msgs;
+        read_varint(&c, &n_msgs);          /* pre-validated by the count pass */
+        for (uint64_t k = 0; k < n_msgs; k++) {
+            uint64_t mlen;
+            read_varint(&c, &mlen);
+            if (mlen > 0) {                /* packed empties: filtered, no row */
+                ok[m] = (uint8_t)featurize_one(c.p, (int)mlen,
+                                               tokens + m * seq_len,
+                                               seq_len, (uint32_t)vocab);
+                spans[2 * m] = frame_offsets[i] + (c.p - base);
+                spans[2 * m + 1] = spans[2 * m] + (int64_t)mlen;
+                m++;
+            }
+            c.p += mlen;
+        }
+    }
+    return m;
+}
+
 /* Raw text lines -> token rows (same tokenizer). */
 int dm_encode_batch(const uint8_t *texts, const int64_t *offsets, int n,
                     int32_t *out, int seq_len, int32_t vocab) {

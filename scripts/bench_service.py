@@ -64,7 +64,10 @@ def main() -> None:
         "http_port": HTTP_PORT,
         "config_file": f"{work}/config.yaml",
         "log_dir": work,
-        "engine_batch_size": 4096,
+        # the engine burst cap is in MESSAGES (frames mode estimates via
+        # frame headers); match the scorer's max_batch so steady-state
+        # device batches ride the largest warmed compile bucket
+        "engine_batch_size": 16384,
         # sender-side SNDHWM is the pipe's flow-control window; the 100
         # default lockstepped the sender to the engine's wakeup cadence
         # (measured 9k lines/s); 8192 lets the engine drain full bursts

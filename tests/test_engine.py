@@ -458,3 +458,73 @@ class TestBlockingBackpressure:
         engine.stop()
         assert time.monotonic() - t0 < 1.5   # bounded by drain budget ≪ join deadline
         assert dropped._value.get() == before + 1   # m2 dropped, counted
+
+
+class TestFusedFrameMode:
+    """Engine + frame-capable processor: packed ingress frames go to
+    process_frames whole; metrics count contained messages; outputs flow."""
+
+    class FrameProc:
+        def __init__(self):
+            self.calls = []
+
+        def process(self, data):  # engine constructor requires it
+            return data
+
+        def process_batch(self, batch):
+            return [d.upper() for d in batch]
+
+        def process_frames(self, frames):
+            from detectmateservice_tpu.engine.framing import unpack_batch
+
+            self.calls.append(len(frames))
+            outs = []
+            n = 0
+            for frame in frames:
+                msgs = unpack_batch(frame) or [frame]
+                for m_ in msgs:
+                    n += 1
+                    outs.append(m_.upper())
+            return outs, n, n  # payloads have no newlines: lines == msgs
+
+    def test_packed_frames_reach_component_whole(self, inproc_factory):
+        from detectmateservice_tpu.engine import metrics as m
+        from detectmateservice_tpu.engine.framing import pack_batch, unpack_batch
+
+        settings = make_settings("inproc://ff1", ["inproc://ff-out"],
+                                 engine_batch_size=64)
+        sub = inproc_factory.create("inproc://ff-out")
+        sub.recv_timeout = 2000
+        proc = self.FrameProc()
+        read_l = m.DATA_READ_LINES().labels(
+            component_type="core", component_id=settings.component_id)
+        before = read_l._value.get()
+        engine = Engine(settings, proc, inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://ff1")
+        client.send(pack_batch([b"a", b"b", b"c"]))
+        client.send(b"d")
+        got = []
+        while len(got) < 4:
+            frame = sub.recv()
+            msgs = unpack_batch(frame)
+            got.extend(msgs if msgs is not None else [frame])
+        assert sorted(got) == [b"A", b"B", b"C", b"D"]
+        assert proc.calls  # frames path was used, not expansion
+        wait_until(lambda: read_l._value.get() == before + 4)
+        engine.stop()
+
+    def test_autodetect_off_disables_frames_path(self, inproc_factory):
+        # with autodetect off the component must NOT be asked to unpack by
+        # magic — the engine falls back to per-message/batch dispatch
+        settings = make_settings("inproc://ff2", engine_batch_size=64,
+                                 engine_frame_autodetect=False)
+        proc = self.FrameProc()
+        engine = Engine(settings, proc, inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://ff2")
+        client.recv_timeout = 2000
+        client.send(b"xy")
+        assert client.recv() == b"XY"
+        assert proc.calls == []
+        engine.stop()

@@ -447,3 +447,78 @@ class TestAsyncFit:
         assert det.process_batch(normal_msgs(32)) == []
         assert det._fit_thread is None
         assert det._fitted
+
+
+class TestProcessFrames:
+    """Fused wire-frame hot path: process_frames must produce exactly the
+    alerts process_batch does, including across the training boundary, with
+    packed, single, mixed, and corrupt frames."""
+
+    def _mk(self, **overrides):
+        return JaxScorerDetector(config=scorer_config(
+            async_fit=False, **overrides))
+
+    def test_steady_state_parity_with_process_batch(self):
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        det_a, det_b = self._mk(), self._mk()
+        train = normal_msgs(32)
+        det_a.process_batch(train)
+        outs_b, n_b, lines_b = det_b.process_frames([pack_batch(train)])
+        assert n_b == 32 and outs_b == []
+        det_a.flush_final(), det_b.flush_final()
+        normal = normal_msgs(16, salt="")
+        anomaly = msg("ERROR <*> segfault at <*> code <*>",
+                      ["kernel-panic", "0xdeadbeef", "0x7f"], log_id="evil")
+        stream = normal[:7] + [anomaly] + normal[7:]
+        outs_a = det_a.process_batch(stream) + det_a.flush()
+        # mixed framing: packed chunk, bare message, packed remainder
+        frames = [pack_batch(stream[:5])] + stream[5:6] + [pack_batch(stream[6:])]
+        outs_f, n, n_lines = det_b.process_frames(frames)
+        outs_f += det_b.flush()
+        assert n == len(stream)
+        alerts_a = [DetectorSchema.from_bytes(o) for o in outs_a if o]
+        alerts_f = [DetectorSchema.from_bytes(o) for o in outs_f if o]
+        assert len(alerts_a) == len(alerts_f) == 1
+        assert alerts_a[0].logIDs == alerts_f[0].logIDs
+        assert alerts_a[0].score == pytest.approx(alerts_f[0].score, rel=1e-5)
+
+    def test_training_phase_via_frames(self):
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        det = self._mk(data_use_training=32)
+        outs, n, _ = det.process_frames([pack_batch(normal_msgs(32))])
+        assert n == 32 and outs == []          # all buffered for training
+        det.flush_final()
+        assert det._fitted
+        anomaly = msg("ERROR <*> segfault at <*> code <*>",
+                      ["boom", "0xff", "1"], log_id="evil")
+        outs, n, _ = det.process_frames([anomaly])
+        outs += det.flush()
+        assert n == 1
+        assert any(o for o in outs)
+
+    def test_corrupt_frame_counted_not_fatal(self):
+        from detectmateservice_tpu.engine import metrics as m
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        det = self._mk(data_use_training=4)
+        det.process_frames([pack_batch(normal_msgs(4))])
+        det.flush_final()
+        counter = m.PROCESSING_ERRORS().labels(
+            component_type=det.config.method_type, component_id=det.name)
+        before = counter._value.get()
+        corrupt = b"\xd7DM\x01\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff"
+        outs, n, _ = det.process_frames([corrupt, normal_msgs(1)[0]])
+        assert n == 1                           # corrupt frame contributed 0
+        assert counter._value.get() == before + 1
+
+    def test_empty_packed_messages_filtered(self):
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        det = self._mk(data_use_training=4)
+        det.process_frames([pack_batch(normal_msgs(4))])
+        det.flush_final()
+        frame = pack_batch([b"", normal_msgs(1)[0], b""])
+        outs, n, _ = det.process_frames([frame])
+        assert n == 1                           # empties silently dropped

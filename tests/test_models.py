@@ -201,3 +201,94 @@ class TestScorers:
         assert nlls.shape == (1, 8)
         assert (nlls[0, 4:] == 0).all()      # PAD positions contribute 0
         assert (nlls[0, :4] > 0).all()       # real positions have real NLL
+
+
+class TestCandidateVocabScoring:
+    """score_vocab > 0: candidate-vocab approximate NLL (models/base.py
+    _token_nlls_candidate) — the head-FLOP reduction that lifts the sequence
+    families past the throughput target (66k → 262k lines/s for logbert at
+    V=32k, C=2048 on one v5e chip)."""
+
+    def _trained_pair(self, score_vocab):
+        """Two identically-trained logberts, one exact one approximate."""
+        def train(scorer):
+            params, opt = scorer.init(jax.random.PRNGKey(0))
+            tok = HashTokenizer(vocab_size=2048, seq_len=12)
+            normal = tok.encode_batch(
+                [f"user u{i % 6} login ok from host{i % 4}" for i in range(128)])
+            rng = jax.random.PRNGKey(1)
+            for _ in range(6):
+                for s in range(0, 128, 32):
+                    rng, r = jax.random.split(rng)
+                    params, opt, _ = scorer.train_step(params, opt, r,
+                                                       normal[s:s + 32])
+            return params, tok, normal
+
+        exact = LogBERTScorer(LogBERTConfig(vocab_size=2048, dim=48, depth=2,
+                                            heads=2, seq_len=12))
+        approx = LogBERTScorer(LogBERTConfig(vocab_size=2048, dim=48, depth=2,
+                                             heads=2, seq_len=12,
+                                             score_vocab=score_vocab))
+        params, tok, normal = train(exact)
+        return exact, approx, params, tok, normal
+
+    def test_scores_track_exact(self):
+        exact, approx, params, tok, normal = self._trained_pair(256)
+        # same params (training is score_vocab-independent): approximate
+        # scores must correlate strongly with exact ones
+        se = np.asarray(exact.score(params, normal[:64]))
+        sa = np.asarray(approx.score(params, normal[:64]))
+        corr = np.corrcoef(se, sa)[0, 1]
+        assert corr > 0.9, corr
+
+    def test_detection_quality_preserved(self):
+        exact, approx, params, tok, normal = self._trained_pair(256)
+        weird = tok.encode_batch(["kernel panic stack smash exploit shell"] * 8)
+        sn = np.asarray(approx.score(params, normal[:32]))
+        sw = np.asarray(approx.score(params, weird))
+        # anomalies separate under the approximation exactly as the exact
+        # path's test (test_logbert_separates_normal_from_anomalous) demands
+        assert sw.mean() > sn.mean() + 3 * sn.std()
+
+    def test_deterministic_across_instances(self):
+        # the candidate subset is seeded: two scorer instances must produce
+        # identical approximate scores (threshold portability / checkpoints)
+        _, a1, params, tok, normal = self._trained_pair(256)
+        a2 = LogBERTScorer(LogBERTConfig(vocab_size=2048, dim=48, depth=2,
+                                         heads=2, seq_len=12, score_vocab=256))
+        s1 = np.asarray(a1.score(params, normal[:16]))
+        s2 = np.asarray(a2.score(params, normal[:16]))
+        np.testing.assert_allclose(s1, s2, rtol=1e-6)
+
+    def test_score_vocab_at_or_above_vocab_is_exact(self):
+        scorer_exact = LogBERTScorer(LogBERTConfig(
+            vocab_size=512, dim=32, depth=1, heads=2, seq_len=8))
+        scorer_full = LogBERTScorer(LogBERTConfig(
+            vocab_size=512, dim=32, depth=1, heads=2, seq_len=8,
+            score_vocab=512))
+        params, _ = scorer_exact.init(jax.random.PRNGKey(0))
+        tokens = np.random.randint(3, 512, (5, 8)).astype(np.int32)
+        np.testing.assert_allclose(
+            np.asarray(scorer_exact.score(params, tokens)),
+            np.asarray(scorer_full.score(params, tokens)), rtol=1e-5)
+
+    def test_gru_supports_score_vocab(self):
+        scorer = GRUScorer(GRUScorerConfig(vocab_size=512, dim=32, depth=1,
+                                           seq_len=8, score_vocab=128))
+        params, _ = scorer.init(jax.random.PRNGKey(0))
+        tokens = np.random.randint(3, 512, (5, 8)).astype(np.int32)
+        scores = np.asarray(scorer.score(params, tokens))
+        assert scores.shape == (5,) and np.isfinite(scores).all()
+
+    def test_chunked_candidate_matches_unchunked(self, monkeypatch):
+        # force chunking (tiny element budget) and pin parity with the
+        # single-einsum candidate path — mirrors the exact path's chunk test
+        scorer = LogBERTScorer(LogBERTConfig(vocab_size=512, dim=32, depth=1,
+                                             heads=2, seq_len=8,
+                                             score_vocab=128))
+        params, _ = scorer.init(jax.random.PRNGKey(0))
+        tokens = np.random.randint(3, 512, (4, 8)).astype(np.int32)
+        full = np.asarray(scorer._token_nlls_impl(params, tokens))
+        monkeypatch.setattr(type(scorer), "_CHUNK_ELEMENT_BUDGET", 4 * 128 * 2)
+        chunked = np.asarray(scorer._token_nlls_impl(params, tokens))
+        np.testing.assert_allclose(full, chunked, rtol=2e-4, atol=1e-5)
