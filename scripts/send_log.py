@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Raw sender: dial a service's engine socket and send log lines.
+
+Role of the reference's minimal demo sender (reference: scripts/client.py —
+raw Pair0 dial + send used by its walkthrough), as a standalone operator
+tool instead of logic embedded in benches/tests.
+
+Examples:
+    # send one line, raw text (a reader stage wraps it into LogSchema)
+    python scripts/send_log.py tcp://127.0.0.1:5500 --line "sshd[1]: fail"
+
+    # stream a whole file, one message per line, 500 msg/s
+    python scripts/send_log.py ipc:///tmp/demo/reader.ipc --file audit.log \
+        --rate 500
+
+    # pre-wrap into LogSchema (when dialing a parser directly)
+    python scripts/send_log.py tcp://127.0.0.1:5501 --file audit.log --wrap
+
+    # pack K messages per wire frame (engine/framing.py batch format)
+    python scripts/send_log.py tcp://127.0.0.1:5501 --file audit.log \
+        --wrap --pack 256
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import sys
+import time
+import uuid
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("addr", help="engine address to dial (tcp://, ipc://, ...)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--line", help="send this single line")
+    src.add_argument("--file", help="send every non-empty line of this file")
+    ap.add_argument("--wrap", action="store_true",
+                    help="wrap lines into LogSchema protobuf (for parser ingress)")
+    ap.add_argument("--pack", type=int, default=1, metavar="K",
+                    help="pack K messages per wire frame (default 1 = plain)")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="throttle to N messages/s (default: unthrottled)")
+    ap.add_argument("--repeat", type=int, default=1,
+                    help="send the input this many times (0 = forever)")
+    args = ap.parse_args()
+
+    from detectmateservice_tpu.engine.framing import pack_batch
+    from detectmateservice_tpu.engine.socket import ZmqPairSocketFactory
+    from detectmateservice_tpu.schemas import LogSchema
+
+    def encode(line: str) -> bytes:
+        if not args.wrap:
+            return line.encode("utf-8")
+        return LogSchema(logID=str(uuid.uuid4()), log=line,
+                         logSource=args.file or "send_log").serialize()
+
+    def lines_once():
+        if args.line is not None:
+            yield args.line
+            return
+        with open(args.file, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                if line.strip():
+                    yield line.rstrip("\n")
+
+    sock = ZmqPairSocketFactory().create_output(args.addr, buffer_size=8192)
+    sent = 0
+    t0 = time.perf_counter()
+    interval = 1.0 / args.rate if args.rate > 0 else 0.0
+    next_at = time.perf_counter()
+    rounds = itertools.count() if args.repeat == 0 else range(args.repeat)
+    try:
+        for _ in rounds:
+            batch: list = []
+            for line in lines_once():
+                if interval:
+                    next_at += interval
+                    delay = next_at - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                msg = encode(line)
+                if args.pack > 1:
+                    batch.append(msg)
+                    if len(batch) >= args.pack:
+                        sock.send(pack_batch(batch))
+                        batch = []
+                else:
+                    sock.send(msg)
+                sent += 1
+            if batch:
+                sock.send(pack_batch(batch))
+    except KeyboardInterrupt:
+        pass
+    elapsed = time.perf_counter() - t0
+    print(f"sent {sent} message(s) in {elapsed:.2f}s"
+          + (f" ({sent / elapsed:,.0f}/s)" if elapsed > 0 else ""),
+          file=sys.stderr)
+    sock.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""North-star perf assertions, gated on real TPU hardware.
+
+VERDICT r2 next #7: the 200k lines/s + <10 ms p50 targets were only ever
+measured by ``bench.py`` under a driver run — a regression of the headline
+could land without any test noticing. This test runs the bench's child
+stage directly (subprocess, so the suite's forced-CPU jax config cannot
+leak in) and asserts the BASELINE.md targets whenever a TPU is present;
+elsewhere it skips with the reason recorded.
+
+Run explicitly with: ``python -m pytest tests/test_northstar_tpu.py -m tpu``
+(it also runs in a plain suite invocation — pytest markers gate selection,
+not execution — and self-skips without the hardware).
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BENCH = REPO / "bench.py"
+MARKER = "@@BENCH_RESULT "
+
+# throughput asserted at half the 578k measured headline: the tunnel adds
+# ±10% session noise and this is a floor against real regressions (and the
+# 200k north-star target), not a flakiness generator
+TARGET_LINES_PER_S = 200_000.0
+TARGET_P50_MS = 10.0
+
+
+def _bench_child(stage: str, arg: str = "", timeout: int = 120):
+    """Run a bench.py child stage in a clean env (no forced-CPU leak)."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    cmd = [sys.executable, str(BENCH), f"--{stage}"]
+    if arg:
+        cmd.append(arg)
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=str(REPO))
+    for line in proc.stdout.splitlines():
+        if line.startswith(MARKER):
+            return json.loads(line[len(MARKER):])
+    return None
+
+
+@pytest.mark.tpu
+def test_northstar_throughput_and_latency_on_tpu():
+    probe = _bench_child("probe", timeout=180)
+    if probe is None or probe.get("platform") != "tpu":
+        pytest.skip("no TPU device present "
+                    f"(probe: {probe and probe.get('platform')!r})")
+    result = _bench_child("run", arg="65536", timeout=420)
+    assert result is not None, "bench run stage produced no result on TPU"
+    assert result["platform"] == "tpu"
+    assert result["lines_per_s"] >= TARGET_LINES_PER_S, (
+        f"north-star throughput regressed: {result['lines_per_s']:.0f} "
+        f"lines/s < {TARGET_LINES_PER_S:.0f} (BASELINE.md)")
+    assert result["p50_ms"] < TARGET_P50_MS, (
+        f"north-star p50 regressed: {result['p50_ms']:.2f} ms ≥ "
+        f"{TARGET_P50_MS} ms (BASELINE.md)")
