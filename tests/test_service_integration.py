@@ -392,3 +392,74 @@ class TestRealComponentPipeline:
                 break
         assert best_p50 < 10.0, (
             f"sparse-traffic service-path p50 {best_p50:.2f} ms >= 10 ms")
+
+
+class TestMeshServiceEndToEnd:
+    """BASELINE config #5 behind the engine: a real Service with
+    ``mesh_shape: {data: 8}`` on the virtual 8-device CPU mesh (conftest
+    forces ``--xla_force_host_platform_device_count=8``), driven with
+    serialized ParserSchema over a REAL zmq socket — proving the 8-way
+    sharded scorer works through the full service stack (socket in →
+    sharded scoring over the mesh → alert out), not just against
+    ShardedScorer directly (VERDICT r2 next #3)."""
+
+    def test_example_mesh_config_parses(self):
+        # the committed example must stay loadable into the detector config
+        from pathlib import Path
+
+        from detectmateservice_tpu.library.detectors.jax_scorer import (
+            JaxScorerDetectorConfig)
+
+        raw = yaml.safe_load(
+            Path(__file__).parent.parent.joinpath(
+                "examples/mesh_scorer_config.yaml").read_text())
+        cfg = JaxScorerDetectorConfig.from_dict(
+            raw["detectors"]["JaxScorerDetector"])
+        assert cfg.mesh_shape == {"data": 8}
+        assert cfg.model == "logbert"
+
+    def test_mesh_scorer_service_socket_to_alert(self, run_service, tmp_path):
+        import jax
+
+        from detectmateservice_tpu.engine.socket import ZmqPairSocketFactory
+
+        assert len(jax.devices()) == 8  # conftest virtual mesh
+        # same shape as examples/mesh_scorer_config.yaml (logbert +
+        # mesh_shape {data: 8} + position norm), sized for CPU test speed
+        config = tmp_path / "mesh.yaml"
+        config.write_text(yaml.safe_dump({"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False,
+            "model": "logbert", "dim": 32, "depth": 1, "heads": 2,
+            "seq_len": 16, "vocab_size": 4096, "score_norm": "position",
+            "data_use_training": 64, "train_epochs": 1, "min_train_steps": 30,
+            "threshold_sigma": 6.0, "max_batch": 64, "async_fit": False,
+            "host_score_max_batch": 0,          # everything rides the mesh
+            "mesh_shape": {"data": 8},
+        }}}))
+        factory = ZmqPairSocketFactory()
+        in_addr = f"ipc://{tmp_path}/mesh-det.ipc"
+        out_addr = f"ipc://{tmp_path}/mesh-out.ipc"
+        sink = factory.create(out_addr)
+        sink.recv_timeout = 120000
+        make_service(run_service, factory, in_addr,
+                     component_type="detectors.jax_scorer.JaxScorerDetector",
+                     config_file=str(config), out_addr=[out_addr],
+                     engine_batch_size=64, engine_batch_timeout_ms=30.0)
+        ingress = factory.create_output(in_addr, buffer_size=512)
+
+        def parser_msg(template, variables, log_id):
+            return ParserSchema(EventID=1, template=template,
+                                variables=variables, logID=log_id,
+                                logFormatVariables={}).serialize()
+
+        for i in range(64):  # training through the socket
+            ingress.send(parser_msg("user <*> ok from <*>",
+                                    [f"u{i % 4}", f"10.0.0.{i % 8}"], str(i)))
+        for _ in range(16):  # anomalies scored on the 8-way mesh
+            ingress.send(parser_msg("segfault <*> exploit <*>",
+                                    ["0xdead", "shellcode"], "evil"))
+        alert = DetectorSchema.from_bytes(sink.recv())
+        assert alert.detectorType == "jax_scorer"
+        assert list(alert.logIDs) == ["evil"]
+        ingress.close()
+        sink.close()
