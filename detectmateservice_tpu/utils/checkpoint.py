@@ -37,15 +37,31 @@ class CheckpointFormatError(RuntimeError):
 # nothing and makes concurrent external checkpoint callers safe.
 _SAVE_LOCK = threading.Lock()
 
+# ONE process-lifetime checkpointer, never closed: a `with
+# ocp.StandardCheckpointer()` per save closes orbax's shared
+# checkpoint-metadata executor on exit, and under rapid save sequences the
+# NEXT save then dies with "cannot schedule new futures after shutdown" /
+# "Must provide item to save" (observed once under a loaded parallel test
+# run). orbax installs its own atexit hooks for process teardown.
+_CKPTR = None
+
+
+def _checkpointer() -> "ocp.StandardCheckpointer":
+    global _CKPTR
+    if _CKPTR is None:
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
 
 def save_scorer_state(directory: str, params: Any, opt_state: Any,
                       meta: Dict[str, Any], tree_version: int = 1) -> None:
     path = Path(directory).absolute()
     path.mkdir(parents=True, exist_ok=True)
     with _SAVE_LOCK:
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path / "params", params, force=True)
-            ckptr.save(path / "opt_state", opt_state, force=True)
+        ckptr = _checkpointer()
+        ckptr.save(path / "params", params, force=True)
+        ckptr.save(path / "opt_state", opt_state, force=True)
+        ckptr.wait_until_finished()
     (path / _META).write_text(json.dumps({**meta, "tree_version": tree_version}))
 
 
@@ -66,7 +82,8 @@ def load_scorer_state(directory: str, params_template: Any,
             "renamed), so this checkpoint cannot be restored directly — "
             "refit the scorer, or migrate the checkpoint by renaming its "
             "param keys to the new layout")
-    with ocp.StandardCheckpointer() as ckptr:
+    with _SAVE_LOCK:  # share the serialized singleton with the save path
+        ckptr = _checkpointer()
         params = ckptr.restore(path / "params", params_template)
         opt_state = ckptr.restore(path / "opt_state", opt_state_template)
     return params, opt_state, meta

@@ -39,6 +39,38 @@ class TestFeaturizeThroughput:
         assert ok.all()
         assert r > 100_000, f"native featurize collapsed to {r:,.0f} lines/s"
 
+    def test_fused_frames_featurize(self):
+        """The fused wire-frame kernel (dm_featurize_frames) is the service
+        path's hot core: guard an absolute floor AND the load-immune
+        relative property that fusing is not slower than
+        unpack-then-featurize (both run under the same host load)."""
+        matchkern = pytest.importorskip("detectmateservice_tpu.utils.matchkern")
+        from detectmateservice_tpu.engine.framing import pack_batch, unpack_batch
+
+        msgs = make_parsed(20_000)
+        frames = [pack_batch(msgs[i:i + 512]) for i in range(0, len(msgs), 512)]
+        matchkern.featurize_frames(frames[:1], 32, 32768)  # warm
+
+        t0 = time.perf_counter()
+        fb = matchkern.featurize_frames(frames, 32, 32768)
+        fused_s = time.perf_counter() - t0
+        assert fb.ok.all() and len(fb) == len(msgs)
+
+        t0 = time.perf_counter()
+        expanded = []
+        for frame in frames:
+            expanded.extend(unpack_batch(frame))
+        matchkern.featurize_batch(expanded, 32, 32768)
+        classic_s = time.perf_counter() - t0
+
+        r = rate(len(msgs), fused_s)
+        assert r > 100_000, f"fused featurize collapsed to {r:,.0f} lines/s"
+        # measured ~1.8x faster; the 1.1 factor tolerates scheduler noise
+        # while still catching any regression that makes fusion pointless
+        assert fused_s < classic_s * 1.1, (
+            f"fused path ({fused_s:.3f}s) slower than unpack+featurize "
+            f"({classic_s:.3f}s)")
+
     def test_python_featurize_fallback(self):
         from detectmateservice_tpu.library.detectors import JaxScorerDetector
 

@@ -29,6 +29,11 @@ def http(method, port, path, payload=None):
         return json.loads(raw) if "json" in ctype else raw.decode()
 
 
+def parser_msg(template, variables, log_id):
+    return ParserSchema(EventID=1, template=template, variables=variables,
+                        logID=log_id, logFormatVariables={}).serialize()
+
+
 def make_service(run_service, factory, addr, **kw):
     settings = ServiceSettings(
         component_type=kw.pop("component_type", "core"),
@@ -327,10 +332,6 @@ class TestRealComponentPipeline:
         sink.recv_timeout = 15000
         ingress = inproc_factory.create_output("inproc://jax-det")
 
-        def parser_msg(template, variables, log_id):
-            return ParserSchema(EventID=1, template=template, variables=variables,
-                                logID=log_id, logFormatVariables={}).serialize()
-
         for i in range(32):  # training
             ingress.send(parser_msg("user <*> ok from <*>",
                                     [f"u{i % 4}", f"10.0.0.{i % 8}"], str(i)))
@@ -365,10 +366,6 @@ class TestRealComponentPipeline:
         sink = inproc_factory.create("inproc://lat-out")
         sink.recv_timeout = 30000
         ingress = inproc_factory.create_output("inproc://lat-det")
-
-        def parser_msg(template, variables, log_id):
-            return ParserSchema(EventID=1, template=template, variables=variables,
-                                logID=log_id, logFormatVariables={}).serialize()
 
         for i in range(32):  # training (fit runs synchronously at boundary)
             ingress.send(parser_msg("user <*> ok from <*>",
@@ -446,11 +443,6 @@ class TestMeshServiceEndToEnd:
                      config_file=str(config), out_addr=[out_addr],
                      engine_batch_size=64, engine_batch_timeout_ms=30.0)
         ingress = factory.create_output(in_addr, buffer_size=512)
-
-        def parser_msg(template, variables, log_id):
-            return ParserSchema(EventID=1, template=template,
-                                variables=variables, logID=log_id,
-                                logFormatVariables={}).serialize()
 
         for i in range(64):  # training through the socket
             ingress.send(parser_msg("user <*> ok from <*>",
