@@ -217,10 +217,52 @@ class Service:
     # -- lifecycle ------------------------------------------------------
     def setup_io(self) -> None:
         """Load models / pin params in HBM before traffic
-        (reference hook: core.py:209-211)."""
+        (reference hook: core.py:209-211). With ``checkpoint_dir`` set and a
+        checkpoint present, the component's state (params + calibrated
+        threshold) is restored here — a restarted detector resumes alerting
+        without retraining (closes SURVEY §5.4 at the operator layer)."""
         if self.library_component is not None:
             self.library_component.setup_io()
+            self._maybe_restore_checkpoint()
         self.logger.info("setup_io: ready to process messages")
+
+    def _maybe_restore_checkpoint(self) -> None:
+        directory = self.settings.checkpoint_dir
+        if not directory:
+            return
+        load_fn = getattr(self.library_component, "load_checkpoint", None)
+        if not callable(load_fn):
+            return
+        if not (Path(directory) / "meta.json").exists():
+            self.logger.info(
+                "checkpoint_dir %s has no checkpoint yet; starting fresh",
+                directory)
+            return
+        try:
+            load_fn(directory)
+        except Exception as exc:
+            # a present-but-unloadable checkpoint (tree-version mismatch,
+            # corruption) is an operator problem — starting silently fresh
+            # would discard the calibration they asked to keep
+            raise ServiceError(
+                f"cannot restore checkpoint from {directory}: {exc}") from exc
+        self.logger.info("component state restored from %s", directory)
+
+    def checkpoint(self) -> Dict[str, Any]:
+        """Save the component's state to ``settings.checkpoint_dir`` (admin
+        verb; also called automatically at clean shutdown)."""
+        directory = self.settings.checkpoint_dir
+        if not directory:
+            raise ServiceError(
+                "no checkpoint_dir configured (settings.checkpoint_dir)")
+        save_fn = getattr(self.library_component, "save_checkpoint", None)
+        if not callable(save_fn):
+            raise ServiceError(
+                "component does not support checkpointing "
+                "(no save_checkpoint hook)")
+        save_fn(directory)
+        self.logger.info("component state checkpointed to %s", directory)
+        return {"checkpoint": "saved", "directory": directory}
 
     def run(self) -> None:
         """Blocking main: admin server up, engine (auto)started, park until
@@ -255,6 +297,15 @@ class Service:
             self.stop()
         except Exception as exc:
             self.logger.error("engine stop during teardown failed: %s", exc)
+        # clean-shutdown checkpoint: after the engine stopped (so the final
+        # flush landed) but before component teardown releases the state
+        if (self.settings.checkpoint_dir and self.library_component is not None
+                and callable(getattr(self.library_component,
+                                     "save_checkpoint", None))):
+            try:
+                self.checkpoint()
+            except Exception as exc:
+                self.logger.error("shutdown checkpoint failed: %s", exc)
         if self.library_component is not None:
             try:
                 self.library_component.teardown()

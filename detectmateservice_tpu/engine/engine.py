@@ -113,10 +113,7 @@ class Engine:
         )
 
         # input socket (close nothing else exists yet on failure)
-        self._pair_sock: EngineSocket = self._factory.create(
-            settings.engine_addr, self.logger, settings.tls_input
-        )
-        self._pair_sock.recv_timeout = settings.engine_recv_timeout
+        self._pair_sock: EngineSocket = self._create_ingress()
 
         # output sockets: background dials; one bad address logs and continues,
         # but a *setup* crash closes the input socket before re-raising
@@ -128,6 +125,35 @@ class Engine:
             raise
 
     # ------------------------------------------------------------------
+    def _create_ingress(self) -> EngineSocket:
+        """Build the input side: one listener on ``engine_addr``, or — when
+        ``engine_ingress_addrs`` is set — N listener shards merged into this
+        loop (the multi-ingress regime: per-shard fds/buffers/senders, one
+        dispatch queue, one device pipeline)."""
+        shards = list(getattr(self.settings, "engine_ingress_addrs", ()) or ())
+        if not shards:
+            sock = self._factory.create(
+                self.settings.engine_addr, self.logger, self.settings.tls_input)
+            sock.recv_timeout = self.settings.engine_recv_timeout
+            return sock
+        from .socket import MergedIngressSocket
+
+        socks: List[EngineSocket] = []
+        try:
+            for addr in shards:
+                socks.append(self._factory.create(
+                    addr, self.logger, self.settings.tls_input))
+        except Exception:
+            for s in socks:
+                try:
+                    s.close()
+                except TransportError:
+                    pass
+            raise
+        merged = MergedIngressSocket(socks)
+        merged.recv_timeout = self.settings.engine_recv_timeout
+        return merged
+
     def _setup_output_sockets(self) -> None:
         for addr in self.settings.out_addr:
             try:
@@ -153,10 +179,7 @@ class Engine:
         if self._running:
             return "already running"
         if self._sockets_closed:
-            self._pair_sock = self._factory.create(
-                self.settings.engine_addr, self.logger, self.settings.tls_input
-            )
-            self._pair_sock.recv_timeout = self.settings.engine_recv_timeout
+            self._pair_sock = self._create_ingress()
             self._out_socks = []
             try:
                 self._setup_output_sockets()
@@ -274,7 +297,7 @@ class Engine:
         batch_fn = getattr(self.processor, "process_batch", None)
         use_batches = batch_size > 1 and callable(batch_fn)
         # fused-frame mode: a processor exposing process_frames(frames) ->
-        # (outputs, n_messages) takes whole wire frames — frame expansion
+        # (outputs, n_messages, n_lines) takes whole wire frames — frame expansion
         # and per-message work happen inside the component (natively for
         # the jax scorer), so the engine loop holds no per-message Python
         # objects at all. Requires frame auto-detection semantics (the
@@ -300,6 +323,16 @@ class Engine:
         # of waiting out the full idle-lull timeout — the sparse-traffic
         # latency contract (<10 ms p50) depends on this
         pending_fn = getattr(self.processor, "pending_count", None) if use_batches else None
+        # reply-mode origin tracking: with no outputs configured and a fan-in
+        # input listener, replies must route to the exact requesting
+        # connection — the last-recv heuristic misroutes under multi-dialer
+        # interleaving. Exact in single-message mode; aligned per-message in
+        # micro-batch mode when the processor returns immediate in-order
+        # outputs; unavailable (falls back to the heuristic) for fused-frame
+        # and pipelined processors, which decouple outputs from this call's
+        # inputs.
+        track_origins = (not self._out_socks
+                         and hasattr(self._pair_sock, "last_origin"))
         # a short-poll tick is NOT true idleness: drain only what is already
         # host-readable (drain_ready) so the loop never blocks on an unready
         # device readback while new traffic queues in the socket buffer
@@ -369,6 +402,7 @@ class Engine:
             msgs = self._expand_frame(raw, read_b, read_l, err_c)
             if not msgs:
                 continue
+            origin = self._pair_sock.last_origin if track_origins else None
 
             if not use_batches:
                 for msg_raw in msgs:
@@ -379,7 +413,7 @@ class Engine:
                         self.logger.error("process() raised: %s", exc)
                         continue
                     if out is not None:
-                        self._send_results([out])
+                        self._send_results([out], [origin])
                 continue
 
             # micro-batch mode: drain what arrived within the window. The
@@ -387,11 +421,19 @@ class Engine:
             # crossing; other sockets fall back to one recv per frame. A
             # packed frame may carry the whole batch in one recv.
             batch = msgs
+            batch_origins = [origin] * len(msgs) if track_origins else None
+
+            def on_burst_frame(nxt: bytes) -> None:
+                ms = self._expand_frame(nxt, read_b, read_l, err_c)
+                batch.extend(ms)
+                if batch_origins is not None:
+                    batch_origins.extend(
+                        [self._pair_sock.last_origin] * len(ms))
+
             self._collect_burst(
                 time.monotonic() + batch_timeout_s,
                 lambda: batch_size - len(batch),
-                lambda nxt: batch.extend(
-                    self._expand_frame(nxt, read_b, read_l, err_c)))
+                on_burst_frame)
             # a packed ingress frame can carry more messages than
             # engine_batch_size; re-chunk so the component never sees a batch
             # beyond the configured cap (its memory/latency contract)
@@ -403,7 +445,14 @@ class Engine:
                     err_c.inc(len(chunk))
                     self.logger.error("process_batch() raised: %s", exc)
                     continue
-                self._send_results(outs)  # in-order, per-message None filter
+                # in-order, per-message None filter; origin alignment holds
+                # only when outputs are immediate (len match) — a pipelined
+                # processor defers results across calls
+                if batch_origins is not None and len(outs) == len(chunk):
+                    self._send_results(outs,
+                                       batch_origins[start:start + batch_size])
+                else:
+                    self._send_results(outs)
 
         # loop exiting (stop requested): drain the pipeline before sockets
         # close — flush_final (when provided) also waits out work the
@@ -416,27 +465,46 @@ class Engine:
                 self.logger.error("flush at stop raised: %s", exc)
 
     # -- fan-out --------------------------------------------------------
-    def _send_results(self, outs) -> None:
+    def _send_results(self, outs, origins=None) -> None:
         """Fan out processor results, packing ``engine_frame_batch`` of them
         per wire frame when configured (>1). Packing amortizes the
         per-message socket cost that otherwise caps the stage-to-stage rate;
         the default of 1 keeps the wire single-message for reference-style
-        peers. Downstream framework engines auto-detect either format."""
-        pending = [o for o in outs if o is not None]
+        peers. Downstream framework engines auto-detect either format.
+
+        ``origins`` (aligned with ``outs``, pre-None-filter) carries each
+        message's originating-connection token for reply mode on a fan-in
+        listener: replies route to the exact requester instead of the
+        last-recv heuristic. Packing only groups consecutive same-origin
+        replies — a packed frame has one destination."""
         frame_batch = getattr(self.settings, "engine_frame_batch", 1)
-        if frame_batch <= 1:
-            for out in pending:
-                self._send_to_outputs(out)
-            return
-        for start in range(0, len(pending), frame_batch):
-            chunk = pending[start:start + frame_batch]
+        if origins is not None and len(origins) == len(outs):
+            pending = [(o, origins[i]) for i, o in enumerate(outs)
+                       if o is not None]
+        else:
+            pending = [(o, None) for o in outs if o is not None]
+        start = 0
+        while start < len(pending):
+            end = start + 1
+            if frame_batch > 1:
+                # == not `is`: merged-ingress origins are (shard, conn)
+                # tuples built per access; plain conn origins compare by
+                # identity either way
+                while (end < len(pending) and end - start < frame_batch
+                       and pending[end][1] == pending[start][1]):
+                    end += 1
+            chunk = [p[0] for p in pending[start:end]]
+            origin = pending[start][1]
             if len(chunk) == 1:
-                self._send_to_outputs(chunk[0])
+                self._send_to_outputs(chunk[0], origin=origin)
             else:
                 self._send_to_outputs(pack_batch(chunk),
-                                      lines=sum(map(_count_lines, chunk)))
+                                      lines=sum(map(_count_lines, chunk)),
+                                      origin=origin)
+            start = end
 
-    def _send_to_outputs(self, data: bytes, lines: Optional[int] = None) -> bool:
+    def _send_to_outputs(self, data: bytes, lines: Optional[int] = None,
+                         origin=None) -> bool:
         written_b = m.DATA_WRITTEN_BYTES().labels(**self._labels)
         written_l = m.DATA_WRITTEN_LINES().labels(**self._labels)
         dropped_b = m.DATA_DROPPED_BYTES().labels(**self._labels)
@@ -445,12 +513,25 @@ class Engine:
             lines = _count_lines(data)
 
         if not self._out_socks:
-            # no outputs: reply on the input pair socket (reference: engine.py:249-259)
+            # no outputs: reply on the input pair socket (reference:
+            # engine.py:249-259). With an origin token and a fan-in listener,
+            # the reply goes to the exact requesting connection; a requester
+            # that disconnected means the reply is undeliverable (counted
+            # dropped), never misrouted to another peer.
+            send_to = getattr(self._pair_sock, "send_to", None)
             try:
-                self._pair_sock.send(data)
+                if origin is not None and callable(send_to):
+                    send_to(origin, data)
+                else:
+                    self._pair_sock.send(data)
                 written_b.inc(len(data))
                 written_l.inc(lines)
                 return True
+            except TransportAgain as exc:
+                self.logger.warning("reply undeliverable: %s", exc)
+                dropped_b.inc(len(data))
+                dropped_l.inc(lines)
+                return False
             except TransportError as exc:
                 self.logger.error("reply on input socket failed: %s", exc)
                 dropped_b.inc(len(data))

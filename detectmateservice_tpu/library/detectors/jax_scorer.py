@@ -82,6 +82,10 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # (DP) and params per the Megatron rules when "model" > 1 (TP); XLA
     # inserts the ICI collectives. None = single device.
     mesh_shape: Optional[Dict[str, int]] = None
+    # model compute dtype: "auto" = each family's default (bfloat16 — the
+    # MXU-native format); "float32" is the right choice on CPU fallback
+    # hosts, where XLA:CPU emulates bf16 in software (~30% slower, measured)
+    dtype: str = "auto"
     seed: int = 0
 
 
@@ -159,6 +163,10 @@ class JaxScorerDetector(CoreDetector):
                 "'einsum', 'flash', 'blockwise', or 'ring'")
         if cfg.model not in ("mlp", "gru", "logbert"):
             raise LibraryError(f"unknown scorer model {cfg.model!r}")
+        if cfg.dtype not in ("auto", "bfloat16", "float32", "float16"):
+            raise LibraryError(
+                f"unknown dtype {cfg.dtype!r}; expected 'auto', 'bfloat16', "
+                "'float32', or 'float16'")
 
     # -- lifecycle ------------------------------------------------------
     def setup_io(self) -> None:
@@ -209,6 +217,11 @@ class JaxScorerDetector(CoreDetector):
         enable_compilation_cache()
         cfg = self.config
         self._validate_static_config()
+        import jax.numpy as jnp
+
+        dtype_kw = {}
+        if cfg.dtype and cfg.dtype != "auto":
+            dtype_kw["dtype"] = jnp.dtype(cfg.dtype).type
         if cfg.model == "logbert":
             from ...models.logbert import LogBERTConfig, LogBERTScorer
 
@@ -216,6 +229,7 @@ class JaxScorerDetector(CoreDetector):
                 vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
                 heads=cfg.heads, seq_len=cfg.seq_len, score_topk=cfg.score_topk,
                 attn_impl=cfg.attn_impl, score_vocab=cfg.score_vocab,
+                **dtype_kw,
             ))
         elif cfg.model == "gru":
             from ...models.gru import GRUScorer, GRUScorerConfig
@@ -223,13 +237,14 @@ class JaxScorerDetector(CoreDetector):
             self._scorer = GRUScorer(GRUScorerConfig(
                 vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
                 seq_len=cfg.seq_len, score_topk=cfg.score_topk,
-                score_vocab=cfg.score_vocab,
+                score_vocab=cfg.score_vocab, **dtype_kw,
             ))
         elif cfg.model == "mlp":
             from ...models.mlp import MLPScorer, MLPScorerConfig
 
             self._scorer = MLPScorer(MLPScorerConfig(
                 vocab_size=cfg.vocab_size, dim=cfg.dim, seq_len=cfg.seq_len,
+                **dtype_kw,
             ))
         else:
             raise LibraryError(f"unknown scorer model {cfg.model!r}")
@@ -951,7 +966,7 @@ class JaxScorerDetector(CoreDetector):
         super().validate_reconfigure(new_config)
         frozen = ("model", "vocab_size", "seq_len", "dim", "depth", "heads",
                   "score_topk", "score_vocab", "score_norm", "mesh_shape",
-                  "attn_impl")
+                  "attn_impl", "dtype")
         for field in frozen:
             if getattr(new_config, field) != getattr(self.config, field):
                 raise LibraryError(
@@ -985,7 +1000,7 @@ class JaxScorerDetector(CoreDetector):
 
     # -- state checkpointing (orbax; closes SURVEY §5.4) -----------------
     def state_dict(self) -> Dict[str, Any]:
-        return {
+        state = {
             "trained": self._trained,
             "threshold": self._threshold,
             "fitted": self._fitted,
@@ -995,6 +1010,16 @@ class JaxScorerDetector(CoreDetector):
             "norm_sigma": (None if self._norm_sigma is None
                            else self._norm_sigma.tolist()),
         }
+        # candidate-vocab subset: numpy's Generator bit-stream is not
+        # guaranteed stable across numpy versions, so "same seed" does not
+        # guarantee the same subset after a restore under a different numpy —
+        # which would silently shift the score_vocab approximation out from
+        # under the fit-frozen threshold. Persist the ids and reuse them.
+        cand = getattr(self._scorer, "_cand_cache", None)
+        if cand is not None:
+            state["cand_key"] = list(cand[0])
+            state["cand_ids"] = cand[1].tolist()
+        return state
 
     def save_checkpoint(self, directory: str) -> None:
         from ...utils.checkpoint import MODEL_TREE_VERSIONS, save_scorer_state
@@ -1034,6 +1059,13 @@ class JaxScorerDetector(CoreDetector):
             self._params, self._opt_state = params, opt_state
         self._trained = int(meta.get("trained", 0))
         self._fitted = bool(meta.get("fitted", False))
+        cand_key, cand_ids = meta.get("cand_key"), meta.get("cand_ids")
+        if cand_key is not None and cand_ids is not None:
+            # reuse the checkpointed subset verbatim — regenerating from the
+            # seed under a different numpy could shift the approximation and
+            # decalibrate the restored threshold
+            self._scorer._cand_cache = (tuple(cand_key),
+                                        np.asarray(cand_ids, np.int32))
         stats = meta.get("calib_stats")
         self._calib_stats = None if stats is None else (float(stats[0]),
                                                         float(stats[1]))

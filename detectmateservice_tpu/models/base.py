@@ -186,6 +186,21 @@ class SequenceScorerBase(ScorerBase):
                                               score_vocab)
         return self._token_nlls_exact(params, tokens, dtype)
 
+    @staticmethod
+    def _lse_low_precision(logits, dtype) -> jax.Array:
+        """logsumexp with the exp in the model's compute dtype and the SUM
+        reduced in fp32 (the r3 roofline's "bf16 logsumexp, fp32 reduce"
+        lever): the candidate head is VPU-softmax-bound, and bf16 exp runs
+        the elementwise pass at twice the lane width. The max is subtracted
+        first (standard stabilization) so bf16's ~3-digit mantissa applies
+        to values in (-inf, 0] — measured NLL drift vs the fp32 lse is
+        <1e-2 nats, far under the sigma-scale thresholds, and fit/detect
+        share the path so the units stay consistent."""
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        e = jnp.exp((logits - m).astype(dtype))
+        s = jnp.sum(e, axis=-1, dtype=jnp.float32)  # fp32 accumulator
+        return jnp.log(s) + m[..., 0].astype(jnp.float32)
+
     def _token_nlls_candidate(self, params, tokens: jax.Array, dtype,
                               n_cand: int) -> jax.Array:
         emb = params["params"]["tok_embed"]["embedding"]
@@ -200,25 +215,26 @@ class SequenceScorerBase(ScorerBase):
         tgt = jnp.einsum("bsd,bsd->bs", hidden, emb[tokens],
                          preferred_element_type=jnp.float32)
         b, s, d = hidden.shape
-        # same HBM discipline as the exact path: the [B, Sc, C] fp32
-        # candidate logits are chunked over S to the element budget — a
-        # long-sequence config must not OOM here when the exact path would
-        # have chunked its way through
-        sc = max(1, min(s, self._CHUNK_ELEMENT_BUDGET // max(1, b * n_cand)))
+        # the [B, Sc, C] candidate logits are stored in the compute dtype
+        # (bf16 halves their HBM footprint → Sc doubles per chunk vs fp32,
+        # the "larger S-chunks" lever); MXU accumulation is fp32 either way
+        elem_bytes = jnp.dtype(dtype).itemsize
+        budget = self._CHUNK_ELEMENT_BUDGET * 4 // max(1, elem_bytes)
+        sc = max(1, min(s, budget // max(1, b * n_cand)))
         while s % sc:
             sc -= 1
         n_chunks = s // sc
         if n_chunks == 1:
             logits_c = jnp.einsum("bsd,cd->bsc", hidden, emb_c,
-                                  preferred_element_type=jnp.float32)
-            lse = jax.nn.logsumexp(logits_c, axis=-1) + correction
+                                  preferred_element_type=dtype)
+            lse = self._lse_low_precision(logits_c, dtype) + correction
         else:
             h = hidden.reshape(b, n_chunks, sc, d).transpose(1, 0, 2, 3)
 
             def step(carry, h_c):
                 logits_c = jnp.einsum("bsd,cd->bsc", h_c, emb_c,
-                                      preferred_element_type=jnp.float32)
-                return carry, jax.nn.logsumexp(logits_c, axis=-1)
+                                      preferred_element_type=dtype)
+                return carry, self._lse_low_precision(logits_c, dtype)
 
             _, lse = jax.lax.scan(step, None, h)        # [n_chunks, B, Sc]
             lse = lse.transpose(1, 0, 2).reshape(b, s) + correction

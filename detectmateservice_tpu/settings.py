@@ -115,6 +115,12 @@ class ServiceSettings(BaseModel):
 
     # -- engine data channel (reference: settings.py:61-65) ---------------
     engine_addr: TransportAddr = "ipc:///tmp/detectmate.engine.ipc"
+    # N-shard ingress (the multi-ingress regime, docs/benchmarks.md): when
+    # non-empty the engine listens on ALL of these — one socket, fd and
+    # kernel buffer per shard, each fed by its own sender — merged into the
+    # single dispatch loop. engine_addr keeps identity/reply duties; it is
+    # NOT implicitly included in the shard set.
+    engine_ingress_addrs: List[TransportAddr] = Field(default_factory=list)
     engine_autostart: bool = True
     engine_recv_timeout: int = Field(default=100, ge=1)  # ms
     engine_retry_count: int = Field(default=10, ge=1)
@@ -170,6 +176,8 @@ class ServiceSettings(BaseModel):
     transport_backend: str = Field(default="auto", pattern="^(auto|zmq|native)$")
     backend: str = Field(default="auto", pattern="^(auto|cpu|tpu)$")
     mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"data": 8}
+    # component state checkpointing (core.py): restore at setup_io when a
+    # checkpoint exists, save at clean shutdown and on POST /admin/checkpoint
     checkpoint_dir: Optional[str] = None
     profile_dir: Optional[str] = None
     # multi-host chip plane (parallel/distributed.py): when a coordinator is
@@ -199,6 +207,9 @@ class ServiceSettings(BaseModel):
     def _check_tls(self) -> "ServiceSettings":
         if self.engine_addr.startswith("tls+tcp://") and self.tls_input is None:
             raise ValueError("engine_addr uses tls+tcp:// but tls_input is not configured")
+        if (any(a.startswith("tls+tcp://") for a in self.engine_ingress_addrs)
+                and self.tls_input is None):
+            raise ValueError("an engine_ingress_addr uses tls+tcp:// but tls_input is not configured")
         if any(a.startswith("tls+tcp://") for a in self.out_addr) and self.tls_output is None:
             raise ValueError("an out_addr uses tls+tcp:// but tls_output is not configured")
         return self

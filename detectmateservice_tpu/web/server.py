@@ -124,6 +124,8 @@ def _make_handler(service):
                     persist = bool((payload or {}).get("persist", False))
                     updated = service.reconfigure(config, persist=persist)
                     self._send_json(200, {"detail": "reconfigured", "config": updated})
+                elif self.path == "/admin/checkpoint":
+                    self._send_json(200, service.checkpoint())
                 elif self.path == "/admin/profile":
                     payload, _ = self._read_json()
                     result = _capture_profile(service, payload or {})

@@ -4,15 +4,24 @@ TPU scoring, alert fan-out — not just the in-process detector contract that
 bench.py times.
 
 Spawns `detectmateservice_tpu.cli` with the mlp scorer, pumps N ParserSchema
-messages through the engine socket from this process, and measures from
-first send until the service's data_processed_lines_total counter covers
-all N (scraped from /metrics). Alerts arriving on the output socket are
-drained concurrently and counted.
+messages through the engine socket, and measures from first send until the
+service's device-lines counter covers all N (scraped from /metrics). Alerts
+arriving on the output socket are drained concurrently and counted.
 
-Usage: python scripts/bench_service.py [N]
+Multi-ingress mode (``--shards K``, the regime docs/benchmarks.md sizes for
+>2M lines/s chip-local): the service listens on K ingress shard sockets
+(``engine_ingress_addrs``) merged into one engine loop, and K SEPARATE
+sender processes blast one shard each — so sender-side Python cost, the
+GIL, and the per-socket kernel path all scale out, and the measured number
+is the aggregate the single dispatch loop actually drains.
+
+Usage:
+    python scripts/bench_service.py [N]              # single ingress
+    python scripts/bench_service.py N --shards 4     # K-shard aggregate
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -21,6 +30,7 @@ import tempfile
 import threading
 import time
 import urllib.request
+from pathlib import Path
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -52,10 +62,47 @@ def processed_at_least(port: int, target: float) -> bool:
     return value is not None and value >= target
 
 
+def sender_main(addr: str, n: int, seed: int, ready: str, go: str) -> None:
+    """One sender process: pre-pack frames, signal ready, blast on go.
+    Packing happens BEFORE the go signal so the measured window contains
+    only socket+service work, and each sender pays it on its own core."""
+    import logging
+
+    from detectmateservice_tpu.engine.framing import pack_batch
+    from detectmateservice_tpu.engine.socket import ZmqPairSocketFactory
+
+    msgs = B.make_messages(n, anomaly_rate=0.01, seed=seed)
+    frame_n = 512
+    frames = [pack_batch(msgs[i:i + frame_n]) for i in range(0, n, frame_n)]
+    sock = ZmqPairSocketFactory().create_output(
+        addr, logging.getLogger("sender"), buffer_size=8192)
+    Path(ready).touch()
+    while not os.path.exists(go):
+        time.sleep(0.01)
+    for frame in frames:
+        sock.send(frame)
+    # zmq sends are async: stay alive so queued frames drain; the parent
+    # kills senders once the service-side counter covers the target
+    time.sleep(600)
+
+
 def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 262144
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", nargs="?", type=int, default=262144)
+    ap.add_argument("--shards", type=int, default=1,
+                    help="ingress shard count (and sender process count)")
+    ap.add_argument("--sender", nargs=5, metavar=("ADDR", "N", "SEED",
+                                                  "READY", "GO"))
+    args = ap.parse_args()
+    if args.sender:
+        sender_main(args.sender[0], int(args.sender[1]), int(args.sender[2]),
+                    args.sender[3], args.sender[4])
+        return
+
+    n, shards = args.n, max(1, args.shards)
     work = tempfile.mkdtemp(prefix="dmbench-svc-")
     n_train = 2048
+    shard_addrs = [f"ipc://{work}/shard{i}.ipc" for i in range(shards)]
     settings = {
         "component_name": "benchdet",
         "component_type": "detectors.jax_scorer.JaxScorerDetector",
@@ -72,10 +119,14 @@ def main() -> None:
         # default lockstepped the sender to the engine's wakeup cadence
         # (measured 9k lines/s); 8192 lets the engine drain full bursts
         "engine_buffer_size": 8192,
-        # pack alerts going out; the sender below packs its ingress frames —
+        # pack alerts going out; the senders pack their ingress frames —
         # one zmq send per 512 messages instead of per message
         "engine_frame_batch": 512,
     }
+    if shards > 1:
+        settings["engine_ingress_addrs"] = shard_addrs
+    else:
+        shard_addrs = [settings["engine_addr"]]
     config = {"detectors": {"JaxScorerDetector": {
         "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
         "data_use_training": n_train, "train_epochs": 2, "async_fit": False,
@@ -93,6 +144,7 @@ def main() -> None:
         [sys.executable, "-m", "detectmateservice_tpu.cli",
          "--settings", f"{work}/settings.yaml"],
         stdout=open(f"{work}/service.out", "w"), stderr=subprocess.STDOUT)
+    senders: list = []
     try:
         deadline = time.time() + 300
         while time.time() < deadline:
@@ -112,7 +164,7 @@ def main() -> None:
         factory = ZmqPairSocketFactory()
         alerts_sock = factory.create(f"ipc://{work}/alerts.ipc", log)
         alerts_sock.recv_timeout = 500
-        ingress = factory.create_output(f"ipc://{work}/det.ipc", log,
+        ingress = factory.create_output(shard_addrs[0], log,
                                         buffer_size=8192)
 
         alerts = []
@@ -143,14 +195,34 @@ def main() -> None:
         while not processed_at_least(HTTP_PORT, n_probe) and time.time() < deadline:
             time.sleep(1)
 
-        msgs = B.make_messages(n, anomaly_rate=0.01, seed=1)
-        frame_n = 512
-        frames = [pack_batch(msgs[i:i + frame_n])
-                  for i in range(0, n, frame_n)]
-        t0 = time.perf_counter()
-        for frame in frames:
-            ingress.send(frame)
-        t_sent = time.perf_counter()
+        per_sender = n // shards
+        go_file = f"{work}/go"
+        if shards == 1:
+            msgs = B.make_messages(n, anomaly_rate=0.01, seed=1)
+            frame_n = 512
+            frames = [pack_batch(msgs[i:i + frame_n])
+                      for i in range(0, n, frame_n)]
+            t0 = time.perf_counter()
+            for frame in frames:
+                ingress.send(frame)
+            t_sent = time.perf_counter()
+        else:
+            ready_files = [f"{work}/ready{i}" for i in range(shards)]
+            for i in range(shards):
+                senders.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--sender",
+                     shard_addrs[i], str(per_sender), str(i + 1),
+                     ready_files[i], go_file],
+                    stdout=open(f"{work}/sender{i}.out", "w"),
+                    stderr=subprocess.STDOUT))
+            deadline = time.time() + 300
+            while (not all(os.path.exists(r) for r in ready_files)
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            n = per_sender * shards  # exact target with integer division
+            t0 = time.perf_counter()
+            Path(go_file).touch()
+            t_sent = None
         target = n_probe + n
         deadline = time.time() + 600
         while not processed_at_least(HTTP_PORT, target) and time.time() < deadline:
@@ -160,17 +232,26 @@ def main() -> None:
         stop.set()
         drainer.join()
         processed = (scrape_processed(HTTP_PORT) or 0.0) - n_probe
-        print(json.dumps({
-            "metric": "service_path_lines_per_sec",
+        result = {
+            "metric": ("service_path_lines_per_sec" if shards == 1 else
+                       f"service_path_aggregate_lines_per_sec_{shards}shards"),
             "value": round(n / elapsed, 1),
             "unit": "lines/s",
-            "send_only_lines_per_s": round(n / (t_sent - t0), 1),
+            "shards": shards,
             "processed": processed,
             "alerts": len(alerts),
             "n": n,
             "elapsed_s": round(elapsed, 3),
-        }))
+        }
+        if t_sent is not None:
+            result["send_only_lines_per_s"] = round(n / (t_sent - t0), 1)
+        print(json.dumps(result))
     finally:
+        for s in senders:
+            try:
+                s.kill()
+            except OSError:
+                pass
         try:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{HTTP_PORT}/admin/shutdown",

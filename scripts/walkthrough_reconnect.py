@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Walkthrough: non-blocking output dials + automatic reconnection.
+
+Role of the reference's manual demo (reference: scripts/walkthrough.md,
+scripts/run_demo_scenario.sh): prove that a service whose downstream is
+OFFLINE still starts, serves its admin plane and processes traffic; that the
+downstream coming online is picked up automatically (background redial, no
+restart); and that killing + restarting the downstream heals the same way.
+
+Scenario (two real service processes over tcp://):
+
+  1. start SENDER (core passthrough service) whose out_addr points at a
+     receiver that does not exist yet — it must come up "running";
+  2. push messages: they are counted as dropped after bounded retries
+     (delivery semantics: drop-and-count, never wedge);
+  3. start RECEIVER; the sender's background dial connects; push messages
+     and watch them land in the receiver's written-lines metric;
+  4. kill the receiver, push (drops again), restart it, push — flows again.
+
+Usage: python scripts/walkthrough_reconnect.py [-v]
+"""
+from __future__ import annotations
+
+import re
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "scripts"))
+
+from run_demo import admin, launch, wait_running  # noqa: E402
+
+SENDER_PORT, RECEIVER_PORT = 18121, 18122
+SENDER_IN, RECEIVER_IN = 15621, 15622
+
+
+def metric(port: int, name: str) -> float:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=5) as resp:
+        text = resp.read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def step(msg: str) -> None:
+    print(f"\n=== {msg}")
+
+
+def main() -> int:
+    from detectmateservice_tpu.engine.socket import ZmqPairSocketFactory
+
+    work = Path(tempfile.mkdtemp(prefix="dm-walkthrough-"))
+    (work / "sender.yaml").write_text(f"""
+component_type: core
+component_id: sender
+engine_addr: tcp://127.0.0.1:{SENDER_IN}
+out_addr: ["tcp://127.0.0.1:{RECEIVER_IN}"]
+http_port: {SENDER_PORT}
+engine_retry_count: 3
+log_to_file: false
+""")
+    (work / "receiver.yaml").write_text(f"""
+component_type: core
+component_id: receiver
+engine_addr: tcp://127.0.0.1:{RECEIVER_IN}
+http_port: {RECEIVER_PORT}
+log_to_file: false
+""")
+
+    procs = []
+    try:
+        step("1. sender starts with its downstream OFFLINE")
+        import run_demo
+        run_demo.DEMO_DIR = work  # launch() uses it as cwd
+        procs.append(launch(work / "sender.yaml", work / "sender.log"))
+        wait_running(SENDER_PORT, 60)
+        print("    sender is RUNNING (background dial pending — no wedge)")
+
+        ingress = ZmqPairSocketFactory().create_output(
+            f"tcp://127.0.0.1:{SENDER_IN}")
+        step("2. traffic while downstream is down → bounded retry, drop+count")
+        for i in range(20):
+            ingress.send(b"early-%d" % i)
+        time.sleep(1.5)
+        dropped = metric(SENDER_PORT, "data_dropped_lines_total")
+        print(f"    sender dropped_lines_total = {dropped:.0f} (expected > 0)")
+        assert dropped > 0, "drops should be counted while downstream is down"
+
+        step("3. receiver comes online → sender redials automatically")
+        recv_proc = launch(work / "receiver.yaml", work / "receiver.log")
+        procs.append(recv_proc)
+        wait_running(RECEIVER_PORT, 60)
+        deadline = time.monotonic() + 15
+        delivered = 0.0
+        while time.monotonic() < deadline:
+            for i in range(10):
+                ingress.send(b"late-%d" % i)
+            time.sleep(1.0)
+            delivered = metric(RECEIVER_PORT, "data_read_lines_total")
+            if delivered > 0:
+                break
+        print(f"    receiver read_lines_total = {delivered:.0f} (expected > 0)")
+        assert delivered > 0, "messages should flow after the redial"
+
+        step("4. receiver dies and is restarted → flow heals again")
+        recv_proc.terminate()
+        recv_proc.wait(timeout=10)
+        time.sleep(1.0)
+        for i in range(10):
+            ingress.send(b"orphan-%d" % i)  # dropped: downstream gone again
+        procs.append(launch(work / "receiver.yaml", work / "receiver.log2"))
+        wait_running(RECEIVER_PORT, 60)
+        deadline = time.monotonic() + 15
+        healed = 0.0
+        while time.monotonic() < deadline:
+            for i in range(10):
+                ingress.send(b"healed-%d" % i)
+            time.sleep(1.0)
+            healed = metric(RECEIVER_PORT, "data_read_lines_total")
+            if healed > 0:
+                break
+        print(f"    restarted receiver read_lines_total = {healed:.0f}")
+        assert healed > 0, "messages should flow after the restart"
+
+        step("walkthrough PASSED: start-order independence + self-healing")
+        return 0
+    finally:
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                p.kill()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

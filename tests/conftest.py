@@ -29,6 +29,27 @@ import pytest
 from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
 
 
+_SLOW_FILES = {
+    # XLA-compile-heavy: every test jit-compiles models (often over the
+    # virtual 8-device mesh); together they dominate suite wall-time
+    "test_models.py",
+    "test_jax_scorer.py",
+    "test_parallel.py",
+    "test_flash.py",
+    "test_distributed.py",
+    "test_concurrency.py",
+    "test_perf.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if (item.fspath.basename in _SLOW_FILES
+                or "MeshServiceEndToEnd" in item.nodeid
+                or "ServiceCheckpointLifecycle" in item.nodeid):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture()
 def free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:

@@ -528,3 +528,56 @@ class TestFusedFrameMode:
         assert client.recv() == b"XY"
         assert proc.calls == []
         engine.stop()
+
+
+class TestMergedIngress:
+    """N-shard ingress merged into one engine loop (engine_ingress_addrs):
+    per-shard sockets, one dispatch queue — the multi-ingress regime
+    scripts/bench_service.py --shards measures."""
+
+    def test_two_shards_both_streams_processed(self, inproc_factory):
+        sink = inproc_factory.create("inproc://mi-out")
+        sink.recv_timeout = 3000
+        settings = make_settings(
+            "inproc://mi-main", ["inproc://mi-out"],
+            engine_ingress_addrs=["inproc://mi-s0", "inproc://mi-s1"])
+        engine = Engine(settings, SimpleProcessor(), inproc_factory)
+        engine.start()
+        a = inproc_factory.create_output("inproc://mi-s0")
+        b = inproc_factory.create_output("inproc://mi-s1")
+        for i in range(10):
+            a.send(b"a%d" % i)
+            b.send(b"b%d" % i)
+        got = sorted(sink.recv() for _ in range(20))
+        assert got == sorted([(b"a%d" % i)[::-1] for i in range(10)] +
+                             [(b"b%d" % i)[::-1] for i in range(10)])
+        engine.stop()
+
+    def test_shard_reply_goes_to_requesting_shard(self, inproc_factory):
+        settings = make_settings(
+            "inproc://mi2-main",
+            engine_ingress_addrs=["inproc://mi2-s0", "inproc://mi2-s1"])
+        engine = Engine(settings, SimpleProcessor(), inproc_factory)
+        engine.start()
+        a = inproc_factory.create_output("inproc://mi2-s0")
+        b = inproc_factory.create_output("inproc://mi2-s1")
+        a.recv_timeout = b.recv_timeout = 3000
+        a.send(b"abc")
+        assert a.recv() == b"cba"
+        b.send(b"xyz")
+        assert b.recv() == b"zyx"
+        engine.stop()
+
+    def test_restart_rebuilds_shards(self, inproc_factory):
+        settings = make_settings(
+            "inproc://mi3-main",
+            engine_ingress_addrs=["inproc://mi3-s0", "inproc://mi3-s1"])
+        engine = Engine(settings, SimpleProcessor(), inproc_factory)
+        engine.start()
+        engine.stop()
+        engine.start()
+        client = inproc_factory.create_output("inproc://mi3-s1")
+        client.recv_timeout = 3000
+        client.send(b"abc")
+        assert client.recv() == b"cba"
+        engine.stop()

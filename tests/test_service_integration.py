@@ -391,6 +391,85 @@ class TestRealComponentPipeline:
             f"sparse-traffic service-path p50 {best_p50:.2f} ms >= 10 ms")
 
 
+class TestServiceCheckpointLifecycle:
+    """``settings.checkpoint_dir`` wired through the service lifecycle
+    (VERDICT r3 #5): restore at setup_io, save at clean shutdown, and the
+    ``POST /admin/checkpoint`` verb. The operator contract: train → kill →
+    restart → alerts resume with the SAME calibration, no retraining."""
+
+    SCORER_CFG = {"detectors": {"JaxScorerDetector": {
+        "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+        "data_use_training": 32, "train_epochs": 2, "min_train_steps": 60,
+        "seq_len": 16, "dim": 32, "max_batch": 32, "async_fit": False,
+        "pipeline_depth": 1, "threshold_sigma": 4.0,
+    }}}
+
+    def _service(self, run_service, factory, tmp_path, addr, out, ckpt_dir):
+        config = tmp_path / "scorer.yaml"
+        config.write_text(yaml.safe_dump(self.SCORER_CFG))
+        return make_service(
+            run_service, factory, addr,
+            component_type="detectors.jax_scorer.JaxScorerDetector",
+            config_file=str(config), out_addr=[out],
+            engine_batch_size=16, engine_batch_timeout_ms=30.0,
+            checkpoint_dir=str(ckpt_dir))
+
+    def test_train_shutdown_restart_resumes_alerting(
+            self, run_service, inproc_factory, tmp_path):
+        ckpt = tmp_path / "svc-ckpt"
+
+        # --- life 1: train + calibrate, then clean shutdown (auto-save)
+        svc1 = self._service(run_service, inproc_factory, tmp_path,
+                             "inproc://ck-det", "inproc://ck-out", ckpt)
+        svc1.setup_io()
+        sink = inproc_factory.create("inproc://ck-out")
+        sink.recv_timeout = 15000
+        ingress = inproc_factory.create_output("inproc://ck-det")
+        for i in range(32):
+            ingress.send(parser_msg("user <*> ok from <*>",
+                                    [f"u{i % 4}", f"10.0.0.{i % 8}"], str(i)))
+        ingress.send(parser_msg("segfault <*> exploit <*>",
+                                ["0xdead", "shellcode"], "evil-1"))
+        alert = DetectorSchema.from_bytes(sink.recv())
+        assert list(alert.logIDs) == ["evil-1"]
+        svc1.shutdown()
+        assert wait_until(lambda: (ckpt / "meta.json").exists(), 15.0), (
+            "clean shutdown did not write a checkpoint")
+        meta = json.loads((ckpt / "meta.json").read_text())
+        assert meta.get("fitted") is True
+
+        # --- life 2: fresh service, same checkpoint_dir; NO training sent —
+        # an anomaly must alert immediately off the restored calibration
+        svc2 = self._service(run_service, inproc_factory, tmp_path,
+                             "inproc://ck2-det", "inproc://ck2-out", ckpt)
+        svc2.setup_io()
+        sink2 = inproc_factory.create("inproc://ck2-out")
+        sink2.recv_timeout = 15000
+        ingress2 = inproc_factory.create_output("inproc://ck2-det")
+        ingress2.send(parser_msg("segfault <*> exploit <*>",
+                                 ["0xbeef", "shellcode"], "evil-2"))
+        alert2 = DetectorSchema.from_bytes(sink2.recv())
+        assert alert2.detectorType == "jax_scorer"
+        assert list(alert2.logIDs) == ["evil-2"]
+
+    def test_admin_checkpoint_verb(self, run_service, inproc_factory, tmp_path):
+        ckpt = tmp_path / "verb-ckpt"
+        svc = self._service(run_service, inproc_factory, tmp_path,
+                            "inproc://ckv-det", "inproc://ckv-out", ckpt)
+        svc.setup_io()
+        result = http("POST", svc.web_server.port, "/admin/checkpoint")
+        assert result["checkpoint"] == "saved"
+        assert (ckpt / "meta.json").exists()
+
+    def test_checkpoint_verb_without_dir_is_500(self, run_service,
+                                                inproc_factory):
+        svc = make_service(run_service, inproc_factory, "inproc://nockpt")
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as err:
+            http("POST", svc.web_server.port, "/admin/checkpoint")
+        assert err.value.code == 500
+
+
 class TestMeshServiceEndToEnd:
     """BASELINE config #5 behind the engine: a real Service with
     ``mesh_shape: {data: 8}`` on the virtual 8-device CPU mesh (conftest
