@@ -210,14 +210,41 @@ class _Child:
         cmd = [sys.executable, os.path.abspath(__file__), f"--{stage}"]
         if arg:
             cmd.append(arg)
+        # child output goes to temp FILES, not pipes: nothing reads a pipe
+        # while the child runs, and a chatty TPU runtime (retry/abort spew
+        # is routine on the tunnel) would fill the ~64 KB pipe buffer and
+        # block the child mid-write — misreported as a timeout
+        import tempfile
+
+        self._out_f = tempfile.TemporaryFile(mode="w+t")
+        self._err_f = tempfile.TemporaryFile(mode="w+t")
         try:
             self._proc = subprocess.Popen(
-                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                cmd, stdout=self._out_f, stderr=self._err_f,
                 text=True, env=env)
         except Exception as exc:
             self._proc = None
             self.diag.update(outcome="spawn_error", error=repr(exc))
             self._done = True
+
+    def _read_output(self) -> tuple[str, str]:
+        out = err = ""
+        for attr, name in ((self._out_f, "out"), (self._err_f, "err")):
+            try:
+                attr.seek(0)
+                text = attr.read()
+            except Exception:
+                text = ""
+            finally:
+                try:
+                    attr.close()
+                except Exception:
+                    pass
+            if name == "out":
+                out = text
+            else:
+                err = text
+        return out, err
 
     def poll(self) -> bool:
         """Advance state; True once the child has finished (any outcome)."""
@@ -231,14 +258,15 @@ class _Child:
                 return False
             self._proc.kill()
             try:
-                self._proc.communicate(timeout=10)
+                self._proc.wait(timeout=10)
             except Exception:
                 pass
+            self._read_output()
             self.diag.update(outcome="timeout",
                              seconds=round(now - self._t0, 1))
             self._done = True
             return True
-        stdout, stderr = self._proc.communicate()
+        stdout, stderr = self._read_output()
         self.diag["seconds"] = round(now - self._t0, 1)
         for line in stdout.splitlines():
             if line.startswith(RESULT_MARKER):
@@ -260,9 +288,10 @@ class _Child:
         if not self._done and self._proc is not None:
             self._proc.kill()
             try:
-                self._proc.communicate(timeout=10)
+                self._proc.wait(timeout=10)
             except Exception:
                 pass
+            self._read_output()
             self.diag.update(outcome="cancelled",
                              seconds=round(time.monotonic() - self._t0, 1))
         self._done = True
@@ -369,13 +398,15 @@ def main() -> None:
     sys.exit(0)
 
 
-def _apply_child_platform_pin() -> None:
+def apply_child_platform_pin() -> None:
     """Pin the jax platform BEFORE any backend init.
 
     This image's sitecustomize force-sets ``jax_platforms="axon,cpu"`` in
     every interpreter, which overrides the ``JAX_PLATFORMS`` env var — so a
     "CPU fallback" child would still try to initialize the (possibly hung)
-    TPU tunnel. ``jax.config.update`` after import wins over both.
+    TPU tunnel. ``jax.config.update`` after import wins over both. The ONE
+    home for this workaround — the bench scripts (bench_models,
+    bench_scorehead) call it too.
     """
     pin = os.environ.get(PLATFORM_ENV_VAR)
     if pin:
@@ -386,10 +417,10 @@ def _apply_child_platform_pin() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
-        _apply_child_platform_pin()
+        apply_child_platform_pin()
         child_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "--run":
-        _apply_child_platform_pin()
+        apply_child_platform_pin()
         child_run(int(sys.argv[2]))
     else:
         main()

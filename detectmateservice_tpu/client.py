@@ -2,8 +2,9 @@
 
 Parity with the reference client (reference: src/service/client.py:27-120):
 subcommands ``start`` / ``stop`` / ``status`` / ``metrics`` /
-``reconfigure [--persist]`` against ``--url``. Uses stdlib urllib — no extra
-dependencies.
+``reconfigure [--persist]`` against ``--url``, plus the TPU-build addition
+``checkpoint`` (save component state to the service's checkpoint_dir).
+Uses stdlib urllib — no extra dependencies.
 """
 from __future__ import annotations
 
@@ -57,6 +58,10 @@ class DetectMateClient:
             "POST", "/admin/reconfigure", {"config": config, "persist": persist}
         )
 
+    def checkpoint(self) -> Any:
+        """Save component state to the service's checkpoint_dir now."""
+        return self._request("POST", "/admin/checkpoint")
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
@@ -69,6 +74,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("shutdown")
     sub.add_parser("status")
     sub.add_parser("metrics")
+    sub.add_parser("checkpoint")
     reconf = sub.add_parser("reconfigure")
     reconf.add_argument("config_file", help="YAML file with the new component config")
     reconf.add_argument("--persist", action="store_true")

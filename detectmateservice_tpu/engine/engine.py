@@ -261,14 +261,20 @@ class Engine:
         read_l.inc(sum(map(_count_lines, msgs)))
         return msgs
 
-    def _collect_burst(self, deadline: float, remaining_fn, on_frame) -> None:
+    def _collect_burst(self, deadline: float, remaining_fn, on_frame,
+                       per_frame: bool = False) -> None:
         """Drain further wire frames from the input socket until ``deadline``
         or until ``remaining_fn()`` (items still wanted, also the recv_many
         count hint) drops to zero; ``on_frame`` consumes each non-empty
         frame. One home for the recv_many probe and the recv-timeout
         save/restore subtlety, shared by the classic micro-batch and the
-        fused-frame collection paths."""
-        recv_many = getattr(self._pair_sock, "recv_many", None)
+        fused-frame collection paths. ``per_frame=True`` forces one recv per
+        frame even when recv_many exists — required when the caller reads
+        ``last_origin`` after each frame (a recv_many burst can span shards/
+        connections but reports only one origin, which would misroute
+        replies)."""
+        recv_many = (None if per_frame
+                     else getattr(self._pair_sock, "recv_many", None))
         saved_timeout = (None if callable(recv_many)
                          else self._pair_sock.recv_timeout)
         while remaining_fn() > 0:
@@ -433,7 +439,8 @@ class Engine:
             self._collect_burst(
                 time.monotonic() + batch_timeout_s,
                 lambda: batch_size - len(batch),
-                on_burst_frame)
+                on_burst_frame,
+                per_frame=track_origins)
             # a packed ingress frame can carry more messages than
             # engine_batch_size; re-chunk so the component never sees a batch
             # beyond the configured cap (its memory/latency contract)

@@ -50,6 +50,11 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # sequences, fused einsum otherwise) | "einsum" | "flash" | "blockwise"
     # | "ring" (sequence-parallel over the mesh_shape 'seq' axis)
     attn_impl: str = "auto"
+    # candidate scoring-head path (gru/logbert with score_vocab > 0):
+    # "auto"/"einsum" = S-chunked einsum + low-precision logsumexp;
+    # "pallas" = fused online-logsumexp kernel (ops/scorehead.py) that
+    # never materializes the [N, C] logits in HBM
+    head_impl: str = "auto"
     data_use_training: int = 256
     train_epochs: int = 3
     # small training buffers still get enough optimizer steps to converge
@@ -167,6 +172,10 @@ class JaxScorerDetector(CoreDetector):
             raise LibraryError(
                 f"unknown dtype {cfg.dtype!r}; expected 'auto', 'bfloat16', "
                 "'float32', or 'float16'")
+        if cfg.head_impl not in ("auto", "einsum", "pallas"):
+            raise LibraryError(
+                f"unknown head_impl {cfg.head_impl!r}; expected 'auto', "
+                "'einsum', or 'pallas'")
 
     # -- lifecycle ------------------------------------------------------
     def setup_io(self) -> None:
@@ -229,7 +238,7 @@ class JaxScorerDetector(CoreDetector):
                 vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
                 heads=cfg.heads, seq_len=cfg.seq_len, score_topk=cfg.score_topk,
                 attn_impl=cfg.attn_impl, score_vocab=cfg.score_vocab,
-                **dtype_kw,
+                head_impl=cfg.head_impl, **dtype_kw,
             ))
         elif cfg.model == "gru":
             from ...models.gru import GRUScorer, GRUScorerConfig
@@ -237,7 +246,8 @@ class JaxScorerDetector(CoreDetector):
             self._scorer = GRUScorer(GRUScorerConfig(
                 vocab_size=cfg.vocab_size, dim=cfg.dim, depth=cfg.depth,
                 seq_len=cfg.seq_len, score_topk=cfg.score_topk,
-                score_vocab=cfg.score_vocab, **dtype_kw,
+                score_vocab=cfg.score_vocab, head_impl=cfg.head_impl,
+                **dtype_kw,
             ))
         elif cfg.model == "mlp":
             from ...models.mlp import MLPScorer, MLPScorerConfig
@@ -966,7 +976,7 @@ class JaxScorerDetector(CoreDetector):
         super().validate_reconfigure(new_config)
         frozen = ("model", "vocab_size", "seq_len", "dim", "depth", "heads",
                   "score_topk", "score_vocab", "score_norm", "mesh_shape",
-                  "attn_impl", "dtype")
+                  "attn_impl", "dtype", "head_impl")
         for field in frozen:
             if getattr(new_config, field) != getattr(self.config, field):
                 raise LibraryError(

@@ -215,6 +215,18 @@ class SequenceScorerBase(ScorerBase):
         tgt = jnp.einsum("bsd,bsd->bs", hidden, emb[tokens],
                          preferred_element_type=jnp.float32)
         b, s, d = hidden.shape
+        if getattr(self.config, "head_impl", "auto") == "pallas":
+            # fused online-logsumexp kernel: the [N, C] logits never touch
+            # HBM (ops/scorehead.py); no S-chunking needed — the kernel's
+            # working set is one (block_n × block_c) tile in VMEM.
+            # interpret mode keeps the path runnable (and testable) on CPU
+            from ..ops.scorehead import candidate_lse
+
+            on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
+            lse = candidate_lse(hidden.reshape(b * s, d), emb_c,
+                                interpret=not on_tpu
+                                ).reshape(b, s) + correction
+            return -(tgt - lse) * (tokens != PAD_ID).astype(jnp.float32)
         # the [B, Sc, C] candidate logits are stored in the compute dtype
         # (bf16 halves their HBM footprint → Sc doubles per chunk vs fp32,
         # the "larger S-chunks" lever); MXU accumulation is fp32 either way

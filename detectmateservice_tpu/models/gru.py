@@ -52,6 +52,8 @@ class GRUScorerConfig:
     score_topk: int = 0
     # candidate-vocab approximate NLL (same knob as LogBERTConfig.score_vocab)
     score_vocab: int = 0
+    # candidate scoring-head implementation (same knob as LogBERTConfig)
+    head_impl: str = "auto"
 
 
 class GRULM(nn.Module):

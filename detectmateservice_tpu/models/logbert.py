@@ -50,6 +50,11 @@ class LogBERTConfig:
     # "auto" = pallas flash kernel on TPU for long sequences, fused einsum
     # otherwise; "einsum" | "flash" | "blockwise" force a path
     attn_impl: str = "auto"
+    # candidate scoring-head implementation: "auto"/"einsum" = S-chunked
+    # einsum + low-precision logsumexp (models/base.py); "pallas" = fused
+    # online-logsumexp kernel that never materializes the [N, C] logits
+    # (ops/scorehead.py — route here once measured faster on real chips)
+    head_impl: str = "auto"
 
 
 class Block(nn.Module):

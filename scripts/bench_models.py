@@ -68,6 +68,9 @@ def main() -> None:
     train = B.make_messages(2048, anomaly_rate=0.0)
     import jax
 
+    # DETECTMATE_BENCH_PLATFORM=cpu escapes a hung TPU tunnel (bench.py
+    # owns the sitecustomize-beating mechanism)
+    B.apply_child_platform_pin()
     platform = jax.devices()[0].platform
     results = []
     for model, overrides in (

@@ -1,0 +1,79 @@
+"""Candidate scoring-head microbench: XLA einsum+logsumexp vs the fused
+Pallas online-logsumexp kernel (ops/scorehead.py).
+
+The shapes are the logbert/gru candidate-path hot shapes: N = B·S rows of
+hidden state against C candidate embeddings. The XLA path materializes the
+[N, C] logits between matmul and reduce; the kernel keeps them in VMEM —
+on a chip the delta is HBM traffic, so run this ON TPU to decide whether
+``head_impl: pallas`` should become the auto route.
+
+Usage: python scripts/bench_scorehead.py [repeats]
+       DETECTMATE_BENCH_PLATFORM=cpu python scripts/bench_scorehead.py  # CPU smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    repeats = int(sys.argv[1]) if len(sys.argv) > 1 else 15
+    import jax
+
+    import bench as B
+
+    # DETECTMATE_BENCH_PLATFORM=cpu escapes a hung TPU tunnel (bench.py
+    # owns the sitecustomize-beating mechanism)
+    B.apply_child_platform_pin()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from detectmateservice_tpu.ops.scorehead import candidate_lse
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    rng = np.random.default_rng(0)
+    shapes = [
+        # (label, N, C, D) — N = B*S for the shipped batch shapes
+        ("logbert-16k x 32, C=2048, D=256", 16384 * 32, 2048, 256),
+        ("gru-16k x 32, C=2048, D=128", 16384 * 32, 2048, 128),
+        ("small (CPU-safe)", 4096, 512, 128),
+    ] if on_tpu else [("small (CPU-safe)", 4096, 512, 128)]
+
+    def xla_lse(h, e):
+        logits = jax.lax.dot_general(
+            h, e, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.bfloat16)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        s = jnp.sum(jnp.exp(logits - m), axis=-1, dtype=jnp.float32)
+        return jnp.log(s) + m[..., 0].astype(jnp.float32)
+
+    for label, n, c, d in shapes:
+        h = jnp.asarray(rng.normal(size=(n, d)), jnp.bfloat16)
+        e = jnp.asarray(rng.normal(size=(c, d)), jnp.bfloat16)
+        f_x = jax.jit(xla_lse)
+        f_p = jax.jit(lambda h, e: candidate_lse(h, e, interpret=not on_tpu))
+        # parity first — a fast wrong kernel is worthless
+        err = float(jnp.max(jnp.abs(f_x(h, e) - f_p(h, e))))
+        out = {"shape": label, "n": n, "c": c, "d": d,
+               "platform": platform, "max_abs_err": round(err, 5)}
+        for name, fn in (("xla_ms", f_x), ("pallas_ms", f_p)):
+            fn(h, e).block_until_ready()  # compile
+            ts = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                fn(h, e).block_until_ready()
+                ts.append((time.perf_counter() - t0) * 1000)
+            out[name] = round(statistics.median(ts), 3)
+        out["speedup"] = round(out["xla_ms"] / max(out["pallas_ms"], 1e-9), 2)
+        print(json.dumps(out), flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -258,7 +258,12 @@ def main() -> None:
                 data=b"", timeout=3)
         except Exception:
             proc.terminate()
-        proc.wait(timeout=15)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            # a service wedged in a heavy device batch must not turn a
+            # completed measurement into a failed bench run
+            proc.kill()
     os._exit(0)
 
 

@@ -168,6 +168,36 @@ class TestCheckpoint:
         assert isinstance(out, list)
 
 
+class TestCandidateIdPersistence:
+    def test_candidate_ids_survive_restore_verbatim(self, tmp_path):
+        """The score_vocab candidate subset is persisted in checkpoint meta
+        and reused on restore — numpy's Generator bit-stream is not stable
+        across numpy versions, so regenerating from the seed could silently
+        shift the approximation under the fit-frozen threshold (advisor r3)."""
+        import json
+
+        import numpy as np
+
+        det = JaxScorerDetector(config=scorer_config(
+            model="gru", depth=1, data_use_training=32, score_vocab=64,
+            vocab_size=512, async_fit=False))
+        det.process_batch(normal_msgs(32))
+        det.flush_final()
+        assert det._fitted
+        det.save_checkpoint(str(tmp_path / "ckpt"))
+        meta = json.loads((tmp_path / "ckpt" / "meta.json").read_text())
+        assert meta["cand_key"] == [512, 64]
+        assert len(meta["cand_ids"]) == 64
+
+        fresh = JaxScorerDetector(config=scorer_config(
+            model="gru", depth=1, data_use_training=32, score_vocab=64,
+            vocab_size=512, async_fit=False))
+        fresh.load_checkpoint(str(tmp_path / "ckpt"))
+        key, ids = fresh._scorer._cand_cache
+        assert key == (512, 64)
+        assert np.array_equal(ids, np.asarray(meta["cand_ids"], np.int32))
+
+
 class TestConfigValidation:
     def test_unknown_attn_impl_fails_at_construction(self):
         """ops/attention's router silently falls through to einsum for

@@ -263,3 +263,71 @@ class TestOversizedFrames:
         f = NativePairSocketFactory()
         sock = f.create(f"ws://127.0.0.1:{free_port}")
         sock.close()
+
+
+class TestMergedIngressNative:
+    """MergedIngressSocket over native shards exercises the recv_many merge
+    (native recv_many raises TransportTimeout on an idle shard — the merge
+    must treat that as a per-shard non-event, not discard the batch)."""
+
+    def test_recv_many_merges_shards_and_skips_idle(self, tmp_path):
+        from detectmateservice_tpu.engine.socket import MergedIngressSocket
+
+        f = NativePairSocketFactory()
+        s0 = f.create(f"ipc://{tmp_path}/m0.ipc")
+        s1 = f.create(f"ipc://{tmp_path}/m1.ipc")
+        merged = MergedIngressSocket([s0, s1])
+        merged.recv_timeout = 200
+        a = f.create_output(f"ipc://{tmp_path}/m0.ipc")
+        b = f.create_output(f"ipc://{tmp_path}/m1.ipc")
+        try:
+            assert callable(getattr(merged, "recv_many", None))
+            # both shards produce: one call aggregates both bursts
+            for i in range(5):
+                a.send(b"a%d" % i)
+                b.send(b"b%d" % i)
+            time.sleep(0.2)
+            got = []
+            deadline = time.monotonic() + 5
+            while len(got) < 10 and time.monotonic() < deadline:
+                got.extend(merged.recv_many(16, 100))
+            assert sorted(got) == sorted([b"a%d" % i for i in range(5)] +
+                                         [b"b%d" % i for i in range(5)])
+            # one idle shard must not discard the other's frames
+            a.send(b"solo")
+            time.sleep(0.1)
+            got2 = []
+            deadline = time.monotonic() + 5
+            while not got2 and time.monotonic() < deadline:
+                got2 = merged.recv_many(16, 100)
+            assert got2 == [b"solo"]
+        finally:
+            a.close()
+            b.close()
+            merged.close()
+
+    def test_plain_recv_round_robins(self, tmp_path):
+        from detectmateservice_tpu.engine.socket import (
+            MergedIngressSocket,
+            TransportTimeout,
+        )
+
+        f = NativePairSocketFactory()
+        s0 = f.create(f"ipc://{tmp_path}/r0.ipc")
+        s1 = f.create(f"ipc://{tmp_path}/r1.ipc")
+        merged = MergedIngressSocket([s0, s1])
+        merged.recv_timeout = 300
+        a = f.create_output(f"ipc://{tmp_path}/r0.ipc")
+        b = f.create_output(f"ipc://{tmp_path}/r1.ipc")
+        try:
+            a.send(b"one")
+            b.send(b"two")
+            time.sleep(0.2)
+            got = {merged.recv(), merged.recv()}
+            assert got == {b"one", b"two"}
+            with pytest.raises(TransportTimeout):
+                merged.recv()
+        finally:
+            a.close()
+            b.close()
+            merged.close()

@@ -1,0 +1,80 @@
+"""Fused candidate scoring-head kernel (ops/scorehead.py): parity with the
+jnp logsumexp reference in interpret mode, and the head_impl route through
+a real scorer. On-chip perf is scripts/bench_scorehead.py's job."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from detectmateservice_tpu.ops.scorehead import candidate_lse
+
+
+class TestCandidateLse:
+    @pytest.mark.parametrize("n,c,d", [(1000, 2048, 128), (256, 512, 64),
+                                       (37, 64, 32), (8, 8, 8)])
+    def test_matches_reference(self, n, c, d):
+        rng = np.random.default_rng(n + c + d)
+        h = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+        e = jnp.asarray(rng.normal(size=(c, d)), jnp.float32)
+        ref = jax.nn.logsumexp(h @ e.T, axis=-1)
+        got = candidate_lse(h, e, interpret=True)
+        assert got.shape == (n,)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_bf16_inputs_fp32_accumulation(self):
+        rng = np.random.default_rng(0)
+        h = jnp.asarray(rng.normal(size=(512, 64)), jnp.bfloat16)
+        e = jnp.asarray(rng.normal(size=(256, 64)), jnp.bfloat16)
+        ref = jax.nn.logsumexp(
+            h.astype(jnp.float32) @ e.astype(jnp.float32).T, axis=-1)
+        got = candidate_lse(h, e, interpret=True)
+        assert got.dtype == jnp.float32
+        # bf16 matmul inputs with fp32 accumulation: small drift allowed
+        assert float(jnp.max(jnp.abs(got - ref))) < 0.1
+
+    def test_extreme_values_stay_finite(self):
+        """Online max-subtraction must keep exp in range the way the
+        two-pass reference does."""
+        h = jnp.full((16, 32), 50.0, jnp.float32)
+        e = jnp.concatenate([jnp.full((8, 32), 2.0), jnp.full((8, 32), -2.0)])
+        ref = jax.nn.logsumexp(h @ e.T, axis=-1)
+        got = candidate_lse(h, e, interpret=True)
+        assert bool(jnp.isfinite(got).all())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-3)
+
+    def test_block_snapping_on_non_pow2_candidates(self):
+        rng = np.random.default_rng(1)
+        h = jnp.asarray(rng.normal(size=(100, 16)), jnp.float32)
+        e = jnp.asarray(rng.normal(size=(96, 16)), jnp.float32)  # 96 = 3*32
+        ref = jax.nn.logsumexp(h @ e.T, axis=-1)
+        got = candidate_lse(h, e, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-4)
+
+
+class TestHeadImplRoute:
+    def test_gru_pallas_head_matches_einsum_head(self):
+        from detectmateservice_tpu.models.gru import GRUScorer, GRUScorerConfig
+
+        toks = jnp.asarray(np.random.default_rng(2).integers(
+            1, 4000, (64, 16)), jnp.int32)
+        base = dict(vocab_size=4096, dim=64, depth=1, seq_len=16,
+                    score_vocab=512)
+        s_e = GRUScorer(GRUScorerConfig(**base, head_impl="einsum"))
+        s_p = GRUScorer(GRUScorerConfig(**base, head_impl="pallas"))
+        params, _ = s_e.init(jax.random.PRNGKey(0))
+        a = np.asarray(s_e.score(params, toks))
+        b = np.asarray(s_p.score(params, toks))
+        assert np.abs(a - b).max() < 0.05
+
+    def test_detector_validates_head_impl(self):
+        from detectmateservice_tpu.library.common.core import LibraryError
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        with pytest.raises(LibraryError, match="head_impl"):
+            JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+                "method_type": "jax_scorer", "auto_config": False,
+                "head_impl": "cuda",
+            }}})
