@@ -224,27 +224,29 @@ class _Child:
                 text=True, env=env)
         except Exception as exc:
             self._proc = None
+            for f in (self._out_f, self._err_f):
+                try:
+                    f.close()
+                except Exception:
+                    pass
             self.diag.update(outcome="spawn_error", error=repr(exc))
             self._done = True
 
-    def _read_output(self) -> tuple[str, str]:
-        out = err = ""
-        for attr, name in ((self._out_f, "out"), (self._err_f, "err")):
+    @staticmethod
+    def _drain(f) -> str:
+        try:
+            f.seek(0)
+            return f.read()
+        except Exception:
+            return ""
+        finally:
             try:
-                attr.seek(0)
-                text = attr.read()
+                f.close()
             except Exception:
-                text = ""
-            finally:
-                try:
-                    attr.close()
-                except Exception:
-                    pass
-            if name == "out":
-                out = text
-            else:
-                err = text
-        return out, err
+                pass
+
+    def _read_output(self) -> tuple[str, str]:
+        return self._drain(self._out_f), self._drain(self._err_f)
 
     def poll(self) -> bool:
         """Advance state; True once the child has finished (any outcome)."""
@@ -261,9 +263,12 @@ class _Child:
                 self._proc.wait(timeout=10)
             except Exception:
                 pass
-            self._read_output()
+            _, stderr = self._read_output()
+            # the timeout outcome is where the runtime's retry/abort spew
+            # matters most for diagnosis — keep the tail
             self.diag.update(outcome="timeout",
-                             seconds=round(now - self._t0, 1))
+                             seconds=round(now - self._t0, 1),
+                             stderr_tail=stderr[-800:])
             self._done = True
             return True
         stdout, stderr = self._read_output()

@@ -436,11 +436,17 @@ class Engine:
                     batch_origins.extend(
                         [self._pair_sock.last_origin] * len(ms))
 
+            # per-frame recv (no recv_many burst) only when origins can
+            # actually differ: misrouting needs >= 2 live reply peers; the
+            # common single-dialer reply pipe keeps burst draining. (A peer
+            # connecting mid-burst can misattribute that one burst's
+            # origins — accepted: the alternative taxes every burst.)
             self._collect_burst(
                 time.monotonic() + batch_timeout_s,
                 lambda: batch_size - len(batch),
                 on_burst_frame,
-                per_frame=track_origins)
+                per_frame=(track_origins and
+                           getattr(self._pair_sock, "peer_count", 1) > 1))
             # a packed ingress frame can carry more messages than
             # engine_batch_size; re-chunk so the component never sees a batch
             # beyond the configured cap (its memory/latency contract)

@@ -444,6 +444,12 @@ class FramedTcpListener:
         return frame
 
     @property
+    def peer_count(self) -> int:
+        """Live fan-in connections. The engine uses this to skip per-frame
+        origin bookkeeping when only one peer exists (misrouting needs two)."""
+        return len(self._conns)
+
+    @property
     def last_origin(self):
         """Opaque token identifying the connection the most recent ``recv``'d
         frame arrived on. Capture it right after ``recv`` and pass it to
@@ -537,6 +543,17 @@ class FramedTcpDialer:
             try:
                 raw = _stdsocket.create_connection((self._host, self._port),
                                                    timeout=self._dial_timeout)
+                # TCP self-connect guard: redialing a DOWN localhost listener,
+                # the kernel can pick the target port as this socket's
+                # ephemeral source port and the simultaneous-open handshake
+                # connects the socket TO ITSELF. The SP/ws handshake then
+                # "succeeds" against our own bytes, the dialer believes the
+                # peer is back (black-holing traffic into an echo loop), and
+                # the port stays captured so the real listener can never
+                # rebind (EADDRINUSE). Found by tests/test_chaos.py.
+                if raw.getsockname() == raw.getpeername():
+                    raw.close()
+                    raise TransportError("self-connect (peer is down)")
                 conn = self._prepare(raw, False)
                 # the connect timeout must NOT govern steady-state reads
                 # (it made the reader tear down + redial on every ~1 s of
@@ -1147,6 +1164,12 @@ class MergedIngressSocket:
                 break
         self._idx = (self._idx + 1) % k
         return frames
+
+    @property
+    def peer_count(self) -> int:
+        """Reply destinations across all shards: shards with their own
+        peer accounting report it; a plain pair shard counts as one."""
+        return sum(getattr(s, "peer_count", 1) for s in self._socks)
 
     @property
     def last_origin(self):

@@ -228,6 +228,15 @@ class JaxScorerDetector(CoreDetector):
         self._validate_static_config()
         import jax.numpy as jnp
 
+        if cfg.head_impl == "pallas":
+            # fail at boot, not per batch: without this, a pallas-less jax
+            # would start "running" while every detect batch errored out
+            from ...ops.scorehead import _PALLAS_OK
+
+            if not _PALLAS_OK:
+                raise LibraryError(
+                    "head_impl 'pallas' needs jax.experimental.pallas, "
+                    "which this jax install does not provide")
         dtype_kw = {}
         if cfg.dtype and cfg.dtype != "auto":
             dtype_kw["dtype"] = jnp.dtype(cfg.dtype).type

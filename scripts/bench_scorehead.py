@@ -58,10 +58,15 @@ def main() -> None:
         e = jnp.asarray(rng.normal(size=(c, d)), jnp.bfloat16)
         f_x = jax.jit(xla_lse)
         f_p = jax.jit(lambda h, e: candidate_lse(h, e, interpret=not on_tpu))
-        # parity first — a fast wrong kernel is worthless
+        # parity first — a fast wrong kernel is worthless. The XLA side
+        # exps in bf16, the kernel in fp32, so ~0.15 of drift is the two
+        # approximations disagreeing; past 0.3 the kernel is WRONG and the
+        # speedup must not be reported as actionable.
         err = float(jnp.max(jnp.abs(f_x(h, e) - f_p(h, e))))
+        parity_ok = err < 0.3
         out = {"shape": label, "n": n, "c": c, "d": d,
-               "platform": platform, "max_abs_err": round(err, 5)}
+               "platform": platform, "max_abs_err": round(err, 5),
+               "parity": "ok" if parity_ok else "FAIL"}
         for name, fn in (("xla_ms", f_x), ("pallas_ms", f_p)):
             fn(h, e).block_until_ready()  # compile
             ts = []
@@ -70,8 +75,12 @@ def main() -> None:
                 fn(h, e).block_until_ready()
                 ts.append((time.perf_counter() - t0) * 1000)
             out[name] = round(statistics.median(ts), 3)
-        out["speedup"] = round(out["xla_ms"] / max(out["pallas_ms"], 1e-9), 2)
+        if parity_ok:
+            out["speedup"] = round(out["xla_ms"] / max(out["pallas_ms"], 1e-9), 2)
         print(json.dumps(out), flush=True)
+        if not parity_ok:
+            print(f"# PARITY FAIL on {label}: do NOT act on the timing above",
+                  file=sys.stderr)
     os._exit(0)
 
 

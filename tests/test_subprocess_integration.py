@@ -237,3 +237,18 @@ class TestSubprocessPipeline:
         dated = outdir / time.strftime("output.%Y%m%d")
         assert dated.exists()
         assert json.loads(dated.read_text().splitlines()[0])["logIDs"] == ["7"]
+
+
+class TestWalkthroughScript:
+    """The operator walkthrough (scripts/walkthrough_reconnect.py) must stay
+    runnable — it is documentation that executes (docs/walkthrough.md), and
+    it pins the start-order-independence + self-healing contract end to end
+    with real service processes."""
+
+    def test_reconnect_walkthrough_passes(self):
+        repo = Path(__file__).resolve().parent.parent
+        proc = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "walkthrough_reconnect.py")],
+            capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
+        assert "walkthrough PASSED" in proc.stdout
