@@ -75,7 +75,10 @@ def attention(
     if impl == "flash":
         from .flash import flash_attention
 
-        return flash_attention(q, k, v, key_mask)
+        # interpret mode keeps a forced flash config runnable (and its
+        # numerics testable) on CPU hosts — slow, but not a crash
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+        return flash_attention(q, k, v, key_mask, interpret=not on_tpu)
     mask = None if key_mask is None else key_mask[:, None, None, :]
     if impl == "blockwise":
         return blockwise_attention(q, k, v, mask=mask)

@@ -94,6 +94,7 @@ def _flash_kernel(bias_ref, q_ref, k_ref, v_ref, o_ref,
                     ).astype(o_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention(
     q: jax.Array,                     # [B, H, S, D]
     k: jax.Array,                     # [B, H, T, D]
@@ -106,7 +107,15 @@ def flash_attention(
     """Fused attention; numerically matches ``dot_product_attention`` with a
     broadcast key mask (the scorer's use). S/T pad up to block multiples
     internally; D must be an MXU-friendly multiple of 8 (it is 64 for every
-    shipped config)."""
+    shipped config).
+
+    Differentiable: the forward runs the fused kernel; the backward
+    (``custom_vjp``) rematerializes attention through the einsum
+    formulation and takes its exact gradient. That trades the backward's
+    memory high-water back up to the [S, T] logits — fine at training
+    shapes (train batches are small; the shipped configs train at S=32) —
+    so for *training* at very long S prefer ``attn_impl: blockwise`` or
+    ``ring``; the kernel's O(S·block_k) advantage is a scoring-path win."""
     if not _PALLAS_OK:
         raise RuntimeError("pallas is unavailable in this jax install")
     b, h, s, d = q.shape
@@ -158,3 +167,33 @@ def flash_attention(
 
     out = out.reshape(b, h, s_pad, d)
     return out[:, :, :s] if s_pad != s else out
+
+
+def _reference_attention(q, k, v, key_mask):
+    """The einsum formulation the kernel matches — the backward's source of
+    exact gradients (and the parity oracle in tests)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * (d ** -0.5)
+    if key_mask is not None:
+        s = s + jnp.where(key_mask, 0.0, _NEG_BIG)[:, None, None, :]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _flash_fwd(q, k, v, key_mask, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, key_mask, block_q, block_k, interpret)
+    return out, (q, k, v, key_mask)
+
+
+def _flash_bwd(block_q, block_k, interpret, residuals, g):
+    q, k, v, key_mask = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference_attention(q_, k_, v_,
+                                                             key_mask),
+                     q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

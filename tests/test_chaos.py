@@ -196,10 +196,13 @@ class TestSoak:
         frame = pack_batch(payloads)
 
         def pump_half():
+            # snapshot BEFORE sending: the drain thread runs concurrently,
+            # so a post-send snapshot would already include this half's
+            # deliveries and push the target past the achievable total
+            target = received[0] + n_half
             for _ in range(n_half // frame_n):
                 ingress.send(frame)
             deadline = time.monotonic() + 120
-            target = received[0] + n_half
             while received[0] < target and time.monotonic() < deadline:
                 time.sleep(0.05)
 

@@ -88,3 +88,53 @@ class TestRouting:
         b = attention(q, k, v, key_mask=mask, impl="blockwise")
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestFlashGradients:
+    """Training THROUGH the flash kernel must work (a user can set
+    attn_impl: flash and call fit): forward runs the fused kernel, backward
+    rematerializes via the einsum formulation (custom_vjp) and must match
+    the reference gradient exactly."""
+
+    def test_grads_match_reference(self):
+        import numpy as np
+
+        from detectmateservice_tpu.ops.flash import (
+            _reference_attention,
+            flash_attention,
+        )
+
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(1, 2, 64, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 2, 64, 32)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 2, 64, 32)), jnp.float32)
+        mask = jnp.asarray(rng.random((1, 64)) > 0.2)
+
+        gf = jax.grad(lambda q, k, v: (flash_attention(
+            q, k, v, mask, 256, 512, True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: (_reference_attention(
+            q, k, v, mask) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert float(jnp.max(jnp.abs(a - b))) < 1e-3
+
+    def test_logbert_trains_with_flash_attn(self):
+        """End-to-end: init + one train step through a LogBERT configured
+        with attn_impl=flash must produce finite loss and updated params —
+        on CPU the attention router falls back to the interpret-mode kernel
+        instead of crashing, so a forced-flash config is trainable anywhere."""
+        import numpy as np
+
+        from detectmateservice_tpu.models import logbert as lb
+
+        scorer = lb.LogBERTScorer(lb.LogBERTConfig(
+            vocab_size=512, dim=32, depth=1, heads=2, seq_len=16,
+            attn_impl="flash"))
+        params, opt_state = scorer.init(jax.random.PRNGKey(0))
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            1, 500, (8, 16)), jnp.int32)
+        new_params, _, loss = scorer.train_step(
+            params, opt_state, jax.random.PRNGKey(1), toks)
+        assert bool(jnp.isfinite(loss))
+        leaf_changed = jax.tree_util.tree_map(
+            lambda a, b: bool((a != b).any()), params, new_params)
+        assert any(jax.tree_util.tree_leaves(leaf_changed))

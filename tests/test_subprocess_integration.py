@@ -252,3 +252,57 @@ class TestWalkthroughScript:
             capture_output=True, text=True, timeout=240)
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
         assert "walkthrough PASSED" in proc.stdout
+
+
+class TestShardedIngressService:
+    """A real service subprocess listening on N ingress shards
+    (engine_ingress_addrs): senders on DIFFERENT shards both reach the
+    component, and the detection contract holds across shards."""
+
+    def test_two_shards_one_detector(self, workdir, reap, free_port):
+        s0 = f"ipc://{workdir}/s0.ipc"
+        s1 = f"ipc://{workdir}/s1.ipc"
+        config = _write_yaml(workdir / "nvd.yaml", {"detectors": {
+            "NewValueDetector": {
+                "method_type": "new_value_detector", "auto_config": False,
+                "data_use_training": 4,
+                "global": {"g": {"variables": [{"pos": 0, "name": "user"}]}},
+            }}})
+        settings = _write_yaml(workdir / "svc.yaml", {
+            "component_type": "detectors.new_value_detector.NewValueDetector",
+            "component_id": "sharded-nvd",
+            "engine_addr": f"ipc://{workdir}/main.ipc",
+            "engine_ingress_addrs": [s0, s1],
+            "out_addr": [f"ipc://{workdir}/alerts.ipc"],
+            "http_port": free_port, "log_to_file": False,
+            "config_file": str(config),
+        })
+        proc = _spawn_service(settings, workdir / "svc.log")
+        reap(proc)
+        _poll_running(free_port, proc, workdir / "svc.log")
+
+        factory = ZmqPairSocketFactory()
+        alerts = factory.create(f"ipc://{workdir}/alerts.ipc")
+        alerts.recv_timeout = 10000
+        a = factory.create_output(s0)
+        b = factory.create_output(s1)
+
+        def msg(user, lid):
+            return ParserSchema(EventID=1, template="user <*> ran <*>",
+                                variables=[user, "ls"], logID=lid,
+                                logFormatVariables={}).serialize()
+
+        # training split across BOTH shards
+        for i in range(2):
+            a.send(msg("alice", f"a{i}"))
+            b.send(msg("bob", f"b{i}"))
+        time.sleep(1.0)
+        # novel value via shard 1 -> alert out
+        b.send(msg("mallory", "evil"))
+        alert = DetectorSchema.from_bytes(alerts.recv())
+        assert list(alert.logIDs) == ["evil"]
+        # known value via shard 0 -> silence
+        a.send(msg("alice", "fine"))
+        alerts.recv_timeout = 1500
+        with pytest.raises(TransportTimeout):
+            alerts.recv()
