@@ -187,6 +187,19 @@ class SequenceScorerBase(ScorerBase):
         return self._token_nlls_exact(params, tokens, dtype)
 
     @staticmethod
+    def _pallas_lse(hidden: jax.Array, emb_matrix: jax.Array) -> jax.Array:
+        """[B, S] logsumexp of hidden·emb_matrixᵀ via the fused kernel
+        (ops/scorehead.py): the logits never leave VMEM. One home for the
+        lazy import + interpret-on-CPU routing, shared by the candidate
+        and exact heads."""
+        from ..ops.scorehead import candidate_lse
+
+        b, s, d = hidden.shape
+        on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
+        return candidate_lse(hidden.reshape(b * s, d), emb_matrix,
+                             interpret=not on_tpu).reshape(b, s)
+
+    @staticmethod
     def _lse_low_precision(logits, dtype) -> jax.Array:
         """logsumexp with the exp in the model's compute dtype and the SUM
         reduced in fp32 (the r3 roofline's "bf16 logsumexp, fp32 reduce"
@@ -217,15 +230,9 @@ class SequenceScorerBase(ScorerBase):
         b, s, d = hidden.shape
         if getattr(self.config, "head_impl", "auto") == "pallas":
             # fused online-logsumexp kernel: the [N, C] logits never touch
-            # HBM (ops/scorehead.py); no S-chunking needed — the kernel's
-            # working set is one (block_n × block_c) tile in VMEM.
-            # interpret mode keeps the path runnable (and testable) on CPU
-            from ..ops.scorehead import candidate_lse
-
-            on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
-            lse = candidate_lse(hidden.reshape(b * s, d), emb_c,
-                                interpret=not on_tpu
-                                ).reshape(b, s) + correction
+            # HBM; no S-chunking needed — the kernel's working set is one
+            # (block_n × block_c) tile in VMEM
+            lse = self._pallas_lse(hidden, emb_c) + correction
             return -(tgt - lse) * (tokens != PAD_ID).astype(jnp.float32)
         # the [B, Sc, C] candidate logits are stored in the compute dtype
         # (bf16 halves their HBM footprint → Sc doubles per chunk vs fp32,
@@ -257,11 +264,20 @@ class SequenceScorerBase(ScorerBase):
 
         bf16 multiplies with fp32 accumulation (MXU-native); identical
         formulation to the models' __call__ head so full and chunked
-        paths agree bit-for-bit."""
+        paths agree bit-for-bit. ``head_impl: pallas`` swaps the chunked
+        einsum+lse for the fused online-logsumexp kernel — the [B, Sc, V]
+        logits (the exact path's HBM high-water) never materialize; the
+        target logit comes from the equivalent direct hidden·emb[token]
+        dot."""
         hidden = self.model.apply(params, tokens, method="hidden").astype(dtype)
         emb = params["params"]["tok_embed"]["embedding"].astype(dtype)
         b, s, d = hidden.shape
         v = emb.shape[0]
+        if getattr(self.config, "head_impl", "auto") == "pallas":
+            lse = self._pallas_lse(hidden, emb)
+            tgt = jnp.einsum("bsd,bsd->bs", hidden, emb[tokens],
+                             preferred_element_type=jnp.float32)
+            return -(tgt - lse) * (tokens != PAD_ID).astype(jnp.float32)
         sc = max(1, min(s, self._CHUNK_ELEMENT_BUDGET // max(1, b * v)))
         while s % sc:
             sc -= 1

@@ -42,7 +42,7 @@ except Exception:  # pragma: no cover - environment without pallas
     _PALLAS_OK = False
 
 
-def _lse_kernel(h_ref, e_ref, o_ref, m_ref, l_ref):
+def _lse_kernel(bias_ref, h_ref, e_ref, o_ref, m_ref, l_ref):
     """One (n-block, c-block) grid step of the online logsumexp."""
     cb = pl.program_id(1)
 
@@ -56,6 +56,7 @@ def _lse_kernel(h_ref, e_ref, o_ref, m_ref, l_ref):
     s = jax.lax.dot_general(                       # [bn, bc] fp32
         h, e, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
     )
+    s = s + bias_ref[:]                            # [1, bc]: C-pad rows → -inf
     m_prev = m_ref[:, :1]                          # [bn, 1]
     l_prev = l_ref[:, :1]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -74,13 +75,6 @@ def _lse_kernel(h_ref, e_ref, o_ref, m_ref, l_ref):
             o_ref.shape)
 
 
-def _largest_divisor_leq(n: int, cap: int) -> int:
-    for d in range(min(n, cap), 0, -1):
-        if n % d == 0:
-            return d
-    return 1
-
-
 def candidate_lse(hidden: jax.Array, emb_c: jax.Array,
                   block_n: int = DEFAULT_BLOCK_N,
                   block_c: int = DEFAULT_BLOCK_C,
@@ -89,25 +83,32 @@ def candidate_lse(hidden: jax.Array, emb_c: jax.Array,
     ``[N, C]`` logits in HBM.
 
     ``hidden``: [N, D] (any float dtype; the matmul accumulates fp32),
-    ``emb_c``: [C, D]. Returns fp32 [N]. ``block_c`` snaps down to a
-    divisor of C (candidate counts are powers of two in every shipped
-    config); N pads internally.
+    ``emb_c``: [C, D]. Returns fp32 [N]. Both N and C pad internally to
+    block multiples — padded C rows are masked out with an additive -inf
+    bias (the flash-kernel pattern), so arbitrary vocab/candidate sizes
+    keep full-width blocks instead of degrading to divisor-sized ones.
     """
     if not _PALLAS_OK:
         raise RuntimeError("pallas is unavailable in this jax install")
     n, d = hidden.shape
     c = emb_c.shape[0]
     block_n = min(block_n, max(n, 8))
-    block_c = _largest_divisor_leq(c, block_c)
+    block_c = min(block_c, max(c, 128))
     n_pad = -(-n // block_n) * block_n
+    c_pad = -(-c // block_c) * block_c
     if n_pad != n:
         hidden = jnp.pad(hidden, ((0, n_pad - n), (0, 0)))
+    if c_pad != c:
+        emb_c = jnp.pad(emb_c, ((0, c_pad - c), (0, 0)))
+    bias = jnp.where(jnp.arange(c_pad) < c, 0.0, _NEG_BIG
+                     ).astype(jnp.float32)[None, :]
 
-    grid = (n_pad // block_n, c // block_c)
+    grid = (n_pad // block_n, c_pad // block_c)
     out = pl.pallas_call(
         functools.partial(_lse_kernel),
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, block_c), lambda ni, ci: (0, ci)),
             pl.BlockSpec((block_n, d), lambda ni, ci: (ni, 0)),
             pl.BlockSpec((block_c, d), lambda ni, ci: (ci, 0)),
         ],
@@ -122,5 +123,5 @@ def candidate_lse(hidden: jax.Array, emb_c: jax.Array,
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(hidden, emb_c)
+    )(bias, hidden, emb_c)
     return out[:n, 0]

@@ -38,14 +38,24 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     rng = np.random.default_rng(0)
+    # each row's XLA baseline is the SHIPPED einsum route for that path
+    # (models/base.py): the candidate head computes bf16 logits + the
+    # low-precision lse; the exact head computes fp32 logits +
+    # jax.nn.logsumexp — A/B'ing pallas against anything else would decide
+    # the auto route on numbers head_impl: auto never produces
     shapes = [
-        # (label, N, C, D) — N = B*S for the shipped batch shapes
-        ("logbert-16k x 32, C=2048, D=256", 16384 * 32, 2048, 256),
-        ("gru-16k x 32, C=2048, D=128", 16384 * 32, 2048, 128),
-        ("small (CPU-safe)", 4096, 512, 128),
-    ] if on_tpu else [("small (CPU-safe)", 4096, 512, 128)]
+        # (label, N, C, D, baseline) — N = B*S for the shipped batch shapes
+        ("logbert-16k x 32, C=2048, D=256", 16384 * 32, 2048, 256, "candidate"),
+        ("gru-16k x 32, C=2048, D=128", 16384 * 32, 2048, 128, "candidate"),
+        # one S-chunk of the shipped exact path (the chunk budget caps
+        # [rows, V] fp32 at 1 GB, models/base.py _CHUNK_ELEMENT_BUDGET):
+        # the baseline here IS the per-chunk compute the einsum route runs
+        ("exact-head chunk 8192 rows, V=32768, D=256", 8192, 32768, 256,
+         "exact"),
+        ("small (CPU-safe)", 4096, 512, 128, "candidate"),
+    ] if on_tpu else [("small (CPU-safe)", 4096, 512, 128, "candidate")]
 
-    def xla_lse(h, e):
+    def xla_lse_candidate(h, e):
         logits = jax.lax.dot_general(
             h, e, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.bfloat16)
@@ -53,10 +63,17 @@ def main() -> None:
         s = jnp.sum(jnp.exp(logits - m), axis=-1, dtype=jnp.float32)
         return jnp.log(s) + m[..., 0].astype(jnp.float32)
 
-    for label, n, c, d in shapes:
+    def xla_lse_exact(h, e):
+        logits = jax.lax.dot_general(
+            h, e, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return jax.nn.logsumexp(logits, axis=-1)
+
+    for label, n, c, d, baseline in shapes:
         h = jnp.asarray(rng.normal(size=(n, d)), jnp.bfloat16)
         e = jnp.asarray(rng.normal(size=(c, d)), jnp.bfloat16)
-        f_x = jax.jit(xla_lse)
+        f_x = jax.jit(xla_lse_exact if baseline == "exact"
+                      else xla_lse_candidate)
         f_p = jax.jit(lambda h, e: candidate_lse(h, e, interpret=not on_tpu))
         # parity first — a fast wrong kernel is worthless. The XLA side
         # exps in bf16, the kernel in fp32, so ~0.15 of drift is the two

@@ -97,6 +97,24 @@ class TestShardedScorer:
         multi = sharded.score(tokens)
         np.testing.assert_allclose(single, multi, rtol=2e-2, atol=2e-2)
 
+    def test_sharded_candidate_head_matches_single_device(self):
+        """score_vocab (candidate-vocab head) under a dp mesh: the seeded
+        subset constant-folds identically into every shard's program, so
+        sharded and single-device scores must agree."""
+        from detectmateservice_tpu.models.gru import GRUScorer, GRUScorerConfig
+
+        cfg = dict(vocab_size=512, dim=32, depth=1, seq_len=16,
+                   score_vocab=64)
+        scorer = GRUScorer(GRUScorerConfig(**cfg))
+        params, _ = scorer.init(jax.random.PRNGKey(0))
+        tokens = np.random.randint(3, 500, (16, 16)).astype(np.int32)
+        single = np.asarray(scorer.score(params, tokens))
+        mesh = make_mesh({"data": 8})
+        sharded = ShardedScorer(GRUScorer(GRUScorerConfig(**cfg)), mesh=mesh,
+                                rng=jax.random.PRNGKey(0))
+        multi = sharded.score(tokens)
+        np.testing.assert_allclose(single, multi, rtol=2e-2, atol=2e-2)
+
 
 class TestSequenceParallelScorer:
     """The integrated long-context path: LogBERT with attn_impl='ring' runs

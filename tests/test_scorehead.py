@@ -44,10 +44,14 @@ class TestCandidateLse:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-3)
 
-    def test_block_snapping_on_non_pow2_candidates(self):
-        rng = np.random.default_rng(1)
+    @pytest.mark.parametrize("c", [96, 1031, 613])
+    def test_non_pow2_and_prime_candidate_counts(self, c):
+        """C pads to a full block with -inf bias masking — arbitrary (even
+        prime) vocab sizes keep full-width blocks instead of degrading to
+        divisor-sized ones."""
+        rng = np.random.default_rng(c)
         h = jnp.asarray(rng.normal(size=(100, 16)), jnp.float32)
-        e = jnp.asarray(rng.normal(size=(96, 16)), jnp.float32)  # 96 = 3*32
+        e = jnp.asarray(rng.normal(size=(c, 16)), jnp.float32)
         ref = jax.nn.logsumexp(h @ e.T, axis=-1)
         got = candidate_lse(h, e, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -78,3 +82,20 @@ class TestHeadImplRoute:
                 "method_type": "jax_scorer", "auto_config": False,
                 "head_impl": "cuda",
             }}})
+
+
+class TestExactHeadPallasRoute:
+    def test_exact_path_pallas_matches_einsum(self):
+        """head_impl=pallas on the EXACT (score_vocab=0) path: fused lse +
+        direct target dot must match the chunked einsum formulation."""
+        from detectmateservice_tpu.models.gru import GRUScorer, GRUScorerConfig
+
+        toks = jnp.asarray(np.random.default_rng(5).integers(
+            1, 500, (32, 16)), jnp.int32)
+        base = dict(vocab_size=512, dim=32, depth=1, seq_len=16)
+        s_e = GRUScorer(GRUScorerConfig(**base, head_impl="einsum"))
+        s_p = GRUScorer(GRUScorerConfig(**base, head_impl="pallas"))
+        params, _ = s_e.init(jax.random.PRNGKey(0))
+        a = np.asarray(s_e.score(params, toks))
+        b = np.asarray(s_p.score(params, toks))
+        assert np.abs(a - b).max() < 0.05, np.abs(a - b).max()
