@@ -24,7 +24,6 @@ measured on real hardware — scripts/bench_scorehead.py is the harness).
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -105,7 +104,7 @@ def candidate_lse(hidden: jax.Array, emb_c: jax.Array,
 
     grid = (n_pad // block_n, c_pad // block_c)
     out = pl.pallas_call(
-        functools.partial(_lse_kernel),
+        _lse_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_c), lambda ni, ci: (0, ci)),

@@ -353,6 +353,32 @@ class TestMeshSharded:
         np.testing.assert_allclose(det.score_tokens(probe),
                                    fresh.score_tokens(probe), rtol=1e-5)
 
+    def test_checkpoint_is_topology_portable(self, tmp_path):
+        """A checkpoint is a deployment artifact, not a topology pin: state
+        trained on an 8-way mesh must restore on a single device (scale-in)
+        and vice versa (scale-out), scoring identically — the param VALUES
+        are the contract, mesh placement is per-process."""
+        mesh_det = self._mesh_detector()
+        mesh_det.process_batch(normal_msgs(32))
+        mesh_det.save_checkpoint(str(tmp_path / "m2s"))
+
+        single = JaxScorerDetector(config=scorer_config(async_fit=False))
+        single.load_checkpoint(str(tmp_path / "m2s"))
+        assert single._fitted
+        assert single._threshold == pytest.approx(mesh_det._threshold)
+        probe = np.stack([single.featurize(ParserSchema.from_bytes(m))
+                          for m in normal_msgs(4, salt="x")])
+        np.testing.assert_allclose(mesh_det.score_tokens(probe),
+                                   single.score_tokens(probe), rtol=1e-4)
+
+        # scale-out: the single-device-saved state onto a fresh mesh
+        single.save_checkpoint(str(tmp_path / "s2m"))
+        remeshed = self._mesh_detector()
+        remeshed.load_checkpoint(str(tmp_path / "s2m"))
+        assert remeshed._threshold == pytest.approx(single._threshold)
+        np.testing.assert_allclose(remeshed.score_tokens(probe),
+                                   single.score_tokens(probe), rtol=1e-4)
+
     def test_logbert_tensor_parallel_mesh(self):
         # dp×tp mesh: logbert params shard over "model" per the Megatron
         # rules; a tiny under-trained model is noisy, so assert the pipeline

@@ -256,3 +256,40 @@ class TestFanInReplyRouting:
             a.close()
             b.close()
             engine.stop()
+
+
+class TestZmqRecvMany:
+    """zmq burst drain (recv_many): same contract as the native transport —
+    one timed first recv, then non-blocking drains, TransportTimeout on an
+    empty window, steady-state recv_timeout restored afterwards."""
+
+    def test_burst_drained_in_one_call(self, tmp_path):
+        factory = ZmqPairSocketFactory()
+        listener = factory.create(f"ipc://{tmp_path}/rm.ipc")
+        listener.recv_timeout = 2000
+        dialer = factory.create_output(f"ipc://{tmp_path}/rm.ipc")
+        try:
+            for i in range(10):
+                dialer.send(b"m%d" % i)
+            time.sleep(0.3)
+            frames = listener.recv_many(8, 500)
+            assert frames == [b"m%d" % i for i in range(8)]  # capped at max_n
+            frames += listener.recv_many(8, 500)
+            assert frames == [b"m%d" % i for i in range(10)]
+            # steady-state timeout still applies to plain recv afterwards
+            t0 = time.monotonic()
+            with pytest.raises(TransportTimeout):
+                listener.recv()
+            assert 1.5 < time.monotonic() - t0 < 4.0
+        finally:
+            dialer.close()
+            listener.close()
+
+    def test_empty_window_raises_timeout(self, tmp_path):
+        factory = ZmqPairSocketFactory()
+        listener = factory.create(f"ipc://{tmp_path}/rm2.ipc")
+        try:
+            with pytest.raises(TransportTimeout):
+                listener.recv_many(8, 100)
+        finally:
+            listener.close()
