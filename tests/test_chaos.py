@@ -221,3 +221,129 @@ class TestSoak:
         assert growth_mb < 64, (
             f"RSS grew {growth_mb:.0f} MB between identical load halves "
             "(leak in the framing/socket hot path?)")
+
+
+class TestKillResume:
+    """SIGKILL a trained scorer service mid-stream; its replacement (same
+    checkpoint_dir) must resume alerting from the restored calibration
+    WITHOUT retraining — the operator story settings.checkpoint_dir exists
+    for, under the rudest possible failure."""
+
+    def test_sigkill_then_restart_resumes_alerting(self, tmp_path, free_port):
+        import json
+        import subprocess
+        import sys
+        import urllib.request
+        from pathlib import Path
+
+        import yaml
+
+        from detectmateservice_tpu.engine.socket import ZmqPairSocketFactory
+        from detectmateservice_tpu.schemas import DetectorSchema, ParserSchema
+
+        repo = Path(__file__).resolve().parent.parent
+        ckpt = tmp_path / "ckpt"
+        config = tmp_path / "scorer.yaml"
+        config.write_text(yaml.safe_dump({"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+            "data_use_training": 32, "train_epochs": 2, "min_train_steps": 60,
+            "seq_len": 16, "dim": 32, "max_batch": 32, "async_fit": False,
+            "pipeline_depth": 1, "threshold_sigma": 4.0,
+        }}}))
+        settings = tmp_path / "svc.yaml"
+        settings.write_text(yaml.safe_dump({
+            "component_type": "detectors.jax_scorer.JaxScorerDetector",
+            "component_id": "kr", "engine_addr": f"ipc://{tmp_path}/in.ipc",
+            "out_addr": [f"ipc://{tmp_path}/alerts.ipc"],
+            "http_port": free_port, "config_file": str(config),
+            "checkpoint_dir": str(ckpt), "backend": "cpu",
+            "engine_batch_size": 16, "engine_batch_timeout_ms": 30.0,
+            "log_to_file": False,
+        }))
+
+        import os
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(repo) + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+
+        log_files = []
+
+        def spawn():
+            fh = open(tmp_path / "svc.log", "ab")
+            log_files.append(fh)
+            return subprocess.Popen(
+                [sys.executable, "-m", "detectmateservice_tpu.cli",
+                 "--settings", str(settings)],
+                stdout=fh, stderr=subprocess.STDOUT, env=env)
+
+        def wait_up(proc):
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                assert proc.poll() is None, "service died during startup"
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{free_port}/admin/status",
+                            timeout=2) as r:
+                        if json.load(r)["status"]["running"]:
+                            return
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            raise AssertionError("service never came up")
+
+        def pmsg(template, variables, lid):
+            return ParserSchema(EventID=1, template=template,
+                                variables=variables, logID=lid,
+                                logFormatVariables={}).serialize()
+
+        f = ZmqPairSocketFactory()
+        alerts = f.create(f"ipc://{tmp_path}/alerts.ipc")
+        alerts.recv_timeout = 60000
+        ing = f.create_output(f"ipc://{tmp_path}/in.ipc")
+
+        # life 1: train + calibrate, checkpoint via the admin verb, then
+        # SIGKILL — no clean shutdown, no teardown hooks
+        proc = spawn()
+        try:
+            wait_up(proc)
+            for i in range(32):
+                ing.send(pmsg("user <*> ok from <*>",
+                              [f"u{i % 4}", f"10.0.0.{i % 8}"], str(i)))
+            ing.send(pmsg("segfault <*> exploit shellcode <*>",
+                          ["0xdead", "0xbeef"], "evil-1"))
+            a1 = DetectorSchema.from_bytes(alerts.recv())
+            assert list(a1.logIDs) == ["evil-1"]
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{free_port}/admin/checkpoint",
+                data=b"", timeout=60).read()
+            assert (ckpt / "meta.json").exists()
+            proc.kill()  # SIGKILL mid-life: no save-at-shutdown path runs
+            proc.wait(timeout=10)
+
+            # life 2: fresh process, same checkpoint_dir; NO training sent
+            proc = spawn()
+            wait_up(proc)
+            deadline = time.monotonic() + 60
+            got = None
+            i = 0
+            while got is None and time.monotonic() < deadline:
+                # redial window after the restart: keep nudging
+                ing.send(pmsg("segfault <*> exploit shellcode <*>",
+                              ["0xaa%d" % i, "0xbb"], "evil-2"))
+                i += 1
+                alerts.recv_timeout = 5000
+                try:
+                    got = DetectorSchema.from_bytes(alerts.recv())
+                except Exception:
+                    got = None
+            assert got is not None, "restarted service never alerted"
+            assert "evil-2" in list(got.logIDs)
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            for fh in log_files:
+                fh.close()
