@@ -263,7 +263,7 @@ class JaxScorerDetector(CoreDetector):
 
             self._scorer = MLPScorer(MLPScorerConfig(
                 vocab_size=cfg.vocab_size, dim=cfg.dim, seq_len=cfg.seq_len,
-                **dtype_kw,
+                head_impl=cfg.head_impl, **dtype_kw,
             ))
         else:
             raise LibraryError(f"unknown scorer model {cfg.model!r}")

@@ -106,6 +106,18 @@ class ScorerBase:
         raise NotImplementedError
 
     # -- shared surface -------------------------------------------------
+    @staticmethod
+    def _pallas_lse_rows(rows: jax.Array, emb_matrix: jax.Array) -> jax.Array:
+        """[N] logsumexp of rows·emb_matrixᵀ via the fused kernel
+        (ops/scorehead.py): the [N, V] logits never leave VMEM. The ONE
+        home for the lazy import + interpret-on-CPU routing, shared by
+        every ``head_impl: pallas`` path (mlp context vectors and the
+        sequence models' flattened hidden states alike)."""
+        from ..ops.scorehead import candidate_lse
+
+        on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
+        return candidate_lse(rows, emb_matrix, interpret=not on_tpu)
+
     def init(self, rng: jax.Array) -> Tuple[Any, Any]:
         dummy = jnp.zeros((1, self.config.seq_len), jnp.int32)
         params = self.model.init(rng, dummy)
@@ -186,18 +198,13 @@ class SequenceScorerBase(ScorerBase):
                                               score_vocab)
         return self._token_nlls_exact(params, tokens, dtype)
 
-    @staticmethod
-    def _pallas_lse(hidden: jax.Array, emb_matrix: jax.Array) -> jax.Array:
-        """[B, S] logsumexp of hidden·emb_matrixᵀ via the fused kernel
-        (ops/scorehead.py): the logits never leave VMEM. One home for the
-        lazy import + interpret-on-CPU routing, shared by the candidate
-        and exact heads."""
-        from ..ops.scorehead import candidate_lse
-
+    @classmethod
+    def _pallas_lse(cls, hidden: jax.Array, emb_matrix: jax.Array) -> jax.Array:
+        """[B, S] logsumexp of hidden·emb_matrixᵀ — the sequence-model view
+        over ScorerBase._pallas_lse_rows."""
         b, s, d = hidden.shape
-        on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
-        return candidate_lse(hidden.reshape(b * s, d), emb_matrix,
-                             interpret=not on_tpu).reshape(b, s)
+        return cls._pallas_lse_rows(hidden.reshape(b * s, d),
+                                    emb_matrix).reshape(b, s)
 
     @staticmethod
     def _lse_low_precision(logits, dtype) -> jax.Array:

@@ -31,23 +31,45 @@ class MLPScorerConfig:
     seq_len: int = 32
     dtype: Any = jnp.bfloat16
     learning_rate: float = 3e-3
+    # scoring-head path: "auto"/"einsum" = weight-tied attend + log_softmax
+    # ([B, V] logits materialize); "pallas" = fused online-logsumexp kernel
+    # (ops/scorehead.py) + direct target dots — no [B, V] tensor in HBM
+    head_impl: str = "auto"
 
 
 class EmbedMLPModel(nn.Module):
     config: MLPScorerConfig
 
-    @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
-        """[B, S] int32 → [B, V] fp32 logits (context token distribution)."""
+    def setup(self) -> None:
         cfg = self.config
-        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")
-        emb = embed(tokens)
+        # explicit names preserve the param-tree layout of the original
+        # nn.compact formulation (checkpoint compatibility, tree version 1)
+        self.tok_embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                                  name="tok_embed")
+        self.fc1 = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="Dense_0")
+        self.fc2 = nn.Dense(cfg.dim, dtype=cfg.dtype, name="Dense_1")
+
+    def hidden(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, D] context vector (pre-head). Exposed via
+        ``apply(..., method="hidden")`` so the pallas head can compute the
+        logsumexp without materializing the [B, V] logits."""
+        cfg = self.config
+        emb = self.tok_embed(tokens)
         mask = (tokens != PAD_ID).astype(cfg.dtype)[..., None]
         pooled = (emb * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
-        h = nn.Dense(cfg.hidden, dtype=cfg.dtype)(pooled)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.dim, dtype=cfg.dtype)(h)
-        return embed.attend(h.astype(jnp.float32))  # weight-tied output head
+        return self.fc2(nn.gelu(self.fc1(pooled)))
+
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """[B, S] int32 → [B, V] fp32 logits (context token distribution)."""
+        return self.tok_embed.attend(self.hidden(tokens).astype(jnp.float32))
+
+
+def _masked_mean_nll(tok_lp: jax.Array, tokens: jax.Array) -> jax.Array:
+    """[B, S] per-token log-probs → [B] mean NLL over non-PAD positions.
+    The single home for the reduction both head implementations share —
+    the parity tests and threshold calibration assume they stay locked."""
+    mask = (tokens != PAD_ID).astype(jnp.float32)
+    return -(tok_lp * mask).sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
 
 
 def bag_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
@@ -55,8 +77,7 @@ def bag_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     distribution → [B] fp32."""
     logprobs = jax.nn.log_softmax(logits, axis=-1)           # [B, V]
     tok_lp = jnp.take_along_axis(logprobs, tokens, axis=-1)  # [B, S]
-    mask = (tokens != PAD_ID).astype(jnp.float32)
-    return -(tok_lp * mask).sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+    return _masked_mean_nll(tok_lp, tokens)
 
 
 class MLPScorer(ScorerBase):
@@ -72,17 +93,40 @@ class MLPScorer(ScorerBase):
     def _build_model(self) -> EmbedMLPModel:
         return EmbedMLPModel(self.config)
 
+    def _pallas_token_logprobs(self, params, tokens: jax.Array) -> jax.Array:
+        """[B, S] per-token log-probs via the fused head: lse from the
+        online kernel (no [B, V] logits in HBM), target logits from direct
+        h·emb[token] dots; bf16 multiplies with fp32 accumulation, like
+        the sequence heads."""
+        dtype = self.config.dtype
+        h = self.model.apply(params, tokens, method="hidden").astype(dtype)
+        emb = params["params"]["tok_embed"]["embedding"].astype(dtype)
+        lse = self._pallas_lse_rows(h, emb)                     # [B]
+        tgt = jnp.einsum("bsd,bd->bs", emb[tokens], h,
+                         preferred_element_type=jnp.float32)
+        return tgt - lse[:, None]
+
+    def _use_pallas_head(self) -> bool:
+        return getattr(self.config, "head_impl", "auto") == "pallas"
+
     def _score_impl(self, params, tokens: jax.Array) -> jax.Array:
         # tokens may arrive as uint16 (the half-width wire format the
         # detector uploads to cut host→device bandwidth); compute in int32
         tokens = tokens.astype(jnp.int32)
+        if self._use_pallas_head():
+            return _masked_mean_nll(
+                self._pallas_token_logprobs(params, tokens), tokens)
         return bag_nll(self.model.apply(params, tokens), tokens)
 
     def _token_nlls_impl(self, params, tokens: jax.Array) -> jax.Array:
         """[B, S] per-position NLL under the bag context distribution."""
         tokens = tokens.astype(jnp.int32)
-        logprobs = jax.nn.log_softmax(self.model.apply(params, tokens), axis=-1)
-        tok_lp = jnp.take_along_axis(logprobs, tokens, axis=-1)  # [B, S]
+        if self._use_pallas_head():
+            tok_lp = self._pallas_token_logprobs(params, tokens)
+        else:
+            logprobs = jax.nn.log_softmax(
+                self.model.apply(params, tokens), axis=-1)
+            tok_lp = jnp.take_along_axis(logprobs, tokens, axis=-1)  # [B, S]
         return -tok_lp * (tokens != PAD_ID).astype(jnp.float32)
 
     def _normscore_impl(self, params, tokens: jax.Array,

@@ -578,3 +578,32 @@ class TestProcessFrames:
         frame = pack_batch([b"", normal_msgs(1)[0], b""])
         outs, n, _ = det.process_frames([frame])
         assert n == 1                           # empties silently dropped
+
+
+class TestLongSequenceConfig:
+    """Long-context configs (SURVEY §5.7) through the FULL detector
+    contract — multi-line log windows tokenized to hundreds of positions.
+    The op-level kernels are covered in test_flash/test_parallel; this
+    pins the detector plumbing (tokenizer seq_len, chunked NLL, bucketing,
+    calibration) at a sequence length far past the flagship 32."""
+
+    def test_logbert_seq256_train_detect(self):
+        det = JaxScorerDetector(config=scorer_config(
+            model="logbert", depth=1, heads=2, dim=32, seq_len=256,
+            vocab_size=2048, data_use_training=16, max_batch=16,
+            train_epochs=1, min_train_steps=10, async_fit=False,
+            threshold_sigma=4.0))
+        # long synthetic lines: many variables -> many tokens per line
+        def long_msg(tag, i):
+            return msg("proc <*> " + "arg <*> " * 40,
+                       [f"{tag}{i % 3}"] + [f"v{j % 7}" for j in range(40)],
+                       log_id=f"{tag}{i}")
+        det.process_batch([long_msg("n", i) for i in range(16)])
+        det.flush_final()
+        assert det._fitted
+        weird = msg("segfault <*> " + "exploit <*> " * 40,
+                    ["0xdead"] + [f"x{j}" for j in range(40)], log_id="evil")
+        out = det.process_batch([long_msg("n", 99), weird]) + det.flush()
+        alerts = [o for o in out if o is not None]
+        ids = {i for a in alerts for i in DetectorSchema.from_bytes(a).logIDs}
+        assert "evil" in ids

@@ -84,6 +84,45 @@ class TestHeadImplRoute:
             }}})
 
 
+class TestMlpHeadPallasRoute:
+    def test_mlp_pallas_head_matches_attend_head(self):
+        """head_impl=pallas on the flagship mlp: fused lse + direct target
+        dots must track the attend+log_softmax formulation (bf16 head vs
+        fp32 attend → loose-but-bounded drift; fit/detect share the path
+        so threshold units stay consistent)."""
+        from detectmateservice_tpu.models.mlp import MLPScorer, MLPScorerConfig
+
+        from detectmateservice_tpu.models.tokenizer import PAD_ID
+
+        rng = np.random.default_rng(7)
+        toks = rng.integers(1, 4000, (64, 16)).astype(np.int32)
+        # ragged batch: half the rows end in PAD runs of varying length —
+        # the masked-mean divisor and PAD zeroing must match across heads
+        for i in range(0, 64, 2):
+            toks[i, 16 - (i % 8 + 1):] = PAD_ID
+        toks = jnp.asarray(toks)
+        base = dict(vocab_size=4096, dim=32, seq_len=16)
+        s_e = MLPScorer(MLPScorerConfig(**base))
+        s_p = MLPScorer(MLPScorerConfig(**base, head_impl="pallas"))
+        params, _ = s_e.init(jax.random.PRNGKey(0))
+        # the setup() refactor must keep the original compact param layout
+        # (checkpoint tree version 1 compatibility)
+        assert sorted(params["params"].keys()) == [
+            "Dense_0", "Dense_1", "tok_embed"]
+        a = np.asarray(s_e.score(params, toks))
+        b = np.asarray(s_p.score(params, toks))
+        assert np.abs(a - b).max() < 0.05
+        # the positional path (score_norm: position / normscore) routes
+        # through the kernel too — per-token NLLs must agree incl. PAD zeros
+        ne = np.asarray(s_e._token_nlls(params, toks))
+        npl = np.asarray(s_p._token_nlls(params, toks))
+        # per-token drift (bf16 head vs fp32 attend) is noisier than the
+        # masked mean; thresholds live at sigma scale (~1.0), so 0.1 is
+        # still an order of magnitude under anything calibration can see
+        assert np.abs(ne - npl).max() < 0.1
+        assert (npl[np.asarray(toks) == PAD_ID] == 0).all()
+
+
 class TestExactHeadPallasRoute:
     def test_exact_path_pallas_matches_einsum(self):
         """head_impl=pallas on the EXACT (score_vocab=0) path: fused lse +
