@@ -292,9 +292,24 @@ class JaxScorerDetector(CoreDetector):
         if cfg.host_score_max_batch > 0 and self._host_scoring_possible():
             try:
                 self._cpu_device = jax.devices("cpu")[0]
-                self._host_score = jax.jit(self._scorer._score_impl,
+                # the twin shares PARAMS with the device scorer but not the
+                # head implementation: head_impl=pallas on the host would
+                # run the kernel in interpret mode per lone message —
+                # exactly the latency path the twin exists to make fast —
+                # so the twin always scores through the einsum formulation
+                host_scorer = self._scorer
+                if cfg.head_impl == "pallas":
+                    import dataclasses as _dc
+
+                    host_scorer = type(self._scorer)(
+                        _dc.replace(host_scorer.config, head_impl="einsum"))
+                # the twin must share the candidate subset too: a restored
+                # checkpoint may install persisted ids on self._scorer that
+                # differ from this numpy's regenerated stream
+                self._host_twin_scorer = host_scorer
+                self._host_score = jax.jit(host_scorer._score_impl,
                                            device=self._cpu_device)
-                self._host_normscore = jax.jit(self._scorer._normscore_impl,
+                self._host_normscore = jax.jit(host_scorer._normscore_impl,
                                                device=self._cpu_device)
             except Exception:
                 self._cpu_device = None  # no CPU backend: accelerator-only
@@ -1083,8 +1098,11 @@ class JaxScorerDetector(CoreDetector):
             # reuse the checkpointed subset verbatim — regenerating from the
             # seed under a different numpy could shift the approximation and
             # decalibrate the restored threshold
-            self._scorer._cand_cache = (tuple(cand_key),
-                                        np.asarray(cand_ids, np.int32))
+            cache = (tuple(cand_key), np.asarray(cand_ids, np.int32))
+            self._scorer._cand_cache = cache
+            twin = getattr(self, "_host_twin_scorer", None)
+            if twin is not None and twin is not self._scorer:
+                twin._cand_cache = cache
         stats = meta.get("calib_stats")
         self._calib_stats = None if stats is None else (float(stats[0]),
                                                         float(stats[1]))

@@ -123,6 +123,44 @@ class TestMlpHeadPallasRoute:
         assert (npl[np.asarray(toks) == PAD_ID] == 0).all()
 
 
+class TestHostTwinStaysEinsum:
+    def test_host_twin_not_bound_to_pallas_head(self):
+        """The sparse-traffic host twin must score through the einsum
+        formulation even when the device head is pallas — interpret-mode
+        kernels per lone message would destroy the <10 ms p50 contract."""
+        import time
+
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+        from detectmateservice_tpu.schemas import ParserSchema
+
+        def msg(i, template="user <*> ok from <*>"):
+            return ParserSchema(
+                EventID=1, template=template,
+                variables=[f"u{i % 4}", f"10.0.0.{i % 8}"], logID=str(i),
+                logFormatVariables={}).serialize()
+
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+            "data_use_training": 32, "train_epochs": 1, "min_train_steps": 20,
+            "seq_len": 16, "dim": 32, "max_batch": 32, "async_fit": False,
+            "vocab_size": 2048, "threshold_sigma": 4.0,
+            "head_impl": "pallas",
+        }}})
+        det.setup_io()
+        det.process_batch([msg(i) for i in range(32)])
+        det.flush_final()
+        assert det._cpu_device is not None
+        det.process_batch([msg(90)])
+        det.flush()  # warm the host-twin compile
+        t0 = time.perf_counter()
+        det.process_batch([msg(91)])
+        det.flush()
+        ms = (time.perf_counter() - t0) * 1000
+        assert ms < 200, (
+            f"lone-message host path took {ms:.0f} ms — the twin is likely "
+            "running the interpret-mode pallas kernel")
+
+
 class TestExactHeadPallasRoute:
     def test_exact_path_pallas_matches_einsum(self):
         """head_impl=pallas on the EXACT (score_vocab=0) path: fused lse +
