@@ -327,8 +327,16 @@ def main() -> None:
 
     tpu_probe.wait()
     diags.append(tpu_probe.diag)
-    tpu_ok = (tpu_probe.payload is not None
-              and tpu_probe.payload.get("platform") != "cpu")
+    probe_result = tpu_probe.payload
+    if (probe_result is None
+            and tpu_probe.diag.get("outcome") != "timeout"
+            and left() > PROBE_TIMEOUT_S + RUN_TIMEOUT_S):
+        # a CRASHED probe (rc != 0) may be a transient tunnel flake worth
+        # one retry; a TIMED-OUT probe means the backend is wedged and a
+        # retry would just burn the budget the CPU fallback needs
+        probe_result = run_stage("probe", PROBE_TIMEOUT_S)
+    tpu_ok = (probe_result is not None
+              and probe_result.get("platform") != "cpu")
 
     best: dict | None = None
     if tpu_ok:
