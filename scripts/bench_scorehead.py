@@ -7,7 +7,36 @@ hidden state against C candidate embeddings. The XLA path materializes the
 on a chip the delta is HBM traffic, so run this ON TPU to decide whether
 ``head_impl: pallas`` should become the auto route.
 
-Usage: python scripts/bench_scorehead.py [repeats]
+Measurement protocol — two tunnel artifacts shape it (both reproduced on
+the live chip this round):
+
+* a single ``block_until_ready`` costs ~67 ms — more than either head
+  variant's device time at every shipped shape — so timing one call per
+  sync measures the tunnel, not the kernel (observed: four shapes
+  spanning 500× in FLOPs all "took" 70–77 ms);
+* worse, when a jitted result is never actually FETCHED to the host,
+  this tunneled runtime can elide the execution entirely:
+  ``f(h, e).block_until_ready()`` in a loop returned in ~5 µs/call while
+  the same program took ~260 ms/call once ``float(out)`` demanded the
+  value. ``block_until_ready`` alone is NOT evidence of execution here.
+
+The harness therefore (a) chains CHAIN data-dependent evaluations inside
+one jit (the k-th call consumes a perturbation derived from the (k-1)-th
+result, so XLA cannot CSE or reorder them), (b) fetches the chained
+scalar with ``float()`` inside the timed region, and (c) reports the
+SLOPE between a short and a long chain — per-op time with the fetch
+floor cancelled: ``(T(chain) - T(4)) / (chain - 4)``.
+
+On-chip results (v5e, 2026-07-31, this harness): candidate shape
+N=512k, C=2048, D=256 → XLA 6.7 ms vs pallas 12.1 ms per op — the XLA
+einsum+bf16-lse route WINS on the candidate head (its bf16 exp runs at
+twice the kernel's fp32 lane width and the [N, C] logits tile at C=2048
+stays cheap for XLA's own fusion), so ``head_impl: auto`` keeps einsum
+there. The kernel remains the memory-safety route for the EXACT head
+(it deletes the [rows, V] chunk materialization; einsum/pallas measured
+within ~10% of each other at that shape).
+
+Usage: python scripts/bench_scorehead.py [chain]
        DETECTMATE_BENCH_PLATFORM=cpu python scripts/bench_scorehead.py  # CPU smoke
 """
 from __future__ import annotations
@@ -21,8 +50,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_SHORT_CHAIN = 4
+
+
 def main() -> None:
-    repeats = int(sys.argv[1]) if len(sys.argv) > 1 else 15
+    chain = int(sys.argv[1]) if len(sys.argv) > 1 else 36
+    if chain <= _SHORT_CHAIN:
+        sys.exit(f"chain must exceed {_SHORT_CHAIN} (the short-chain "
+                 f"baseline the slope subtracts); got {chain}")
     import jax
 
     import bench as B
@@ -69,29 +104,58 @@ def main() -> None:
             preferred_element_type=jnp.float32)
         return jax.nn.logsumexp(logits, axis=-1)
 
+    def chained(single, k):
+        """k data-dependent evals of ``single`` in one jitted program:
+        each iteration perturbs h by a scalar derived from the previous
+        result, so the compiler must run all k matmul+lse passes."""
+        def run(h, e):
+            def body(_, carry):
+                eps, acc = carry
+                out = single(h + eps, e)
+                # tiny, value-dependent perturbation: keeps the numerics
+                # intact (|eps| ~ 1e-6) while defeating CSE
+                return ((jnp.mean(out) * 1e-9).astype(jnp.bfloat16),
+                        acc + out[0])
+            return jax.lax.fori_loop(
+                0, k, body, (jnp.bfloat16(0.0), jnp.float32(0.0)))[1]
+        return jax.jit(run)
+
+    def timed_ms(fn, h, e, repeats: int = 5) -> float:
+        """Median wall ms with the value FETCHED inside the timed region
+        (block_until_ready alone may not execute on this backend)."""
+        float(fn(h, e))  # compile + first fetch
+        ts = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(fn(h, e))
+            ts.append((time.perf_counter() - t0) * 1000)
+        return statistics.median(ts)
+
+    short = _SHORT_CHAIN
+
+    def pal_single(h, e):
+        return candidate_lse(h, e, interpret=not on_tpu)
+
     for label, n, c, d, baseline in shapes:
         h = jnp.asarray(rng.normal(size=(n, d)), jnp.bfloat16)
         e = jnp.asarray(rng.normal(size=(c, d)), jnp.bfloat16)
-        f_x = jax.jit(xla_lse_exact if baseline == "exact"
-                      else xla_lse_candidate)
-        f_p = jax.jit(lambda h, e: candidate_lse(h, e, interpret=not on_tpu))
+        # ONE definition per path, shared by parity check and timing — the
+        # two must measure the same program
+        xla_single = xla_lse_exact if baseline == "exact" else xla_lse_candidate
         # parity first — a fast wrong kernel is worthless. The XLA side
         # exps in bf16, the kernel in fp32, so ~0.15 of drift is the two
         # approximations disagreeing; past 0.3 the kernel is WRONG and the
         # speedup must not be reported as actionable.
-        err = float(jnp.max(jnp.abs(f_x(h, e) - f_p(h, e))))
+        err = float(jnp.max(jnp.abs(jax.jit(xla_single)(h, e)
+                                    - jax.jit(pal_single)(h, e))))
         parity_ok = err < 0.3
-        out = {"shape": label, "n": n, "c": c, "d": d,
+        out = {"shape": label, "n": n, "c": c, "d": d, "chain": chain,
                "platform": platform, "max_abs_err": round(err, 5),
                "parity": "ok" if parity_ok else "FAIL"}
-        for name, fn in (("xla_ms", f_x), ("pallas_ms", f_p)):
-            fn(h, e).block_until_ready()  # compile
-            ts = []
-            for _ in range(repeats):
-                t0 = time.perf_counter()
-                fn(h, e).block_until_ready()
-                ts.append((time.perf_counter() - t0) * 1000)
-            out[name] = round(statistics.median(ts), 3)
+        for name, single in (("xla_ms", xla_single), ("pallas_ms", pal_single)):
+            t_short = timed_ms(chained(single, short), h, e)
+            t_long = timed_ms(chained(single, chain), h, e)
+            out[name] = round((t_long - t_short) / (chain - short), 3)
         if parity_ok:
             out["speedup"] = round(out["xla_ms"] / max(out["pallas_ms"], 1e-9), 2)
         print(json.dumps(out), flush=True)
