@@ -268,8 +268,11 @@ class Service:
         """Blocking main: admin server up, engine (auto)started, park until
         shutdown (reference: core.py:213-237)."""
         self.web_server.start()
+        # web_server.port, not settings.http_port: with an ephemeral port
+        # request (http_port: 0) the log must name the port that actually
+        # bound, or the operator has no way to find the admin plane
         self.logger.info(
-            "HTTP Admin active at %s:%s", self.settings.http_host, self.settings.http_port
+            "HTTP Admin active at %s:%s", self.settings.http_host, self.web_server.port
         )
         if self.settings.engine_autostart:
             self.logger.info("Auto-starting engine...")
