@@ -8,6 +8,7 @@ from .socket import (
     TransportTimeout,
     ZmqPairSocketFactory,
     NngTcpSocketFactory,
+    NngTlsTcpSocketFactory,
     InprocQueueSocketFactory,
     make_socket_factory,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "TransportTimeout",
     "ZmqPairSocketFactory",
     "NngTcpSocketFactory",
+    "NngTlsTcpSocketFactory",
     "InprocQueueSocketFactory",
     "make_socket_factory",
 ]

@@ -239,6 +239,10 @@ class NativePairSocketFactory:
             from .socket import NngTcpSocketFactory
 
             return NngTcpSocketFactory()
+        if scheme == "nng+tls+tcp":
+            from .socket import NngTlsTcpSocketFactory
+
+            return NngTlsTcpSocketFactory()
         if scheme in ("ws", "inproc"):
             from .socket import ZmqPairSocketFactory
 

@@ -232,6 +232,8 @@ class ZmqPairSocketFactory:
             return factory.create(addr, logger, tls_config)
         if scheme == "nng+tcp":
             return NngTcpSocketFactory().create(addr, logger, tls_config)
+        if scheme == "nng+tls+tcp":
+            return NngTlsTcpSocketFactory().create(addr, logger, tls_config)
         if scheme == "ws":
             # the Python RFC6455 transport, NOT libzmq's ws (a compile-time
             # option this image's libzmq lacks) — and wire-compatible with
@@ -276,6 +278,9 @@ class ZmqPairSocketFactory:
         if scheme == "nng+tcp":
             return NngTcpSocketFactory().create_output(addr, logger, tls_config,
                                                        dial_timeout, buffer_size)
+        if scheme == "nng+tls+tcp":
+            return NngTlsTcpSocketFactory().create_output(addr, logger, tls_config,
+                                                          dial_timeout, buffer_size)
         if scheme == "ws":
             return WsSocketFactory().create_output(addr, logger, tls_config,
                                                    dial_timeout, buffer_size)
@@ -678,10 +683,56 @@ def _host_port(rest: str, addr: str) -> tuple:
         raise TransportError(f"bad port in {addr!r}") from exc
 
 
+# Shared TLS plumbing for the two TLS-bearing schemes (tls+tcp and
+# nng+tls+tcp). Contexts are fully configured — and their material errors
+# raised — BEFORE the listener binds / the dialer connects, the ordering the
+# reference pins (reference: tests/test_tls_transport.py:156-188). One home
+# for TLS policy, so hardening (min version, ciphers, client certs) cannot
+# drift between the schemes.
+
+def _server_ssl_ctx(tls_config: Optional[object], addr: str,
+                    scheme: str) -> ssl.SSLContext:
+    if tls_config is None or not getattr(tls_config, "cert_key_file", None):
+        raise TransportError(
+            f"{scheme} listener {addr!r} requires tls_input.cert_key_file")
+    ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    try:
+        ssl_ctx.load_cert_chain(tls_config.cert_key_file)
+    except (OSError, ssl.SSLError) as exc:
+        raise TransportError(
+            f"cannot load TLS cert/key {tls_config.cert_key_file}: {exc}") from exc
+    return ssl_ctx
+
+
+def _client_ssl_ctx(tls_config: Optional[object], addr: str, scheme: str,
+                    host: str) -> tuple:
+    if tls_config is None or not getattr(tls_config, "ca_file", None):
+        raise TransportError(f"{scheme} output {addr!r} requires tls_output.ca_file")
+    ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    try:
+        ssl_ctx.load_verify_locations(tls_config.ca_file)
+    except (OSError, ssl.SSLError) as exc:
+        raise TransportError(f"cannot load TLS CA {tls_config.ca_file}: {exc}") from exc
+    return ssl_ctx, getattr(tls_config, "server_name", None) or host
+
+
+def _tls_server_wrap(ssl_ctx: ssl.SSLContext,
+                     raw: _stdsocket.socket) -> ssl.SSLSocket:
+    """Server-side TLS handshake with a bounded deadline. The accepted socket
+    arrives blocking with NO timeout, and ``wrap_socket`` blocks in
+    ``do_handshake`` waiting for a ClientHello — a peer that connects and
+    sends nothing (port scanner, half-open connection) would wedge the single
+    accept loop forever, a silent DoS on every later dialer. Same guard
+    ``_sp_prepare`` applies to the SP header read; the accept loop sets the
+    steady-state timeout right after ``prepare`` returns."""
+    raw.settimeout(5.0)
+    return ssl_ctx.wrap_socket(raw, server_side=True)
+
+
 class TlsTcpSocketFactory:
-    """tls+tcp:// factory. The TLS context is fully configured *before* the
-    listener binds / the dialer connects — the ordering the reference pins
-    (reference: tests/test_tls_transport.py:156-188)."""
+    """tls+tcp:// factory: real ssl around the framework's 4-byte
+    length-prefixed framing (for NNG-wire TLS interop see
+    NngTlsTcpSocketFactory)."""
 
     def create(self, addr: str, logger: Optional[logging.Logger] = None,
                tls_config: Optional[object] = None) -> EngineSocket:
@@ -689,17 +740,11 @@ class TlsTcpSocketFactory:
         scheme, rest = _split_scheme(addr)
         if scheme != "tls+tcp":
             raise TransportError(f"TlsTcpSocketFactory cannot handle scheme {scheme!r}")
-        if tls_config is None or not getattr(tls_config, "cert_key_file", None):
-            raise TransportError(f"tls+tcp listener {addr!r} requires tls_input.cert_key_file")
         host, port = _host_port(rest, addr)
-        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        try:
-            ssl_ctx.load_cert_chain(tls_config.cert_key_file)
-        except (OSError, ssl.SSLError) as exc:
-            raise TransportError(f"cannot load TLS cert/key {tls_config.cert_key_file}: {exc}") from exc
+        ssl_ctx = _server_ssl_ctx(tls_config, addr, "tls+tcp")
 
         def prepare(raw: _stdsocket.socket, server_side: bool) -> _FramedConn:
-            return _FramedConn(ssl_ctx.wrap_socket(raw, server_side=True))
+            return _FramedConn(_tls_server_wrap(ssl_ctx, raw))
 
         return FramedTcpListener(host, port, prepare, logger, label="tls+tcp")
 
@@ -711,15 +756,8 @@ class TlsTcpSocketFactory:
         scheme, rest = _split_scheme(addr)
         if scheme != "tls+tcp":
             raise TransportError(f"TlsTcpSocketFactory cannot handle scheme {scheme!r}")
-        if tls_config is None or not getattr(tls_config, "ca_file", None):
-            raise TransportError(f"tls+tcp output {addr!r} requires tls_output.ca_file")
         host, port = _host_port(rest, addr)
-        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-        try:
-            ssl_ctx.load_verify_locations(tls_config.ca_file)
-        except (OSError, ssl.SSLError) as exc:
-            raise TransportError(f"cannot load TLS CA {tls_config.ca_file}: {exc}") from exc
-        server_name = getattr(tls_config, "server_name", None) or host
+        ssl_ctx, server_name = _client_ssl_ctx(tls_config, addr, "tls+tcp", host)
 
         def prepare(raw: _stdsocket.socket, server_side: bool) -> _FramedConn:
             return _FramedConn(ssl_ctx.wrap_socket(raw, server_hostname=server_name))
@@ -1052,6 +1090,58 @@ class WsSocketFactory:
 
         return FramedTcpDialer(host, port, prepare, logger, dial_timeout,
                                buffer_size, label="ws")
+
+
+class NngTlsTcpSocketFactory:
+    """nng+tls+tcp:// factory: SP Pair0 wire protocol INSIDE a real TLS
+    stream — byte-compatible with NNG's ``tls+tcp`` transport (mbedTLS under
+    libnng), which is how the reference's encrypted deployments speak on the
+    wire (reference: src/service/features/engine_socket.py:60-71 server-side
+    TLSConfig applied before listen; engine.py:165-170 client CA config).
+    NNG's TLS transport completes the TLS handshake first and then runs the
+    same 8-byte SP header exchange and u64-be length framing inside the
+    session, so composing the ssl wrap with ``_sp_prepare`` reproduces the
+    wire exactly. The plain-``tls+tcp://`` scheme here remains the
+    framework-private 4-byte framing; THIS scheme is the one a genuine
+    NNG/fluentd peer can dial encrypted.
+
+    Ordering contract preserved: the TLS context is fully configured before
+    the listener binds / the dialer connects (reference:
+    tests/test_tls_transport.py:156-188)."""
+
+    def create(self, addr: str, logger: Optional[logging.Logger] = None,
+               tls_config: Optional[object] = None) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "nng+tls+tcp":
+            raise TransportError(f"NngTlsTcpSocketFactory cannot handle scheme {scheme!r}")
+        host, port = _host_port(rest, addr)
+        ssl_ctx = _server_ssl_ctx(tls_config, addr, "nng+tls+tcp")
+
+        def prepare(raw: _stdsocket.socket, server_side: bool) -> _FramedConn:
+            # TLS first, then the SP header exchange inside the session —
+            # NNG's layering (its tls+tcp transport wraps the SP stream)
+            return _sp_prepare(_tls_server_wrap(ssl_ctx, raw), True)
+
+        return FramedTcpListener(host, port, prepare, logger, label="nng+tls+tcp")
+
+    def create_output(self, addr: str, logger: Optional[logging.Logger] = None,
+                      tls_config: Optional[object] = None,
+                      dial_timeout: Optional[int] = None,
+                      buffer_size: int = 100) -> EngineSocket:
+        logger = logger or logging.getLogger(__name__)
+        scheme, rest = _split_scheme(addr)
+        if scheme != "nng+tls+tcp":
+            raise TransportError(f"NngTlsTcpSocketFactory cannot handle scheme {scheme!r}")
+        host, port = _host_port(rest, addr)
+        ssl_ctx, server_name = _client_ssl_ctx(tls_config, addr, "nng+tls+tcp", host)
+
+        def prepare(raw: _stdsocket.socket, server_side: bool) -> _FramedConn:
+            return _sp_prepare(
+                ssl_ctx.wrap_socket(raw, server_hostname=server_name), False)
+
+        return FramedTcpDialer(host, port, prepare, logger, dial_timeout,
+                               buffer_size, label="nng+tls+tcp")
 
 
 class NngTcpSocketFactory:

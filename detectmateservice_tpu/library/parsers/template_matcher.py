@@ -24,6 +24,7 @@ C++ kernel (native/matchkern) when built; the Python path is the fallback.
 """
 from __future__ import annotations
 
+import json
 import re
 import string
 import time
@@ -34,10 +35,14 @@ from typing import Any, List, Optional, Pattern, Tuple
 from pydantic import Field
 
 from ...schemas import LogSchema, ParserSchema, SchemaError
+from ...schemas import schemas_pb2 as _pb
 from ..common.core import CoreComponent, CoreConfig, LibraryError
 
 _TOKEN_RE = re.compile(r"<([A-Za-z_][A-Za-z0-9_]*)>")
 _PUNCT_TABLE = str.maketrans("", "", string.punctuation)
+# explicit-presence LogSchema fields: at least one present <=> the bytes are
+# a genuine envelope, not arbitrary text that happens to parse as protobuf
+_LOGSCHEMA_FIELDS = ("__version__", "logID", "log", "logSource", "hostname")
 
 
 class MatcherParserConfig(CoreConfig):
@@ -49,6 +54,73 @@ class MatcherParserConfig(CoreConfig):
     remove_punctuation: bool = False
     lowercase: bool = False
     path_templates: Optional[str] = None
+    # Ingest-payload flexibility for STOCK-fluentd edges. The reference's
+    # ingest edge wraps each tailed line in a LogSchema protobuf via its
+    # private `fluent-plugin-detectmate` formatter (reference:
+    # container/fluentin/fluent.conf:164-166); that gem is not installable
+    # here, so this build's edge (container/Dockerfile_fluentd) runs stock
+    # formatters, which emit either a JSON record ({"message": line,
+    # "logSource": path, "hostname": host} — `<format> @type json`) or the
+    # bare line (`<format> @type single_value`). When true, payloads that
+    # are not LogSchema protobufs are accepted in those two shapes; when
+    # false (default), non-LogSchema payloads raise — the reference's strict
+    # contract, which the error-taxonomy tests pin.
+    accept_raw_lines: bool = False
+
+
+def decode_ingest_payload(data: bytes, accept_raw: bool):
+    """Resolve one ingest payload to a LogSchema message.
+
+    Payload shapes, tried in order (first match wins):
+
+    1. **LogSchema protobuf** — the reference-grade envelope its
+       `fluent-plugin-detectmate` formatter emits (reference:
+       container/fluentin/fluent.conf:164-166). Accepted iff the bytes parse
+       AND at least one LogSchema field is present — proto3 will "parse"
+       some arbitrary byte strings into all-unknown-fields messages, and
+       those must not be mistaken for envelopes.
+    2. **JSON record** — what stock fluentd's `<format> @type json` emits
+       for the tail source: ``{"message": line, "logSource": path,
+       "hostname": host}`` (+ trailing newline). Mapped onto LogSchema as
+       message→log, logSource→logSource, hostname→hostname — the same field
+       mapping the reference formatter performs.
+    3. **Bare line** — `<format> @type single_value` (+ its default
+       trailing newline): the line alone, no provenance.
+
+    Shapes 2-3 are gated by ``accept_raw``; with it off, a payload that is
+    not a LogSchema protobuf raises SchemaError (the reference's strict
+    contract).
+    """
+    msg = _pb.LogSchema()
+    try:
+        msg.ParseFromString(data)
+        envelope = any(msg.HasField(f) for f in _LOGSCHEMA_FIELDS)
+    except Exception as exc:
+        if not accept_raw:
+            raise SchemaError(f"cannot parse LogSchema: {exc}") from exc
+        envelope = False
+    if envelope or not accept_raw:
+        return msg
+    out = _pb.LogSchema()
+    if data[:1] == b"{":
+        try:
+            rec = json.loads(data)
+        except (ValueError, UnicodeDecodeError):
+            rec = None
+        if isinstance(rec, dict) and ("message" in rec or "log" in rec):
+            out.log = str(rec.get("message", rec.get("log", "")))
+            if rec.get("logID"):
+                out.logID = str(rec["logID"])
+            if rec.get("logSource"):
+                out.logSource = str(rec["logSource"])
+            if rec.get("hostname"):
+                out.hostname = str(rec["hostname"])
+            return out
+    line = data.decode("utf-8", errors="replace")
+    if line.endswith("\n"):          # single_value's add_newline (default on)
+        line = line[:-1]
+    out.log = line
+    return out
 
 
 def compile_log_format(log_format: str) -> Tuple[Pattern, List[str]]:
@@ -199,10 +271,10 @@ class MatcherParser(CoreComponent):
 
     def process(self, data: bytes) -> Optional[bytes]:
         try:
-            input_ = LogSchema.from_bytes(data)
+            msg = decode_ingest_payload(data, self.config.accept_raw_lines)
         except SchemaError as exc:
             raise LibraryError(f"{self.name}: cannot deserialize LogSchema: {exc}") from exc
-        parsed = self.parse_line(input_.get("log") or "", log_id=input_.get("logID") or "")
+        parsed = self.parse_line(msg.log, log_id=msg.logID)
         return parsed.serialize() if parsed is not None else None
 
     def process_batch(self, batch: List[bytes]) -> List[Optional[bytes]]:
@@ -227,11 +299,11 @@ class MatcherParser(CoreComponent):
         # batch (per-call ctypes overhead was ~20 µs/line — the ceiling)
         prepared = []  # (msg, header_vars, content) | None (filtered) | False (error)
         contents: List[str] = []
+        accept_raw = self.config.accept_raw_lines
         for data in batch:
-            msg = _pb.LogSchema()
             try:
-                msg.ParseFromString(data)
-            except Exception:
+                msg = decode_ingest_payload(data, accept_raw)
+            except SchemaError:
                 decode_errors += 1  # surfaced below; containment per message
                 prepared.append(False)
                 continue

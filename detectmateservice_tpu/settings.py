@@ -39,10 +39,13 @@ from pydantic import (
 ENV_PREFIX = "DETECTMATE_"
 ENV_NESTED_DELIMITER = "__"
 
-# nng+tcp is a TPU-build addition beyond the reference scheme set: the NNG
-# SP Pair0 wire protocol over plain TCP, so real NNG/fluentd peers can dial
-# this data plane (engine/socket.py NngTcpSocketFactory).
-SUPPORTED_SCHEMES = ("ipc", "tcp", "tls+tcp", "nng+tcp", "ws", "inproc")
+# nng+tcp / nng+tls+tcp are TPU-build additions beyond the reference scheme
+# set: the NNG SP Pair0 wire protocol over plain TCP (resp. inside a real TLS
+# session — byte-compatible with NNG's mbedTLS ``tls+tcp`` transport), so real
+# NNG/fluentd peers can dial this data plane, encrypted or not
+# (engine/socket.py NngTcpSocketFactory / NngTlsTcpSocketFactory).
+SUPPORTED_SCHEMES = ("ipc", "tcp", "tls+tcp", "nng+tcp", "nng+tls+tcp", "ws",
+                     "inproc")
 
 
 # ws:// historical note: through round 2, ws rode libzmq's WebSocket
@@ -71,7 +74,7 @@ def _validate_addr(addr: str) -> str:
         raise ValueError(f"unsupported scheme {scheme!r} in {addr!r}; expected one of {SUPPORTED_SCHEMES}")
     if not rest:
         raise ValueError(f"address {addr!r} has an empty target")
-    if scheme in ("tcp", "tls+tcp", "nng+tcp", "ws"):
+    if scheme in ("tcp", "tls+tcp", "nng+tcp", "nng+tls+tcp", "ws"):
         host_port = rest.split("/", 1)[0]
         if ":" not in host_port:
             raise ValueError(f"address {addr!r} requires an explicit port")
@@ -205,13 +208,19 @@ class ServiceSettings(BaseModel):
     # -- TLS cross-validation (reference: settings.py:116-132) ------------
     @model_validator(mode="after")
     def _check_tls(self) -> "ServiceSettings":
-        if self.engine_addr.startswith("tls+tcp://") and self.tls_input is None:
-            raise ValueError("engine_addr uses tls+tcp:// but tls_input is not configured")
-        if (any(a.startswith("tls+tcp://") for a in self.engine_ingress_addrs)
+        # both TLS-bearing schemes (framework-private tls+tcp and the
+        # NNG-wire-compatible nng+tls+tcp) need their material up front —
+        # fail at startup, not at first connection
+        tls_schemes = ("tls+tcp://", "nng+tls+tcp://")
+        if self.engine_addr.startswith(tls_schemes) and self.tls_input is None:
+            raise ValueError(
+                f"engine_addr uses {self.engine_addr.split('://')[0]}:// "
+                "but tls_input is not configured")
+        if (any(a.startswith(tls_schemes) for a in self.engine_ingress_addrs)
                 and self.tls_input is None):
-            raise ValueError("an engine_ingress_addr uses tls+tcp:// but tls_input is not configured")
-        if any(a.startswith("tls+tcp://") for a in self.out_addr) and self.tls_output is None:
-            raise ValueError("an out_addr uses tls+tcp:// but tls_output is not configured")
+            raise ValueError("an engine_ingress_addr uses a TLS scheme but tls_input is not configured")
+        if any(a.startswith(tls_schemes) for a in self.out_addr) and self.tls_output is None:
+            raise ValueError("an out_addr uses a TLS scheme but tls_output is not configured")
         return self
 
     # -- loading -----------------------------------------------------------
