@@ -50,19 +50,63 @@ RESULT_MARKER = "@@BENCH_RESULT "
 
 # stage knobs (env-overridable so a constrained run can shrink them)
 PROBE_TIMEOUT_S = int(os.environ.get("DETECTMATE_BENCH_PROBE_TIMEOUT", "120"))
+TINY_N = int(os.environ.get("DETECTMATE_BENCH_TINY_N", "8192"))
 SMOKE_N = int(os.environ.get("DETECTMATE_BENCH_SMOKE_N", "16384"))
 FULL_N = int(os.environ.get("DETECTMATE_BENCH_N", "262144"))
 CPU_FULL_N = int(os.environ.get("DETECTMATE_BENCH_CPU_N", "65536"))
 RUN_TIMEOUT_S = int(os.environ.get("DETECTMATE_BENCH_RUN_TIMEOUT", "480"))
 # whole-bench budget: past this, stop escalating and report the best stage
 DEADLINE_S = int(os.environ.get("DETECTMATE_BENCH_DEADLINE", "1500"))
+# TPU re-probe cadence: a new probe launches this long after the previous
+# probe STARTED, for the whole deadline (a wedged probe burns its own 120 s
+# window, so wedged probes chain ~back-to-back; fast crashes wait it out)
+REPROBE_INTERVAL_S = int(os.environ.get("DETECTMATE_BENCH_REPROBE_INTERVAL", "120"))
+# wall-clock reserved at the end for the parent to print the report
+REPORT_MARGIN_S = 20
+# smallest remaining budget worth launching a TPU run into (compile alone
+# is ~20-40 s), and the budget above which the first run uses the full
+# smoke size instead of the tiny late-recovery size
+TPU_MIN_RUN_BUDGET_S = 45
+TPU_COMFORT_BUDGET_S = 300
+# give up on the chip only after this many failed TPU RUN children (probe
+# failures never count: re-probing is the whole point)
+MAX_TPU_RUN_FAILURES = 4
 # env var read by child processes; "cpu" => jax.config.update before any op
 PLATFORM_ENV_VAR = "DETECTMATE_BENCH_PLATFORM"
+
+# CPU-fallback regression net (r4 weak #5: a wedged-tunnel round's CPU number
+# could not distinguish "environment got small" from "code got slow"). Floor
+# methodology follows tests/test_perf.py: a RATE floor pinned far below any
+# healthy measurement, immune to box-size variance by normalizing per core.
+# Measured reference points: r4's wedged-round fallback was 943 lines/s on a
+# 1-core judge box (float32, XLA:CPU); this build's dev box does ~the same
+# per core. Floor = 4x headroom under that.
+CPU_FLOOR_LINES_PER_S_PER_CORE = 230.0
 
 
 # ----------------------------------------------------------------------
 # child stages (these import jax / the framework)
 # ----------------------------------------------------------------------
+
+# Canonical bench scorer configuration — ONE home. scripts/bench_overlap.py
+# and scripts/bench_service.py derive from it, so an A/B or service-path run
+# always measures the configuration the headline bench runs.
+BENCH_SCORER_CONFIG = {
+    "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+    "data_use_training": 2048, "train_epochs": 2, "async_fit": False,
+    "seq_len": 32, "dim": 128, "max_batch": 16384, "pipeline_depth": 8,
+    "threshold_sigma": 6.0,
+}
+
+
+def build_bench_detector(workers: int = 0, dtype: str = "auto"):
+    """Construct the headline-bench detector (the one knob pair that varies
+    per platform: compute dtype and dispatch-overlap workers)."""
+    from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+    cfg = dict(BENCH_SCORER_CONFIG, dtype=dtype, upload_workers=workers)
+    return JaxScorerDetector(config={"detectors": {"JaxScorerDetector": cfg}})
+
 
 def make_messages(n: int, anomaly_rate: float = 0.01, seed: int = 0):
     import numpy as np
@@ -113,18 +157,18 @@ def child_run(n_bench: int) -> None:
     """Measure detector throughput + single-message p50 for n_bench messages."""
     import numpy as np
 
-    from detectmateservice_tpu.library.detectors import JaxScorerDetector
-
-    n_train, batch = 2048, 16384
+    n_train = BENCH_SCORER_CONFIG["data_use_training"]
+    batch = BENCH_SCORER_CONFIG["max_batch"]
     # CPU-pinned fallback runs score in float32: XLA:CPU emulates bfloat16
-    # in software (~30% slower, measured); on TPU bf16 is the MXU format
-    dtype = "float32" if os.environ.get(PLATFORM_ENV_VAR) == "cpu" else "auto"
-    det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
-        "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
-        "data_use_training": n_train, "train_epochs": 2, "async_fit": False,
-        "seq_len": 32, "dim": 128, "max_batch": batch, "pipeline_depth": 8,
-        "threshold_sigma": 6.0, "dtype": dtype,
-    }}})
+    # in software (~30% slower, measured); on TPU bf16 is the MXU format.
+    # upload_workers overlaps device upload/dispatch with featurize on the
+    # accelerator path (the tunnel's ~4.5 ms/call + ~15 ms/batch floors
+    # otherwise serialize with the engine thread); inline on CPU, where
+    # dispatch is ~free and the worker measured ~parity
+    # (scripts/bench_overlap.py).
+    cpu_pinned = os.environ.get(PLATFORM_ENV_VAR) == "cpu"
+    det = build_bench_detector(workers=0 if cpu_pinned else 1,
+                               dtype="float32" if cpu_pinned else "auto")
     det.setup_io()
     import jax
 
@@ -176,14 +220,17 @@ def child_run(n_bench: int) -> None:
         lat.append(time.perf_counter() - t)
     p50_ms = float(np.median(lat) * 1000.0)
 
-    _child_exit({
+    payload = {
         "lines_per_s": round(lines_per_s, 1),
         "p50_ms": round(p50_ms, 4),
         "alerts": alerts,
         "n": n_bench,
         "elapsed_s": round(elapsed, 3),
         "platform": platform,
-    })
+    }
+    if platform == "cpu":
+        payload["cpu_cores"] = os.cpu_count() or 1
+    _child_exit(payload)
 
 
 # ----------------------------------------------------------------------
@@ -303,6 +350,24 @@ class _Child:
 
 
 def main() -> None:
+    """Acquisition event loop (r4 weak #1 redesign).
+
+    The old flow probed TPU ONCE: a single 120 s timeout committed the whole
+    remaining ~22 min to the CPU fallback, and two consecutive rounds ended
+    with no driver-verified on-chip number while the code was demonstrably
+    capable of one — the tunnel wedges are often transient. Now the parent
+    runs a poll loop for the full deadline:
+
+    * CPU insurance starts immediately and escalates exactly as before —
+      its result is never blocked on TPU fate;
+    * the TPU side keeps ONE child in flight at all times: probe → (on
+      success) run → (on failure) back to probe, re-launching probes on a
+      ~REPROBE_INTERVAL_S cadence until the deadline. A tunnel that comes
+      back at minute 20 still yields an on-chip number: the first run after
+      a late probe is sized to the remaining budget (TINY_N when short);
+    * at report time ANY TPU result — however small its N — beats the CPU
+      fallback; among TPU results the largest-N run wins.
+    """
     t_start = time.monotonic()
 
     def left() -> float:
@@ -310,74 +375,128 @@ def main() -> None:
 
     diags: list = []
 
-    def run_stage(stage: str, timeout_s: float, platform: str | None = None,
-                  arg: str = "") -> dict | None:
-        child = _Child(stage, min(timeout_s, max(left(), 30)),
-                       platform=platform, arg=arg)
-        res = child.wait()
+    def harvest(child: "_Child") -> dict | None:
         diags.append(child.diag)
-        return res
+        return child.payload
 
-    # 1. probe TPU and CPU concurrently, and start a CPU insurance smoke run
-    #    right away — a dead tunnel then costs one probe window, not a serial
-    #    retry ladder, and the CPU number is already cooking while we wait.
-    tpu_probe = _Child("probe", PROBE_TIMEOUT_S)
-    cpu_probe = _Child("probe", PROBE_TIMEOUT_S, platform="cpu")
-    cpu_smoke = _Child("run", RUN_TIMEOUT_S, platform="cpu", arg=str(SMOKE_N))
+    # ---- CPU insurance plane (starts cooking immediately) ----------------
+    cpu_probe: _Child | None = _Child("probe", PROBE_TIMEOUT_S, platform="cpu")
+    cpu_smoke: _Child | None = _Child("run", RUN_TIMEOUT_S, platform="cpu",
+                                      arg=str(SMOKE_N))
+    cpu_run: _Child | None = None        # escalation (retry or CPU_FULL_N)
+    cpu_escalated = False
+    cpu_retried = False
+    cpu_result: dict | None = None
 
-    tpu_probe.wait()
-    diags.append(tpu_probe.diag)
-    probe_result = tpu_probe.payload
-    if (probe_result is None
-            and tpu_probe.diag.get("outcome") != "timeout"
-            and left() > PROBE_TIMEOUT_S + RUN_TIMEOUT_S):
-        # a CRASHED probe (rc != 0) may be a transient tunnel flake worth
-        # one retry; a TIMED-OUT probe means the backend is wedged and a
-        # retry would just burn the budget the CPU fallback needs
-        probe_result = run_stage("probe", PROBE_TIMEOUT_S)
-    tpu_ok = (probe_result is not None
-              and probe_result.get("platform") != "cpu")
+    # ---- TPU acquisition plane ------------------------------------------
+    tpu_probe: _Child | None = _Child("probe", PROBE_TIMEOUT_S)
+    last_probe_start = time.monotonic()
+    tpu_run: _Child | None = None
+    tpu_result: dict | None = None       # largest-N successful TPU run
+    tpu_run_failures = 0
 
-    best: dict | None = None
-    if tpu_ok:
-        # 2a. TPU path: smoke then full; insurance run keeps cooking in the
-        #     background until a TPU number lands (a flaky chip can pass the
-        #     probe and wedge in the run stage).
-        for n in (SMOKE_N, FULL_N):
-            if best is not None and left() < RUN_TIMEOUT_S / 2:
-                break  # keep the smoke number; deadline too close for full
-            res = run_stage("run", RUN_TIMEOUT_S, arg=str(n))
+    def launch_tpu_run() -> "_Child | None":
+        """Pick the next TPU run size for the remaining budget, or None."""
+        budget = left() - REPORT_MARGIN_S
+        if budget < TPU_MIN_RUN_BUDGET_S or tpu_run_failures >= MAX_TPU_RUN_FAILURES:
+            return None
+        if tpu_result is None:
+            # first number: full smoke when the budget is comfortable, the
+            # tiny size when a late-recovering tunnel leaves a short window
+            n = SMOKE_N if budget > TPU_COMFORT_BUDGET_S else TINY_N
+        elif tpu_result.get("n", 0) >= FULL_N or budget < RUN_TIMEOUT_S:
+            return None                  # nothing bigger worth running
+        else:
+            n = FULL_N
+        return _Child("run", min(RUN_TIMEOUT_S, budget), arg=str(n))
+
+    while left() > REPORT_MARGIN_S:
+        # -- CPU plane
+        if cpu_probe is not None and cpu_probe.poll():
+            harvest(cpu_probe)
+            cpu_probe = None
+        if cpu_smoke is not None and cpu_smoke.poll():
+            res = harvest(cpu_smoke)
+            cpu_smoke = None
             if res is not None:
-                best = res
-            elif best is None and n == SMOKE_N:
-                res = run_stage("run", RUN_TIMEOUT_S, arg=str(n))  # one retry
-                if res is not None:
-                    best = res
-                else:
-                    break  # chip wedged post-probe; fall through to insurance
+                cpu_result = res
+            elif left() > 90 and not cpu_retried:
+                cpu_retried = True       # one smoke retry, as before
+                cpu_run = _Child("run", RUN_TIMEOUT_S, platform="cpu",
+                                 arg=str(SMOKE_N))
+        if cpu_run is not None and cpu_run.poll():
+            res = harvest(cpu_run)
+            cpu_run = None
+            if res is not None:
+                cpu_result = res
+        if (cpu_run is None and cpu_smoke is None and cpu_result is not None
+                and not cpu_escalated and tpu_result is None
+                and left() > RUN_TIMEOUT_S / 2):
+            cpu_escalated = True
+            cpu_run = _Child("run", RUN_TIMEOUT_S, platform="cpu",
+                             arg=str(CPU_FULL_N))
+
+        # -- TPU plane: keep exactly one child in flight
+        if tpu_probe is not None and tpu_probe.poll():
+            res = harvest(tpu_probe)
+            tpu_probe = None
+            if res is not None and res.get("platform") not in (None, "cpu"):
+                tpu_run = launch_tpu_run()
+            # else: fall through; the cadence below schedules the re-probe
+        if tpu_run is not None and tpu_run.poll():
+            res = harvest(tpu_run)
+            tpu_run = None
+            if res is not None and res.get("platform") == "cpu":
+                # the tunnel died between probe and run and the child fell
+                # back to XLA:CPU (bf16-emulated, mislabeled config): that is
+                # a TPU-plane FAILURE, not a result — storing it would cancel
+                # the proper float32 CPU insurance in favor of a worse number
+                res = None
+            if res is not None:
+                if (tpu_result is None
+                        or res.get("n", 0) > tpu_result.get("n", 0)):
+                    tpu_result = res
+                # an on-chip number always wins at report time, so the CPU
+                # insurance is moot now — stop it stealing host cores from
+                # the escalation run's featurize threads
+                for c in (cpu_probe, cpu_smoke, cpu_run):
+                    if c is not None:
+                        c.cancel()
+                        diags.append(c.diag)
+                cpu_probe = cpu_smoke = cpu_run = None
+                tpu_run = launch_tpu_run()   # escalate toward FULL_N
             else:
-                break
-    if best is not None:
-        cpu_smoke.cancel()
-        cpu_probe.cancel()
-        diags.append(cpu_probe.diag)
-        diags.append(cpu_smoke.diag)
-    else:
-        # 2b. CPU path (tunnel dead or TPU runs failed): harvest the
-        #     insurance smoke run, then try a bigger CPU run if time allows.
-        cpu_probe.wait()
-        diags.append(cpu_probe.diag)
-        best = cpu_smoke.wait()
-        diags.append(cpu_smoke.diag)
-        if best is None and left() > 60:
-            best = run_stage("run", RUN_TIMEOUT_S, platform="cpu",
-                             arg=str(SMOKE_N))
-        if best is not None and left() > RUN_TIMEOUT_S / 2:
-            res = run_stage("run", RUN_TIMEOUT_S, platform="cpu",
-                            arg=str(CPU_FULL_N))
-            if res is not None:
-                best = res
+                tpu_run_failures += 1
+                if tpu_result is not None:
+                    # chip was demonstrably alive earlier: retry the
+                    # escalation directly, no probe round-trip
+                    tpu_run = launch_tpu_run()
+                # else: back to the cadenced probe cycle below
+        if (tpu_probe is None and tpu_run is None and tpu_result is None
+                and tpu_run_failures < MAX_TPU_RUN_FAILURES
+                and time.monotonic() - last_probe_start >= REPROBE_INTERVAL_S
+                and left() > REPORT_MARGIN_S + TPU_MIN_RUN_BUDGET_S):
+            tpu_probe = _Child("probe", PROBE_TIMEOUT_S)
+            last_probe_start = time.monotonic()
 
+        # -- early exit: nothing in flight and nothing left to launch.
+        # While tpu_result is None and runs haven't been abandoned, the loop
+        # stays alive for the whole deadline — that persistence IS the fix.
+        tpu_active = tpu_probe is not None or tpu_run is not None
+        cpu_active = cpu_probe is not None or cpu_smoke is not None or cpu_run is not None
+        tpu_abandoned = tpu_run_failures >= MAX_TPU_RUN_FAILURES
+        if (not tpu_active and not cpu_active
+                and (tpu_result is not None or tpu_abandoned)):
+            break
+        time.sleep(0.5)
+
+    for child in (cpu_probe, cpu_smoke, cpu_run, tpu_probe, tpu_run):
+        if child is not None:
+            child.cancel()
+            diags.append(child.diag)
+
+    # any on-chip number, however small its N, beats the CPU fallback
+    best = tpu_result or cpu_result
     if best is not None:
         out = {
             "metric": "audit_log_lines_per_sec_through_detector",
@@ -389,10 +508,19 @@ def main() -> None:
             "n": best.get("n"),
         }
         if best.get("platform") == "cpu":
+            cores = best.get("cpu_cores") or os.cpu_count() or 1
+            per_core = best["lines_per_s"] / cores
+            # the regression net for wedged-tunnel rounds (r4 weak #5): a
+            # per-core rate with a pinned floor answers "did the code
+            # regress?" even when the box has 1 core and no chip
+            out["cpu_lines_per_s_per_core"] = round(per_core, 1)
+            out["cpu_floor_lines_per_s_per_core"] = CPU_FLOOR_LINES_PER_S_PER_CORE
+            out["cpu_floor_ok"] = per_core >= CPU_FLOOR_LINES_PER_S_PER_CORE
             out["note"] = (
-                f"TPU backend unreachable; float32 CPU fallback on "
-                f"{os.cpu_count()} core(s) — the target ratio is defined "
-                "against 1x TPU v5e")
+                f"TPU backend unreachable for the whole {DEADLINE_S}s window "
+                f"(persistent re-probe every ~{REPROBE_INTERVAL_S}s); float32 "
+                f"CPU fallback on {cores} core(s) — vs_baseline is defined "
+                "against 1x TPU v5e, cpu_floor_ok is the regression signal")
         print(json.dumps(out))
         print(f"# alerts: {best.get('alerts')}/{best.get('n')}; "
               f"elapsed: {best.get('elapsed_s')}s; stages: "

@@ -1,0 +1,172 @@
+"""Acquisition-loop logic of the root bench (VERDICT r4 next #1).
+
+The round-3/4 scoreboard zeros were orchestration failures, not code
+failures: one timed-out TPU probe committed the whole remaining deadline to
+the CPU fallback. These tests pin the redesigned event loop — persistent
+re-probe, run-size selection against the remaining budget, TPU-beats-CPU
+preference, and the CPU per-core regression floor — by stubbing the child
+subprocess layer, so they run in milliseconds with no jax and no tunnel.
+"""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    """A fresh bench module with tight time constants for fast loops."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", REPO_ROOT / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "DEADLINE_S", 2.0)
+    monkeypatch.setattr(mod, "REPORT_MARGIN_S", 0.5)
+    monkeypatch.setattr(mod, "REPROBE_INTERVAL_S", 0.2)
+    monkeypatch.setattr(mod, "PROBE_TIMEOUT_S", 1.0)
+    monkeypatch.setattr(mod, "RUN_TIMEOUT_S", 0.5)
+    monkeypatch.setattr(mod, "TPU_MIN_RUN_BUDGET_S", 0.3)
+    monkeypatch.setattr(mod, "TPU_COMFORT_BUDGET_S", 1.0)
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    return mod
+
+
+class ScriptedChild:
+    """Stands in for bench._Child: finishes instantly with a scripted
+    outcome decided by the test's controller function."""
+
+    calls: list = []
+    controller = staticmethod(lambda stage, platform, arg: None)
+
+    def __init__(self, stage, timeout_s, platform=None, arg=""):
+        type(self).calls.append((stage, platform, arg))
+        self.diag = {"stage": stage, "arg": arg,
+                     "platform_pin": platform or "default"}
+        self.payload = type(self).controller(stage, platform, arg)
+        self.diag["outcome"] = "ok" if self.payload is not None else "no_result"
+
+    def poll(self):
+        return True
+
+    def wait(self):
+        return self.payload
+
+    def cancel(self):
+        self.diag["outcome"] = "cancelled"
+
+
+def run_main(bench, monkeypatch, controller, capsys):
+    ScriptedChild.calls = []
+    ScriptedChild.controller = staticmethod(controller)
+    monkeypatch.setattr(bench, "_Child", ScriptedChild)
+    with pytest.raises(SystemExit):
+        bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1]), ScriptedChild.calls
+
+
+def cpu_payload(n, lps=2000.0):
+    return {"lines_per_s": lps, "p50_ms": 50.0, "alerts": 1, "n": int(n),
+            "elapsed_s": 1.0, "platform": "cpu", "cpu_cores": 4}
+
+
+def tpu_payload(n, lps=600000.0):
+    return {"lines_per_s": lps, "p50_ms": 4.9, "alerts": 1, "n": int(n),
+            "elapsed_s": 1.0, "platform": "tpu"}
+
+
+class TestAcquisitionLoop:
+    def test_wedged_tunnel_reprobes_and_reports_cpu_floor(
+            self, bench, monkeypatch, capsys):
+        """Every TPU probe fails for the whole window: the loop must keep
+        probing (not surrender after one window) and the CPU fallback must
+        carry the per-core regression-floor fields (r4 weak #5)."""
+        def controller(stage, platform, arg):
+            if stage == "probe":
+                return {"platform": "cpu"} if platform == "cpu" else None
+            if platform == "cpu":
+                return cpu_payload(arg)
+            return None
+
+        out, calls = run_main(bench, monkeypatch, controller, capsys)
+        assert out["platform"] == "cpu"
+        assert out["cpu_lines_per_s_per_core"] == pytest.approx(2000.0 / 4)
+        assert out["cpu_floor_ok"] is True
+        assert out["cpu_floor_lines_per_s_per_core"] == \
+            bench.CPU_FLOOR_LINES_PER_S_PER_CORE
+        tpu_probes = [c for c in calls if c[0] == "probe" and c[1] is None]
+        assert len(tpu_probes) >= 3, "one probe window must not end the hunt"
+
+    def test_late_probe_success_yields_tpu_number(
+            self, bench, monkeypatch, capsys):
+        """The tunnel comes back after several dead probe windows: the next
+        probe must trigger a run, and the TPU result must win over the
+        already-banked CPU number."""
+        state = {"probes": 0}
+
+        def controller(stage, platform, arg):
+            if stage == "probe":
+                if platform == "cpu":
+                    return {"platform": "cpu"}
+                state["probes"] += 1
+                if state["probes"] >= 3:
+                    return {"platform": "tpu", "device": "TPU v5e", "n_devices": 1}
+                return None
+            if platform == "cpu":
+                return cpu_payload(arg)
+            return tpu_payload(arg)
+
+        out, calls = run_main(bench, monkeypatch, controller, capsys)
+        assert out["platform"] == "tpu"
+        assert out["value"] == 600000.0
+        assert out["vs_baseline"] == 3.0
+        assert "cpu_lines_per_s_per_core" not in out
+
+    def test_escalates_to_full_n_and_keeps_largest(
+            self, bench, monkeypatch, capsys):
+        """With a healthy chip and a comfortable budget the loop must not
+        stop at the smoke size."""
+        def controller(stage, platform, arg):
+            if stage == "probe":
+                return {"platform": "cpu"} if platform == "cpu" else \
+                    {"platform": "tpu", "device": "d", "n_devices": 1}
+            if platform == "cpu":
+                return cpu_payload(arg)
+            return tpu_payload(arg, lps=500000.0 + float(arg))
+
+        out, calls = run_main(bench, monkeypatch, controller, capsys)
+        assert out["platform"] == "tpu"
+        assert out["n"] == bench.FULL_N
+        tpu_runs = [c for c in calls if c[0] == "run" and c[1] is None]
+        assert [int(a) for (_, _, a) in tpu_runs] == \
+            [bench.SMOKE_N, bench.FULL_N]
+
+    def test_run_failures_bounded(self, bench, monkeypatch, capsys):
+        """A chip that passes probes but wedges every run must not burn the
+        budget forever: runs stop at MAX_TPU_RUN_FAILURES and the CPU
+        number still reports."""
+        def controller(stage, platform, arg):
+            if stage == "probe":
+                return {"platform": "cpu"} if platform == "cpu" else \
+                    {"platform": "tpu", "device": "d", "n_devices": 1}
+            if platform == "cpu":
+                return cpu_payload(arg)
+            return None  # every TPU run dies
+
+        out, calls = run_main(bench, monkeypatch, controller, capsys)
+        assert out["platform"] == "cpu"
+        tpu_runs = [c for c in calls if c[0] == "run" and c[1] is None]
+        assert len(tpu_runs) == bench.MAX_TPU_RUN_FAILURES
+
+    def test_total_failure_still_emits_one_json_line(
+            self, bench, monkeypatch, capsys):
+        def controller(stage, platform, arg):
+            return None
+
+        out, _ = run_main(bench, monkeypatch, controller, capsys)
+        assert out["value"] == 0.0
+        assert out["error"]
