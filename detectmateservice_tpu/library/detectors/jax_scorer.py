@@ -75,6 +75,16 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # back to the host; hides device→host readback latency behind the next
     # batch's CPU featurization (jax dispatch is async)
     pipeline_depth: int = 8
+    # overlap host→device upload + jit dispatch with the engine thread's
+    # featurize/drain work: >0 moves the _score_dev call for each batch onto
+    # N background dispatch workers. On a tunneled TPU every device_put /
+    # dispatch call pays a multi-ms RPC floor that otherwise serializes with
+    # featurization on the engine thread (docs/benchmarks.md: ~4.5 ms/call +
+    # ~15 ms/batch tunnel floor at 2.6-9% MFU); a worker hides it behind the
+    # next batch's featurize. Output order is unaffected: the in-flight slot
+    # is queued at dispatch-call time, workers only fill it in. 0 = dispatch
+    # inline (the right choice on local CPU, where dispatch is ~free).
+    upload_workers: int = 0
     # batches at or below this size score on a CPU-jitted twin of the model
     # (host-resident params) instead of the accelerator: a lone message costs
     # ~1 ms on host vs 2 host↔device round-trips on a remote/tunneled TPU
@@ -100,6 +110,27 @@ def _bucket(n: int, max_batch: int) -> int:
     while b < n:
         b *= 2
     return min(b, max_batch)
+
+
+class _InflightSlot:
+    """One scored (or still-scoring) batch in the in-flight queue.
+
+    ``done`` is set once ``scores`` (device array or host numpy) or
+    ``error`` is populated. Inline dispatch fills the slot before it is
+    appended; the upload worker fills it after — but the slot joins
+    ``_inflight`` at dispatch-call time either way, so output order is the
+    dispatch order regardless of which thread ran the jax calls."""
+
+    __slots__ = ("scores", "raws", "real", "error", "done")
+
+    def __init__(self, raws, real: int):
+        import threading
+
+        self.scores = None
+        self.raws = raws
+        self.real = real
+        self.error: Optional[Exception] = None
+        self.done = threading.Event()
 
 
 class JaxScorerDetector(CoreDetector):
@@ -147,10 +178,12 @@ class JaxScorerDetector(CoreDetector):
         self._host_warm_thread = None
         self._ready_supported: Optional[bool] = None   # jax.Array.is_ready seen?
         self._metrics_labels = None
-        # in-flight scored batches: (scores_device_array, parsed_msgs, n_real)
+        # in-flight scored batches (_InflightSlot), oldest first
         from collections import deque
 
         self._inflight = deque()
+        self._upload_queue = None                      # upload_workers > 0
+        self._upload_threads: List = []
 
     def _validate_static_config(self) -> None:
         """Reject bad enum-ish config at CONSTRUCTION (no jax import needed):
@@ -758,10 +791,12 @@ class JaxScorerDetector(CoreDetector):
     def _head_ready(self) -> bool:
         """True when the oldest in-flight batch's scores are host-readable
         without blocking (host-path numpy results always are)."""
-        scores = self._inflight[0][0]
-        if isinstance(scores, np.ndarray):
+        slot = self._inflight[0]
+        if not slot.done.is_set():
+            return False  # a worker still owns the dispatch call
+        if slot.error is not None or isinstance(slot.scores, np.ndarray):
             return True
-        is_ready = getattr(scores, "is_ready", None)
+        is_ready = getattr(slot.scores, "is_ready", None)
         if callable(is_ready):
             self._ready_supported = True
             try:
@@ -862,10 +897,15 @@ class JaxScorerDetector(CoreDetector):
                 if n < bucket:
                     chunk = np.concatenate(
                         [tokens, np.zeros((bucket - n, tokens.shape[1]), np.int32)])
-                scores = np.asarray(self._score_host(chunk))[:n]
-                self._inflight.append((scores, list(msgs), n))
+                slot = _InflightSlot(list(msgs), n)
+                slot.scores = np.asarray(self._score_host(chunk))[:n]
+                slot.done.set()
+                self._inflight.append(slot)
                 return
         bucket = _bucket(n, self.config.max_batch)
+        use_workers = self.config.upload_workers > 0
+        if use_workers:
+            self._ensure_upload_workers()
         for start in range(0, n, bucket):
             chunk = tokens[start:start + bucket]
             real = len(chunk)
@@ -873,12 +913,56 @@ class JaxScorerDetector(CoreDetector):
                 chunk = np.concatenate(
                     [chunk, np.zeros((bucket - real, tokens.shape[1]), np.int32)]
                 )
-            scores = self._score_dev(chunk)
+            slot = _InflightSlot(msgs[start:start + real], real)
+            self._inflight.append(slot)
+            if use_workers:
+                self._upload_queue.put((slot, chunk))
+            else:
+                # inline: fill before returning; dispatch errors propagate
+                # to the caller exactly as before
+                slot.scores = self._score_dev(chunk)
+                try:
+                    slot.scores.copy_to_host_async()
+                except AttributeError:
+                    pass
+                slot.done.set()
+
+    def _ensure_upload_workers(self) -> None:
+        if self._upload_threads and all(t.is_alive() for t in self._upload_threads):
+            return
+        import queue as _queue
+        import threading
+
+        if self._upload_queue is None:
+            self._upload_queue = _queue.Queue()
+        self._upload_threads = [t for t in self._upload_threads if t.is_alive()]
+        for i in range(len(self._upload_threads), self.config.upload_workers):
+            t = threading.Thread(target=self._upload_loop, daemon=True,
+                                 name=f"ScorerDispatch-{i}")
+            self._upload_threads.append(t)
+            t.start()
+
+    def _upload_loop(self) -> None:
+        """Dispatch worker: runs the device upload + jit call for queued
+        slots. jax dispatch is thread-safe; a failure is stored on the slot
+        (surfaced and counted at drain) so a poisoned batch can never leave
+        the engine thread waiting on a slot that nobody will complete."""
+        while True:
+            item = self._upload_queue.get()
+            if item is None:
+                return
+            slot, chunk = item
             try:
-                scores.copy_to_host_async()
-            except AttributeError:
-                pass
-            self._inflight.append((scores, msgs[start:start + real], real))
+                scores = self._score_dev(chunk)
+                try:
+                    scores.copy_to_host_async()
+                except AttributeError:
+                    pass
+                slot.scores = scores
+            except Exception as exc:  # noqa: BLE001 — containment boundary
+                slot.error = exc
+            finally:
+                slot.done.set()
 
     def _score_host(self, tokens: np.ndarray):
         """Score a small batch on the CPU backend with the mirrored params."""
@@ -888,8 +972,18 @@ class JaxScorerDetector(CoreDetector):
         return self._host_score(self._host_params, tokens)
 
     def _drain_one(self) -> List[Optional[bytes]]:
-        scores_dev, raws, real = self._inflight.popleft()
-        scores = np.asarray(scores_dev)[:real]
+        slot = self._inflight.popleft()
+        slot.done.wait()
+        if slot.error is not None:
+            # worker-path dispatch failure: same containment rule as the
+            # engine's per-message processing — count EVERY lost message
+            # (error-rate dashboards must see the real magnitude), emit
+            # nothing, live on
+            self.count_processing_errors(
+                slot.real, f"batch dispatch failed: {slot.error}")
+            return []
+        raws, real = slot.raws, slot.real
+        scores = np.asarray(slot.scores)[:real]
         threshold = self._threshold if self._threshold is not None else float("inf")
         out: List[Optional[bytes]] = []
         hits = np.flatnonzero(scores > threshold)
@@ -918,12 +1012,27 @@ class JaxScorerDetector(CoreDetector):
     def flush_final(self) -> List[Optional[bytes]]:
         """Stop-time drain: waits for a running boundary fit so its pending
         backlog is scored and emitted before sockets close (and for the host
-        bucket warmer, so post-restore usage sees a deterministic state)."""
+        bucket warmer, so post-restore usage sees a deterministic state).
+        Upload workers are stopped after the drain — a detector that keeps
+        processing afterwards (tests do) just respawns them on next
+        dispatch; a torn-down one leaks no thread pinning it alive."""
         self._finish_fit(wait=True)
         warm = self._host_warm_thread
         if warm is not None and warm.is_alive():
             warm.join()
-        return self.flush()
+        out = self.flush()
+        self._stop_upload_workers()
+        return out
+
+    def _stop_upload_workers(self) -> None:
+        if self._upload_queue is None:
+            return
+        for t in self._upload_threads:
+            if t.is_alive():
+                self._upload_queue.put(None)   # one sentinel per live worker
+        for t in self._upload_threads:
+            t.join(timeout=5)
+        self._upload_threads = []
 
     def _make_alert_pb(self, msg, score: float) -> bytes:
         """Alert construction straight on the generated pb2 classes — at a
