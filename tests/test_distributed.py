@@ -135,3 +135,78 @@ print("DISTRIBUTED_OK")
                                 capture_output=True, text=True, timeout=120,
                                 env=env)
         assert "DISTRIBUTED_OK" in result.stdout, result.stderr[-1500:]
+
+
+_TWO_PROCESS_CHILD = r"""
+import os, sys
+pid, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from detectmateservice_tpu.parallel import distributed
+
+# one child resolves the coordinator from settings, the other from env —
+# both resolution paths of initialize_from_settings in one real bootstrap
+if pid == 0:
+    class S:
+        coordinator_address = f"127.0.0.1:{port}"
+        num_processes = 2
+        process_id = 0
+    assert distributed.initialize_from_settings(S()) is True
+else:
+    os.environ["DETECTMATE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["DETECTMATE_NUM_PROCESSES"] = "2"
+    os.environ["DETECTMATE_PROCESS_ID"] = "1"
+    assert distributed.initialize_from_settings(None) is True
+
+info = distributed.process_info()
+assert info["process_count"] == 2, info
+assert info["process_index"] == pid, info
+assert info["local_devices"] == 1, info
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devices = jax.devices()          # the GLOBAL view: one CPU device per process
+assert len(devices) == 2, devices
+mesh = Mesh(np.array(devices), ("dp",))
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), np.array([float(pid + 1)]))
+psum = jax.shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                     in_specs=P("dp"), out_specs=P())
+out = jax.jit(psum)(arr)         # replicated output: addressable everywhere
+assert float(out[0]) == 3.0, out  # 1 (proc 0) + 2 (proc 1): saw BOTH shards
+print(f"TWO_PROCESS_OK pid={pid}")
+"""
+
+
+class TestRealTwoProcessInitialize:
+    def test_cross_process_psum_over_localhost_coordinator(self, free_port,
+                                                           tmp_path):
+        """The seam actually spanning processes (VERDICT r4 next #5): two
+        subprocesses bootstrap one jax.distributed runtime over a localhost
+        coordinator, build a cross-process dp mesh (1 CPU device each), and
+        a psum observes both processes' shards. This is the same wireup a
+        real multi-host deployment uses — only the transport under the
+        coordinator (localhost vs DCN) differs."""
+        script = tmp_path / "two_process_child.py"
+        script.write_text(_TWO_PROCESS_CHILD)
+        env = dict(PYTHONPATH=str(REPO), PATH="/usr/bin:/bin:/opt/venv/bin",
+                   HOME="/root")
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(free_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+            for pid in (0, 1)]
+        outs = []
+        try:
+            for p in procs:
+                stdout, stderr = p.communicate(timeout=180)
+                outs.append((p.returncode, stdout, stderr))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for pid, (rc, stdout, stderr) in enumerate(outs):
+            assert rc == 0, f"pid={pid} rc={rc}\n{stderr[-2000:]}"
+            assert f"TWO_PROCESS_OK pid={pid}" in stdout, stderr[-1500:]
