@@ -1,4 +1,4 @@
 """Package metadata (role of the reference's src/service/metadata.py:10)."""
 
 NAME = "detectmateservice-tpu"
-VERSION = "0.1.0"
+VERSION = "0.5.0"

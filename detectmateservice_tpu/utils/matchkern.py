@@ -44,7 +44,7 @@ def _rebuild() -> None:
     os.close(fd)
     try:
         subprocess.run(["cc", "-O3", "-shared", "-fPIC", "-o", tmp,
-                        str(_SRC_PATH), "-lz"],
+                        str(_SRC_PATH)],
                        check=True, capture_output=True, timeout=120)
         os.chmod(tmp, 0o755)  # mkstemp creates 0600; other users must dlopen
         os.replace(tmp, str(_LIB_PATH))

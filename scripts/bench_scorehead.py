@@ -152,11 +152,19 @@ def main() -> None:
         out = {"shape": label, "n": n, "c": c, "d": d, "chain": chain,
                "platform": platform, "max_abs_err": round(err, 5),
                "parity": "ok" if parity_ok else "FAIL"}
+        slope_ok = True
         for name, single in (("xla_ms", xla_single), ("pallas_ms", pal_single)):
             t_short = timed_ms(chained(single, short), h, e)
             t_long = timed_ms(chained(single, chain), h, e)
+            # slope protocol sanity: median-of-5 over a jittery tunnel can
+            # yield t_long < t_short, and the resulting negative ms/op would
+            # print a sign-flipped "speedup" as if it were valid
+            if t_long <= t_short:
+                slope_ok = False
             out[name] = round((t_long - t_short) / (chain - short), 3)
-        if parity_ok:
+        if not slope_ok:
+            out["slope"] = "unreliable"
+        if parity_ok and slope_ok:
             out["speedup"] = round(out["xla_ms"] / max(out["pallas_ms"], 1e-9), 2)
         print(json.dumps(out), flush=True)
         if not parity_ok:
