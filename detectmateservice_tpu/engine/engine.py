@@ -157,10 +157,13 @@ class Engine:
     def _setup_output_sockets(self) -> None:
         for addr in self.settings.out_addr:
             try:
+                # both TLS-bearing schemes get the client material; others
+                # get None so a fake factory never sees surprise TLS args
+                is_tls = addr.startswith(("tls+tcp://", "nng+tls+tcp://"))
                 sock = self._factory.create_output(
                     addr,
                     self.logger,
-                    self.settings.tls_output if addr.startswith("tls+tcp://") else None,
+                    self.settings.tls_output if is_tls else None,
                     dial_timeout=self.settings.out_dial_timeout,
                     buffer_size=self.settings.engine_buffer_size,
                 )

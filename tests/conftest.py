@@ -57,6 +57,33 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.fixture(scope="session")
+def tls_material(tmp_path_factory):
+    """Throwaway CA + server cert via the openssl CLI (the reference's
+    approach, tests/test_tls_transport.py:52-99). Session-scoped: one
+    keypair serves every TLS test (transport, nng wire, chaos)."""
+    import subprocess
+
+    d = tmp_path_factory.mktemp("tls")
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    srv_key, srv_csr, srv_crt = d / "srv.key", d / "srv.csr", d / "srv.crt"
+    cert_key = d / "server_bundle.pem"
+
+    def run(*cmd):
+        subprocess.run(cmd, check=True, capture_output=True)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+        "-subj", "/CN=testca")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(srv_key), "-out", str(srv_csr), "-subj", "/CN=localhost")
+    run("openssl", "x509", "-req", "-in", str(srv_csr), "-CA", str(ca_crt),
+        "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(srv_crt),
+        "-days", "1")
+    cert_key.write_text(srv_crt.read_text() + srv_key.read_text())
+    return {"ca_file": str(ca_crt), "cert_key_file": str(cert_key)}
+
+
 @pytest.fixture()
 def inproc_factory() -> InprocQueueSocketFactory:
     return InprocQueueSocketFactory()

@@ -17,9 +17,14 @@ from detectmateservice_tpu.engine import Engine
 from detectmateservice_tpu.engine.socket import (
     InprocQueueSocketFactory,
     NngTcpSocketFactory,
+    NngTlsTcpSocketFactory,
     TransportTimeout,
 )
-from detectmateservice_tpu.settings import ServiceSettings
+from detectmateservice_tpu.settings import (
+    ServiceSettings,
+    TlsInputConfig,
+    TlsOutputConfig,
+)
 
 from conftest import wait_until
 
@@ -31,34 +36,54 @@ class _Echo:
 
 class _MixedFactory:
     """inproc for the engine input (lossless, so every send reaches the
-    engine), real nng+tcp for the output (the plane under attack)."""
+    engine), real SP wire — plain or TLS — for the output (the plane under
+    attack)."""
 
-    def __init__(self):
+    def __init__(self, tls_material=None):
         self.inproc = InprocQueueSocketFactory()
-        self.nng = NngTcpSocketFactory()
+        if tls_material:
+            self.out = NngTlsTcpSocketFactory()
+            self._listener_tls = TlsInputConfig(
+                cert_key_file=tls_material["cert_key_file"])
+        else:
+            self.out = NngTcpSocketFactory()
+            self._listener_tls = None
 
     def create(self, addr, logger=None, tls_config=None):
         return self.inproc.create(addr, logger, tls_config)
 
     def create_output(self, addr, logger=None, tls_config=None,
                       dial_timeout=None, buffer_size=100):
-        return self.nng.create_output(addr, logger or logging.getLogger("t"))
+        return self.out.create_output(addr, logger or logging.getLogger("t"),
+                                      tls_config)
+
+    def make_listener(self, addr, logger):
+        """The downstream peer the churn kills and resurrects."""
+        return self.out.create(addr, logger, self._listener_tls)
 
 
 class TestDownstreamChurn:
-    def test_no_silent_loss_across_listener_deaths(self, free_port):
+    @pytest.mark.parametrize("scheme", ["nng+tcp", "nng+tls+tcp"])
+    def test_no_silent_loss_across_listener_deaths(self, scheme, free_port,
+                                                   tls_material):
+        """Same churn invariant over the plain AND the encrypted SP plane:
+        the TLS variant makes every redial re-run a full TLS + SP handshake
+        (a path plain nng+tcp never exercises)."""
         from detectmateservice_tpu.engine import metrics as m
 
-        out_addr = f"nng+tcp://127.0.0.1:{free_port}"
+        tls = tls_material if scheme == "nng+tls+tcp" else None
+        out_addr = f"{scheme}://127.0.0.1:{free_port}"
         settings = ServiceSettings(
-            component_type="core", component_id="chaos",
-            engine_addr="inproc://chaos-in", out_addr=[out_addr],
+            component_type="core", component_id=f"chaos-{scheme}",
+            engine_addr=f"inproc://chaos-in-{scheme}", out_addr=[out_addr],
+            tls_output=TlsOutputConfig(ca_file=tls["ca_file"],
+                                       server_name="localhost") if tls else None,
             engine_retry_count=2, log_to_file=False)
-        factory = _MixedFactory()
+        factory = _MixedFactory(tls)
         engine = Engine(settings, _Echo(), factory)
         engine.start()
-        ingress = factory.inproc.create_output("inproc://chaos-in")
-        labels = dict(component_type="core", component_id="chaos")
+        ingress = factory.inproc.create_output(f"inproc://chaos-in-{scheme}")
+        labels = dict(component_type="core", component_id=f"chaos-{scheme}")
 
         received = []
         stop = threading.Event()
@@ -72,8 +97,8 @@ class TestDownstreamChurn:
             deadline = time.monotonic() + 10
             while True:
                 try:
-                    listener = factory.nng.create(out_addr,
-                                                  logging.getLogger("sink"))
+                    listener = factory.make_listener(out_addr,
+                                                     logging.getLogger("sink"))
                     break
                 except Exception:
                     if time.monotonic() > deadline:

@@ -14,7 +14,6 @@ import re
 import socket
 import ssl
 import struct
-import subprocess
 import threading
 import time
 from pathlib import Path
@@ -160,27 +159,6 @@ def _send_raises(sock, payload: bytes) -> bool:
         return True
 
 
-@pytest.fixture(scope="module")
-def tls_material(tmp_path_factory):
-    """Throwaway CA + server cert via the openssl CLI (the reference's
-    approach, tests/test_tls_transport.py:52-99)."""
-    d = tmp_path_factory.mktemp("nngtls")
-    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
-    srv_key, srv_csr, srv_crt = d / "srv.key", d / "srv.csr", d / "srv.crt"
-    cert_key = d / "server_bundle.pem"
-    run = lambda *cmd: subprocess.run(cmd, check=True, capture_output=True)
-    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
-        "-subj", "/CN=testca")
-    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
-        "-keyout", str(srv_key), "-out", str(srv_csr), "-subj", "/CN=localhost")
-    run("openssl", "x509", "-req", "-in", str(srv_csr), "-CA", str(ca_crt),
-        "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(srv_crt),
-        "-days", "1")
-    cert_key.write_text(srv_crt.read_text() + srv_key.read_text())
-    return {"ca_file": str(ca_crt), "cert_key_file": str(cert_key)}
-
-
 def raw_sp_tls_connect(port: int, ca_file: str) -> ssl.SSLSocket:
     """Dial like a libnng tls+tcp Pair0 peer (mbedTLS side): complete the
     TLS handshake FIRST, then exchange the 8-byte SP headers inside the
@@ -304,6 +282,57 @@ class TestNngTlsWire:
             ServiceSettings(component_type="core",
                             out_addr=[f"nng+tls+tcp://127.0.0.1:{free_port}"],
                             log_to_file=False)
+
+    def test_engine_output_dials_tls_listener(self, tls_material, free_port):
+        """The ENGINE forwards tls_output to the factory for nng+tls+tcp
+        out addrs. Integration gap the factory-level tests missed: settings
+        validation guaranteed the material existed, but the engine's output
+        setup only forwarded it for tls+tcp:// — every encrypted NNG output
+        failed at dial with 'requires tls_output.ca_file'."""
+        from detectmateservice_tpu.engine.socket import ZmqPairSocketFactory
+
+        listener = NngTlsTcpSocketFactory().create(
+            f"nng+tls+tcp://127.0.0.1:{free_port}",
+            tls_config=TlsInputConfig(cert_key_file=tls_material["cert_key_file"]))
+        listener.recv_timeout = 8000
+        settings = ServiceSettings(
+            component_type="core",
+            engine_addr="inproc://tls-out-engine",
+            out_addr=[f"nng+tls+tcp://127.0.0.1:{free_port}"],
+            tls_output=TlsOutputConfig(ca_file=tls_material["ca_file"],
+                                       server_name="localhost"),
+            log_to_file=False,
+        )
+
+        class Upper:
+            def process(self, data: bytes):
+                return data.upper()
+
+        engine = Engine(settings, Upper(), ZmqPairSocketFactory())
+        engine.start()
+        ingress = ZmqPairSocketFactory().create_output("inproc://tls-out-engine")
+        # pump until one delivery lands: the engine's bounded send-retry may
+        # drop the first messages while the background TLS dial completes
+        done = threading.Event()
+
+        def pump():
+            while not done.is_set():
+                try:
+                    ingress.send(b"encrypted out", block=False)
+                except TransportError:
+                    pass
+                time.sleep(0.05)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            assert listener.recv() == b"ENCRYPTED OUT"
+        finally:
+            done.set()
+            t.join()
+        ingress.close()
+        engine.stop()
+        listener.close()
 
     def test_engine_serves_raw_tls_nng_peer(self, tls_material, free_port):
         """Full stack parity with TestEngineOverNngTcp, encrypted: an Engine

@@ -315,22 +315,32 @@ class TestRealComponentPipeline:
         assert dict(alert.alertsObtain) == {"Global - Component": "Unknown value: 'rootkit'"}
         assert list(alert.logIDs) == ["9"]
 
-    def test_jax_scorer_service_micro_batched(self, run_service, inproc_factory, tmp_path):
+    @pytest.mark.parametrize("upload_workers", [0, 1])
+    def test_jax_scorer_service_micro_batched(self, upload_workers,
+                                              run_service, inproc_factory,
+                                              tmp_path):
+        """workers=1 runs the whole service loop with dispatch on the
+        background worker (the r5 overlap lever) — the engine's drain_ready
+        short-poll, flush, and stop paths all cross the slot machinery."""
         config = tmp_path / "j.yaml"
         config.write_text(yaml.safe_dump({"detectors": {"JaxScorerDetector": {
             "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
             "data_use_training": 32, "train_epochs": 2, "min_train_steps": 60,
             "seq_len": 16, "dim": 32, "max_batch": 32,
             "pipeline_depth": 1, "threshold_sigma": 4.0,
+            "host_score_max_batch": 0,  # force every batch onto the
+            "upload_workers": upload_workers,  # (worker-)dispatch path
         }}}))
-        make_service(run_service, inproc_factory, "inproc://jax-det",
+        addr = f"inproc://jax-det-{upload_workers}"
+        out = f"inproc://jax-out-{upload_workers}"
+        make_service(run_service, inproc_factory, addr,
                      component_type="detectors.jax_scorer.JaxScorerDetector",
                      config_file=str(config),
-                     out_addr=["inproc://jax-out"],
+                     out_addr=[out],
                      engine_batch_size=16, engine_batch_timeout_ms=30.0)
-        sink = inproc_factory.create("inproc://jax-out")
+        sink = inproc_factory.create(out)
         sink.recv_timeout = 15000
-        ingress = inproc_factory.create_output("inproc://jax-det")
+        ingress = inproc_factory.create_output(addr)
 
         for i in range(32):  # training
             ingress.send(parser_msg("user <*> ok from <*>",

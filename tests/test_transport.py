@@ -1,7 +1,6 @@
 """Transport-layer tests: schemes, timeouts, stale ipc cleanup, real TLS
 (model of the reference's tests/test_tls_transport.py:52-258 and
 tests/test_engine_socket_factory_error_handling.py:74-125)."""
-import subprocess
 import time
 
 import pytest
@@ -58,27 +57,6 @@ class TestZmqFactory:
         assert client.recv() == b"pong"
         client.close()
         server.close()
-
-
-@pytest.fixture(scope="module")
-def tls_material(tmp_path_factory):
-    """Throwaway CA + server cert via the openssl CLI (the reference's
-    approach, tests/test_tls_transport.py:52-99)."""
-    d = tmp_path_factory.mktemp("tls")
-    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
-    srv_key, srv_csr, srv_crt = d / "srv.key", d / "srv.csr", d / "srv.crt"
-    cert_key = d / "server_bundle.pem"
-    run = lambda *cmd: subprocess.run(cmd, check=True, capture_output=True)
-    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-        "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
-        "-subj", "/CN=testca")
-    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
-        "-keyout", str(srv_key), "-out", str(srv_csr), "-subj", "/CN=localhost")
-    run("openssl", "x509", "-req", "-in", str(srv_csr), "-CA", str(ca_crt),
-        "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(srv_crt),
-        "-days", "1")
-    cert_key.write_text(srv_crt.read_text() + srv_key.read_text())
-    return {"ca_file": str(ca_crt), "cert_key_file": str(cert_key)}
 
 
 class TestTlsTransport:
