@@ -33,7 +33,7 @@ import threading
 import time
 from typing import List, Optional, Protocol, runtime_checkable
 
-from ..settings import ServiceSettings
+from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
 from . import metrics as m
 from .framing import FramingError, frame_msg_count, pack_batch, unpack_batch
 from .socket import (
@@ -157,9 +157,12 @@ class Engine:
     def _setup_output_sockets(self) -> None:
         for addr in self.settings.out_addr:
             try:
-                # both TLS-bearing schemes get the client material; others
-                # get None so a fake factory never sees surprise TLS args
-                is_tls = addr.startswith(("tls+tcp://", "nng+tls+tcp://"))
+                # TLS-bearing schemes get the client material; others get
+                # None so a fake factory never sees surprise TLS args. The
+                # scheme list is shared with settings validation on purpose:
+                # the two diverging is exactly the bug that broke encrypted
+                # NNG outputs at dial.
+                is_tls = addr.startswith(TLS_SCHEME_PREFIXES)
                 sock = self._factory.create_output(
                     addr,
                     self.logger,

@@ -47,6 +47,12 @@ ENV_NESTED_DELIMITER = "__"
 SUPPORTED_SCHEMES = ("ipc", "tcp", "tls+tcp", "nng+tcp", "nng+tls+tcp", "ws",
                      "inproc")
 
+# The TLS-bearing scheme prefixes. ONE home, used by both settings
+# cross-validation (material must exist) and the engine's socket setup
+# (material must be FORWARDED to the factory) — those two drifted once,
+# breaking every encrypted NNG output at dial while validation passed.
+TLS_SCHEME_PREFIXES = ("tls+tcp://", "nng+tls+tcp://")
+
 
 # ws:// historical note: through round 2, ws rode libzmq's WebSocket
 # transport — a compile-time option this image's libzmq lacks, so settings
@@ -211,7 +217,7 @@ class ServiceSettings(BaseModel):
         # both TLS-bearing schemes (framework-private tls+tcp and the
         # NNG-wire-compatible nng+tls+tcp) need their material up front —
         # fail at startup, not at first connection
-        tls_schemes = ("tls+tcp://", "nng+tls+tcp://")
+        tls_schemes = TLS_SCHEME_PREFIXES
         if self.engine_addr.startswith(tls_schemes) and self.tls_input is None:
             raise ValueError(
                 f"engine_addr uses {self.engine_addr.split('://')[0]}:// "

@@ -1,0 +1,60 @@
+"""CLI logging stream split (reference: tests/test_cli_logging_setup.py:21-44
+pins cli.py:12-32): records below ERROR go to stdout, ERROR and above to
+stderr — so shell pipelines and process supervisors can separate operational
+chatter from failures. Captured via stream substitution, the reference's
+idiom."""
+import io
+import logging
+import sys
+
+from detectmateservice_tpu.cli import setup_logging
+
+
+class TestCliLoggingSplit:
+    def _capture(self, emit, level="DEBUG"):
+        """Run ``emit(logger)`` with fresh stdout/stderr StringIOs installed
+        BEFORE setup_logging (handlers bind the stream object at creation).
+        Root handlers AND level are restored afterwards — leaking the DEBUG
+        level would order-dependently change what later tests capture."""
+        root = logging.getLogger()
+        old_out, old_err = sys.stdout, sys.stderr
+        old_handlers = list(root.handlers)
+        old_level = root.level
+        sys.stdout, sys.stderr = io.StringIO(), io.StringIO()
+        try:
+            setup_logging(level)
+            emit(logging.getLogger("split-test"))
+            return sys.stdout.getvalue(), sys.stderr.getvalue()
+        finally:
+            sys.stdout, sys.stderr = old_out, old_err
+            for h in list(root.handlers):
+                root.removeHandler(h)
+            for h in old_handlers:
+                root.addHandler(h)
+            root.setLevel(old_level)
+
+    def test_info_and_warning_go_to_stdout_only(self):
+        out, err = self._capture(lambda log: (log.info("routine"),
+                                              log.warning("heads-up")))
+        assert "routine" in out and "heads-up" in out
+        assert err == ""
+
+    def test_error_and_critical_go_to_stderr_only(self):
+        out, err = self._capture(lambda log: (log.error("broken"),
+                                              log.critical("on fire")))
+        assert "broken" in err and "on fire" in err
+        assert "broken" not in out and "on fire" not in out
+
+    def test_mixed_stream_routing_is_per_record(self):
+        out, err = self._capture(lambda log: (log.info("ok"),
+                                              log.error("bad"),
+                                              log.info("ok again")))
+        assert "ok" in out and "ok again" in out and "bad" not in out
+        assert "bad" in err and "ok" not in err.replace("ok again", "")
+
+    def test_level_threshold_respected(self):
+        """setup_logging(level) still gates the root logger: DEBUG records
+        are dropped entirely at INFO."""
+        out, err = self._capture(lambda log: log.debug("invisible"),
+                                 level="INFO")
+        assert "invisible" not in out and "invisible" not in err
