@@ -75,10 +75,12 @@ def decode_ingest_payload(data: bytes, accept_raw: bool):
 
     1. **LogSchema protobuf** — the reference-grade envelope its
        `fluent-plugin-detectmate` formatter emits (reference:
-       container/fluentin/fluent.conf:164-166). Accepted iff the bytes parse
-       AND at least one LogSchema field is present — proto3 will "parse"
-       some arbitrary byte strings into all-unknown-fields messages, and
-       those must not be mistaken for envelopes.
+       container/fluentin/fluent.conf:164-166). In strict mode any parse
+       is taken as-is (the reference contract). With ``accept_raw`` on,
+       an envelope is recognized iff the bytes parse AND at least one
+       LogSchema field is present — proto3 will "parse" some arbitrary
+       byte strings into all-unknown-fields messages, and those must not
+       shadow the raw-line interpretations.
     2. **JSON record** — what stock fluentd's `<format> @type json` emits
        for the tail source: ``{"message": line, "logSource": path,
        "hostname": host}`` (+ trailing newline). Mapped onto LogSchema as
@@ -94,12 +96,18 @@ def decode_ingest_payload(data: bytes, accept_raw: bool):
     msg = _pb.LogSchema()
     try:
         msg.ParseFromString(data)
-        envelope = any(msg.HasField(f) for f in _LOGSCHEMA_FIELDS)
     except Exception as exc:
         if not accept_raw:
             raise SchemaError(f"cannot parse LogSchema: {exc}") from exc
         envelope = False
-    if envelope or not accept_raw:
+    else:
+        if not accept_raw:
+            # strict mode takes whatever parsed, envelope or not (the
+            # reference contract) — skip the per-line presence probe, this
+            # is the parser service's hot path
+            return msg
+        envelope = any(msg.HasField(f) for f in _LOGSCHEMA_FIELDS)
+    if envelope:
         return msg
     out = _pb.LogSchema()
     if data[:1] == b"{":
