@@ -131,18 +131,32 @@ def decode_ingest_payload(data: bytes, accept_raw: bool):
     return out
 
 
-def compile_log_format(log_format: str) -> Tuple[Pattern, List[str]]:
-    """Turn a ``<Name>`` token template into a regex + capture-name list."""
+def split_log_format(log_format: str) -> Tuple[List[str], List[str]]:
+    """Split a ``<Name>`` token template into (literal segments, capture
+    names): ``len(lits) == len(names) + 1``. The ONE home of the
+    capture-token grammar for both the regex path (compile_log_format) and
+    the fused C kernel (matchkern.ParseKernel)."""
+    lits: List[str] = []
     names: List[str] = []
-    pattern_parts: List[str] = ["^"]
     pos = 0
     for match in _TOKEN_RE.finditer(log_format):
-        literal = log_format[pos:match.start()]
-        pattern_parts.append(re.escape(literal))
+        lits.append(log_format[pos:match.start()])
         names.append(match.group(1))
-        pattern_parts.append("(.*?)" if match.end() != len(log_format) else "(.*)")
         pos = match.end()
-    pattern_parts.append(re.escape(log_format[pos:]))
+    lits.append(log_format[pos:])
+    return lits, names
+
+
+def compile_log_format(log_format: str) -> Tuple[Pattern, List[str]]:
+    """Turn a ``<Name>`` token template into a regex + capture-name list."""
+    lits, names = split_log_format(log_format)
+    pattern_parts: List[str] = ["^"]
+    for i, name in enumerate(names):
+        pattern_parts.append(re.escape(lits[i]))
+        # the capture that ends the format is greedy; all others lazy
+        trailing = i == len(names) - 1 and lits[i + 1] == ""
+        pattern_parts.append("(.*)" if trailing else "(.*?)")
+    pattern_parts.append(re.escape(lits[-1]))
     pattern_parts.append("$")
     return re.compile("".join(pattern_parts)), names
 
@@ -179,6 +193,7 @@ class MatcherParser(CoreComponent):
         if self.config.path_templates:
             templates, template_res = self._read_templates(self.config.path_templates)
         native = None
+        parse_native = None
         try:  # optional C++ matching kernel
             from ...utils import matchkern
 
@@ -186,11 +201,31 @@ class MatcherParser(CoreComponent):
                 native = matchkern.TemplateMatcher(
                     [self._normalize(t) for t in templates]
                 )
+            # fused whole-row kernel (round 5): decode + header extraction +
+            # normalize + match + ParserSchema encode in one C pass.
+            # time_format needs strptime/mktime with Python's exact quirks —
+            # those configs stay on the Python path.
+            if matchkern.has_parse_kernel() and not self.config.time_format:
+                from ...schemas import SCHEMA_VERSION
+
+                flags = ((1 if self.config.remove_spaces else 0)
+                         | (2 if self.config.remove_punctuation else 0)
+                         | (4 if self.config.lowercase else 0))
+                lits, names = (split_log_format(self.config.log_format)
+                               if self.config.log_format else ([], []))
+                parse_native = matchkern.ParseKernel(
+                    lits=lits, names=names, norm_flags=flags,
+                    accept_raw=self.config.accept_raw_lines,
+                    matcher=native, raw_templates=templates,
+                    method_type=self.config.method_type,
+                    parser_id=self.name, version=SCHEMA_VERSION)
         except Exception:
-            native = None
+            native = native or None
+            parse_native = None
         self._format_re, self._format_names = format_re, format_names
         self._templates, self._template_res = templates, template_res
         self._native = native
+        self._parse_native = parse_native
 
     def _read_templates(self, path: str):
         try:
@@ -291,7 +326,47 @@ class MatcherParser(CoreComponent):
         test_process_batch_matches_process — but built straight on the
         generated pb2 classes. The dict-style wrapper's field-descriptor
         lookups were ~40% of the per-line budget (11 assignments/message);
-        at pipeline rates that overhead IS the parser stage's ceiling."""
+        at pipeline rates that overhead IS the parser stage's ceiling.
+
+        With the fused C kernel available the whole row runs native
+        (``dm_parse_batch``: decode + header extract + normalize + match +
+        encode); rows the kernel cannot do with exact parity come back
+        flagged and re-run through this Python path one by one."""
+        if self._parse_native is not None:
+            return self._process_batch_native(batch)
+        return self._process_batch_python(batch)
+
+    def _process_batch_native(self, batch: List[bytes]) -> List[Optional[bytes]]:
+        status, blob, ends = self._parse_native.parse_batch(batch)
+        outs: List[Optional[bytes]] = []
+        decode_errors = 0
+        status_list = status.tolist()
+        ends_list = ends.tolist()
+        for i, st in enumerate(status_list):
+            if st == 1:
+                outs.append(blob[ends_list[i]:ends_list[i + 1]])
+            elif st == 0:
+                outs.append(None)   # blank line: filtered
+            else:
+                out, err = self._parse_row_python(batch[i])
+                decode_errors += err
+                outs.append(out)
+        if decode_errors:
+            self.count_processing_errors(decode_errors,
+                                         "undecodable LogSchema message(s)")
+        return outs
+
+    def _parse_row_python(self, data: bytes):
+        """Exact-semantics fallback for one kernel-flagged row: the batch
+        path's per-message behavior (decode error → counted + None)."""
+        try:
+            msg = decode_ingest_payload(data, self.config.accept_raw_lines)
+        except SchemaError:
+            return None, 1
+        parsed = self.parse_line(msg.log, log_id=msg.logID)
+        return (parsed.serialize() if parsed is not None else None), 0
+
+    def _process_batch_python(self, batch: List[bytes]) -> List[Optional[bytes]]:
         from os import urandom
 
         from ...schemas import SCHEMA_VERSION, schemas_pb2 as _pb

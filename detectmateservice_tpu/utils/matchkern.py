@@ -111,6 +111,28 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int32,
     ]
     lib.dm_featurize_frames.restype = ctypes.c_int64
+    # dm_parse_batch landed in round 5: an older committed .so may lack it
+    # (a host without a compiler keeps using the rest of the kernels)
+    if hasattr(lib, "dm_parse_batch"):
+        lib.dm_parse_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int8),
+        ]
+        lib.dm_parse_batch.restype = ctypes.c_int64
     return lib
 
 
@@ -363,3 +385,103 @@ class TemplateMatcher:
                     pass  # span split a multibyte char: regex fallback
             results.append(self.match(lines[i]))  # slow-path fallback
         return results
+
+
+def has_parse_kernel() -> bool:
+    """True when the loaded library carries the round-5 fused parser path."""
+    return hasattr(_lib, "dm_parse_batch")
+
+
+class ParseKernel:
+    """Fused MatcherParser batch path: LogSchema payloads → serialized
+    ParserSchema bytes, one C crossing per micro-batch (dm_parse_batch).
+
+    Rows the kernel cannot process with EXACT Python-path parity come back
+    with status -1 and the caller re-runs them in Python — same containment
+    pattern as ``featurize_frames``'s ok-mask. ``status`` semantics:
+    1 = emitted, 0 = filtered (None), -1 = Python fallback.
+
+    All config-derived arrays are marshalled once at construction (the
+    ctypes pointer conversions cost ~6 µs/call otherwise — same lesson as
+    TemplateMatcher); ``parse_batch`` only packs the payload blob.
+    """
+
+    def __init__(self, lits: List[str], names: List[str], norm_flags: int,
+                 accept_raw: bool, matcher, raw_templates: List[str],
+                 method_type: str, parser_id: str, version: str):
+        # lits/names come from the CALLER's log_format split (the parser owns
+        # the capture-token grammar, template_matcher._TOKEN_RE) — one
+        # definition of the grammar, one split, both paths agree by
+        # construction. Empty lits = no log_format configured.
+        self._n_lits = len(lits)
+        self._lit_blob, self._lit_offsets = _pack([s.encode() for s in lits])
+        self._name_blob, self._name_offsets = _pack([s.encode() for s in names])
+        self._lit_offsets_p = self._lit_offsets.ctypes.data_as(_I64P)
+        self._name_offsets_p = self._name_offsets.ctypes.data_as(_I64P)
+        # dict(zip(names, groups)) is last-wins for duplicate capture names
+        self._content_cap = -1
+        for i, nm in enumerate(names):
+            if nm == "Content":
+                self._content_cap = i
+        self._norm_flags = norm_flags
+        self._accept_raw = 1 if accept_raw else 0
+        self._matcher = matcher                    # TemplateMatcher or None
+        self._tmpl_blob, self._tmpl_offsets = _pack(
+            [t.encode() for t in raw_templates])
+        self._tmpl_offsets_p = self._tmpl_offsets.ctypes.data_as(_I64P)
+        self._n_templates = len(raw_templates)
+        self._consts = (version.encode(), method_type.encode(),
+                        parser_id.encode())
+        self._names_total = int(self._name_offsets[-1])
+        self._tmpl_max = max((len(t.encode()) for t in raw_templates),
+                             default=0)
+
+    def parse_batch(self, payloads: Sequence[bytes]):
+        """→ (status int8 array, out blob bytes, offsets int64 array)."""
+        import os
+        import time
+
+        n = len(payloads)
+        blob, offsets = _pack(payloads)
+        status = np.full(n, -1, dtype=np.int8)
+        out_offsets = np.zeros(n + 1, dtype=np.int64)
+        rand_hex = os.urandom(16 * n).hex().encode() if n else b""
+        now = int(time.time())
+        m = self._matcher
+        if m is not None:
+            seg = (m._seg_blob, m._seg_offsets_p, m._counts_p,
+                   m._starts_p, m._ends_p, len(m._templates), m._max_caps)
+        else:
+            seg = (b"", _ZERO_I64.ctypes.data_as(_I64P),
+                   _ZERO_I32.ctypes.data_as(_I32P),
+                   _ZERO_U8.ctypes.data_as(_U8P),
+                   _ZERO_U8.ctypes.data_as(_U8P), 0, 1)
+        version, method_type, parser_id = self._consts
+        cap = int(len(blob) * 2 + n * (256 + self._tmpl_max
+                                       + self._names_total) + 1024)
+        for _ in range(4):
+            out = np.empty(cap, dtype=np.uint8)
+            used = int(_lib.dm_parse_batch(
+                blob, offsets.ctypes.data_as(_I64P), n, self._accept_raw,
+                self._lit_blob, self._lit_offsets_p, self._n_lits,
+                self._name_blob, self._name_offsets_p,
+                self._content_cap, self._norm_flags,
+                seg[0], seg[1], seg[2], seg[3], seg[4], seg[5],
+                self._tmpl_blob, self._tmpl_offsets_p, seg[6],
+                version, len(version), method_type, len(method_type),
+                parser_id, len(parser_id),
+                now, rand_hex,
+                out.ctypes.data_as(_U8P), cap,
+                out_offsets.ctypes.data_as(_I64P),
+                status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))))
+            if used >= 0:
+                # slice BEFORE materializing: tobytes() on the full
+                # capacity-sized array would memcpy cap bytes per call
+                return status, out[:used].tobytes(), out_offsets
+            cap *= 4
+        raise MemoryError("dm_parse_batch output buffer kept overflowing")
+
+
+_ZERO_I64 = np.zeros(1, dtype=np.int64)
+_ZERO_I32 = np.zeros(1, dtype=np.int32)
+_ZERO_U8 = np.zeros(1, dtype=np.uint8)

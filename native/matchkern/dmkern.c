@@ -533,3 +533,380 @@ void dm_match_extract_batch(const uint8_t *lines, const int64_t *line_offsets,
             caps_out + (size_t)i * 2 * max_caps, max_caps, ncaps_out + i);
     }
 }
+
+/* ---------------- fused parser path (dm_parse_batch) ----------------
+ *
+ * One C pass for the MatcherParser batch hot path: LogSchema payload ->
+ * (logID, log) -> log_format header extraction -> content normalization ->
+ * template match + wildcard captures -> serialized ParserSchema bytes.
+ * Profiled before this kernel existed, the Python batch path spent its
+ * ~12 us/line roughly 31% building pb2 outputs, 23% in the header regex,
+ * 14% marshalling for the match kernel, and the rest in decode/serialize —
+ * all of it fused here.
+ *
+ * Exactness contract: every row this kernel EMITS is field-identical to
+ * what the Python path produces (pinned by tests/test_native_kernels.py);
+ * any row it cannot guarantee that for gets status -1 and the caller
+ * re-runs it through the Python path:
+ *   - payloads that are not LogSchema protobufs in accept_raw mode
+ *     (JSON records, invalid UTF-8 — Python applies its own fallbacks),
+ *   - strict-mode parse failures (Python raises/counts the exact error),
+ *   - lowercase normalization on non-ASCII content (str.lower() is
+ *     Unicode-aware, C is not),
+ *   - lines whose ASCII bytes are all whitespace but that carry high
+ *     bytes (str.strip() knows Unicode whitespace),
+ *   - capture-buffer overflow in the template matcher.
+ * Header extraction needs no backtracking fallback: with anchored-prefix /
+ * leftmost-middle / anchored-suffix literal placement, a failure is
+ * definitive and a success is exactly what the non-greedy regex commits to
+ * (later literal occurrences only shrink the room for the rest).
+ *
+ * Status codes: 1 emitted, 0 filtered (blank line -> None), -1 Python.
+ */
+
+static int utf8_valid(const uint8_t *s, int len) {
+    int i = 0;
+    while (i < len) {
+        uint8_t c = s[i];
+        if (c < 0x80) { i++; continue; }
+        int n;
+        uint32_t cp;
+        if ((c & 0xE0) == 0xC0) { n = 1; cp = c & 0x1F; }
+        else if ((c & 0xF0) == 0xE0) { n = 2; cp = c & 0x0F; }
+        else if ((c & 0xF8) == 0xF0) { n = 3; cp = c & 0x07; }
+        else return 0;
+        if (i + n >= len) return 0;             /* truncated sequence */
+        for (int k = 1; k <= n; k++) {
+            if ((s[i + k] & 0xC0) != 0x80) return 0;
+            cp = (cp << 6) | (s[i + k] & 0x3F);
+        }
+        if (n == 1 && cp < 0x80) return 0;
+        if (n == 2 && (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF))) return 0;
+        if (n == 3 && (cp < 0x10000 || cp > 0x10FFFF)) return 0;
+        i += n + 1;
+    }
+    return 1;
+}
+
+/* 0 = non-blank, 1 = blank (all ASCII whitespace), -1 = ambiguous (only
+ * whitespace ASCII but high bytes present: Python's Unicode strip() may
+ * still blank it). Python str.strip() whitespace includes \x1c-\x1f. */
+static int blank_class(const uint8_t *s, int len) {
+    int high = 0;
+    for (int i = 0; i < len; i++) {
+        uint8_t c = s[i];
+        if (c >= 0x80) { high = 1; continue; }
+        if (!(c == ' ' || (c >= 0x09 && c <= 0x0D) || (c >= 0x1C && c <= 0x1F)))
+            return 0;
+    }
+    return high ? -1 : 1;
+}
+
+static const uint8_t *find_lit(const uint8_t *hay, const uint8_t *end,
+                               const uint8_t *lit, int lit_len) {
+    for (const uint8_t *q = hay; q + lit_len <= end; q++)
+        if (memcmp(q, lit, (size_t)lit_len) == 0) return q;
+    return NULL;
+}
+
+static int is_ascii_punct(uint8_t c) {  /* string.punctuation */
+    return (c >= '!' && c <= '/') || (c >= ':' && c <= '@') ||
+           (c >= '[' && c <= '`') || (c >= '{' && c <= '~');
+}
+
+/* Apply remove_spaces / remove_punctuation piecewise OUTSIDE "<*>"
+ * occurrences (the Python _normalize splits on the wildcard and rejoins);
+ * lowercase applies to the whole string (ASCII-only — caller guarantees
+ * no high bytes when the flag is set). Order matches Python: lowercase,
+ * then punctuation, then spaces. Writes to dst, returns new length
+ * (never longer than len). */
+#define NORM_SPACES 1
+#define NORM_PUNCT 2
+#define NORM_LOWER 4
+
+static int normalize_span(const uint8_t *s, int len, uint8_t *dst, int flags) {
+    int o = 0;
+    int i = 0;
+    while (i < len) {
+        if (len - i >= 3 && s[i] == '<' && s[i + 1] == '*' && s[i + 2] == '>') {
+            dst[o++] = '<'; dst[o++] = '*'; dst[o++] = '>';
+            i += 3;
+            continue;
+        }
+        uint8_t c = s[i++];
+        if ((flags & NORM_LOWER) && c >= 'A' && c <= 'Z') c += 32;
+        if ((flags & NORM_PUNCT) && is_ascii_punct(c)) continue;
+        if ((flags & NORM_SPACES) && c == ' ') continue;
+        dst[o++] = c;
+    }
+    return o;
+}
+
+/* -- minimal protobuf emit helpers -- */
+static inline int64_t emit_varint(uint8_t *out, int64_t o, uint64_t v) {
+    while (v >= 0x80) { out[o++] = (uint8_t)(v | 0x80); v >>= 7; }
+    out[o++] = (uint8_t)v;
+    return o;
+}
+
+static inline int64_t emit_str(uint8_t *out, int64_t o, uint32_t field,
+                               const uint8_t *s, int len) {
+    o = emit_varint(out, o, (uint64_t)(field << 3) | 2);
+    o = emit_varint(out, o, (uint64_t)len);
+    memcpy(out + o, s, (size_t)len);
+    return o + len;
+}
+
+static inline int64_t emit_i32(uint8_t *out, int64_t o, uint32_t field,
+                               int32_t v) {
+    o = emit_varint(out, o, (uint64_t)(field << 3));
+    /* int32 wire format sign-extends negatives to 64 bits (10-byte varint
+     * for EventID = -1), exactly like upb */
+    return emit_varint(out, o, (uint64_t)(int64_t)v);
+}
+
+static int64_t varint_size(uint64_t v) {
+    int64_t n = 1;
+    while (v >= 0x80) { v >>= 7; n++; }
+    return n;
+}
+
+int64_t dm_parse_batch(
+    const uint8_t *payloads, const int64_t *offsets, int n, int accept_raw,
+    /* log_format: n_lits literal segments, n_lits-1 captures between them
+       (n_lits == 0 => no log_format configured) */
+    const uint8_t *lit_data, const int64_t *lit_offsets, int n_lits,
+    const uint8_t *name_data, const int64_t *name_offsets,
+    int content_cap, /* index of the capture named Content, -1 = none */
+    int norm_flags,
+    /* pre-normalized template segments (TemplateMatcher layout) + the raw
+       template strings for the output's template field */
+    const uint8_t *seg_data, const int64_t *seg_offsets,
+    const int32_t *seg_counts, const uint8_t *starts_wild,
+    const uint8_t *ends_wild, int n_templates,
+    const uint8_t *tmpl_data, const int64_t *tmpl_offsets,
+    int max_caps,
+    /* constants + per-batch entropy */
+    const uint8_t *version, int version_len,
+    const uint8_t *parser_type, int parser_type_len,
+    const uint8_t *parser_id, int parser_id_len,
+    int64_t now, const uint8_t *rand_hex, /* n * 32 hex chars */
+    uint8_t *out_buf, int64_t out_cap, int64_t *out_offsets, int8_t *status)
+{
+    int n_caps_fmt = n_lits > 0 ? n_lits - 1 : 0;
+    int64_t o = 0;
+    out_offsets[0] = 0;
+    /* scratch for normalized content: grown to the largest payload */
+    int scratch_cap = 0;
+    uint8_t *scratch = NULL;
+    int32_t *tcaps = (int32_t *)malloc(sizeof(int32_t) * 2 * (size_t)(max_caps > 0 ? max_caps : 1));
+    if (!tcaps) return -1;
+
+    for (int i = 0; i < n; i++) {
+        const uint8_t *pay = payloads + offsets[i];
+        int pay_len = (int)(offsets[i + 1] - offsets[i]);
+        status[i] = -1; /* default: Python handles it */
+
+        /* 1. LogSchema decode (fields: logID=2, log=3; presence of 1-5) */
+        const uint8_t *log = NULL; int log_len = 0;
+        const uint8_t *log_id = NULL; int log_id_len = 0;
+        int presence = 0, parse_ok = 1;
+        {
+            cursor_t c = { pay, pay + pay_len };
+            while (c.p < c.end) {
+                uint64_t tag;
+                if (!read_varint(&c, &tag)) { parse_ok = 0; break; }
+                uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+                if (field == 0) { parse_ok = 0; break; }
+                if (wt == 2 && (field == 2 || field == 3)) {
+                    uint64_t l;
+                    if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { parse_ok = 0; break; }
+                    if (field == 2) { log_id = c.p; log_id_len = (int)l; }
+                    else { log = c.p; log_len = (int)l; }
+                    c.p += l;
+                    presence = 1;
+                } else {
+                    /* presence mirrors HasField(): only a CORRECT wire type
+                     * (all LogSchema fields 1-5 are strings, wt 2) counts —
+                     * a wrong-wire-type field is an unknown field to proto3
+                     * and must not make a payload look like an envelope */
+                    if (wt == 2 && field >= 1 && field <= 5) presence = 1;
+                    if (!skip_field(&c, wt)) { parse_ok = 0; break; }
+                }
+            }
+        }
+        if (parse_ok && (!accept_raw || presence)) {
+            if (log == NULL) { log = pay; log_len = 0; }
+            if (log_id == NULL) { log_id = pay; log_id_len = 0; }
+        } else if (accept_raw) {
+            /* raw-line shape: JSON records go to Python; strip ONE
+             * trailing newline (the single_value formatter's add_newline) */
+            if (pay_len > 0 && pay[0] == '{') { out_offsets[i + 1] = o; continue; }
+            log = pay; log_len = pay_len;
+            if (log_len > 0 && log[log_len - 1] == '\n') log_len--;
+            log_id = pay; log_id_len = 0;
+        } else {
+            out_offsets[i + 1] = o; continue; /* strict parse error -> Python */
+        }
+        if (!utf8_valid(log, log_len) || !utf8_valid(log_id, log_id_len)) {
+            out_offsets[i + 1] = o; continue;
+        }
+
+        /* 2. blank filter (Python: `if not log_line.strip(): return None`) */
+        int bc = blank_class(log, log_len);
+        if (bc == -1) { out_offsets[i + 1] = o; continue; }
+        if (bc == 1) { status[i] = 0; out_offsets[i + 1] = o; continue; }
+
+        /* Embedded newlines change the regex semantics the header
+         * extraction mirrors (Python's `.` never crosses `\n`, and `$`
+         * also matches BEFORE a trailing newline) — those rows go to
+         * Python rather than risking divergent captures. Rare: upstream
+         * tailers split on newlines. */
+        if (memchr(log, '\n', (size_t)log_len) != NULL) {
+            out_offsets[i + 1] = o; continue;
+        }
+
+        /* 3. header extraction */
+        const uint8_t *caps_s[64]; int caps_l[64];
+        int n_caps = 0, header_matched = 0;
+        if (n_lits > 0 && n_caps_fmt <= 64) {
+            const uint8_t *pos = log;
+            const uint8_t *end = log + log_len;
+            const uint8_t *lit0 = lit_data + lit_offsets[0];
+            int lit0_len = (int)(lit_offsets[1] - lit_offsets[0]);
+            int okflag = 1;
+            if (lit0_len > 0) {
+                if (end - pos < lit0_len || memcmp(pos, lit0, (size_t)lit0_len) != 0)
+                    okflag = 0;
+                else
+                    pos += lit0_len;
+            }
+            for (int c = 0; okflag && c < n_caps_fmt; c++) {
+                const uint8_t *lit = lit_data + lit_offsets[c + 1];
+                int lit_len = (int)(lit_offsets[c + 2] - lit_offsets[c + 1]);
+                if (c == n_caps_fmt - 1) {
+                    if (lit_len == 0) {
+                        caps_s[c] = pos; caps_l[c] = (int)(end - pos);
+                        pos = end;
+                    } else if (end - log >= lit_len &&
+                               end - lit_len >= pos &&
+                               memcmp(end - lit_len, lit, (size_t)lit_len) == 0) {
+                        caps_s[c] = pos; caps_l[c] = (int)(end - lit_len - pos);
+                        pos = end;
+                    } else {
+                        okflag = 0;
+                    }
+                } else if (lit_len == 0) {
+                    caps_s[c] = pos; caps_l[c] = 0; /* adjacent captures */
+                } else {
+                    const uint8_t *found = find_lit(pos, end, lit, lit_len);
+                    if (!found) { okflag = 0; break; }
+                    caps_s[c] = pos; caps_l[c] = (int)(found - pos);
+                    pos = found + lit_len;
+                }
+            }
+            if (okflag && n_caps_fmt == 0) {
+                /* capture-free format: anchored whole-line equality */
+                okflag = (lit0_len == log_len);
+            }
+            if (okflag) { header_matched = 1; n_caps = n_caps_fmt; }
+        } else if (n_lits > 0) {
+            out_offsets[i + 1] = o; continue; /* >64 captures: Python */
+        }
+
+        const uint8_t *content = log; int content_len = log_len;
+        if (header_matched && content_cap >= 0 && content_cap < n_caps) {
+            content = caps_s[content_cap];
+            content_len = caps_l[content_cap];
+        }
+
+        /* 4. normalize content for matching */
+        if ((norm_flags & NORM_LOWER)) {
+            int high = 0;
+            for (int k = 0; k < content_len; k++)
+                if (content[k] >= 0x80) { high = 1; break; }
+            if (high) { out_offsets[i + 1] = o; continue; } /* Unicode lower() */
+        }
+        const uint8_t *norm = content; int norm_len = content_len;
+        if (norm_flags) {
+            if (content_len > scratch_cap) {
+                free(scratch);
+                scratch_cap = content_len * 2 + 256;
+                scratch = (uint8_t *)malloc((size_t)scratch_cap);
+                if (!scratch) { free(tcaps); return -1; }
+            }
+            norm_len = normalize_span(content, content_len, scratch, norm_flags);
+            norm = scratch;
+        }
+
+        /* 5. template match + captures */
+        int event_id = -1;
+        const uint8_t *tmpl = NULL; int tmpl_len = 0;
+        int32_t tn_caps = 0;
+        if (n_templates > 0) {
+            int idx = match_extract_one(norm, norm_len, seg_data, seg_offsets,
+                                        seg_counts, starts_wild, ends_wild,
+                                        n_templates, tcaps, max_caps, &tn_caps);
+            if (idx == -2) { out_offsets[i + 1] = o; continue; }
+            if (idx >= 0) {
+                event_id = idx + 1;
+                tmpl = tmpl_data + tmpl_offsets[idx];
+                tmpl_len = (int)(tmpl_offsets[idx + 1] - tmpl_offsets[idx]);
+            }
+        }
+
+        /* 6. capacity check then emit */
+        int64_t names_total = n_caps ? (name_offsets[n_caps] - name_offsets[0]) : 0;
+        int64_t bound = 64 + version_len + parser_type_len + 2 * parser_id_len
+            + tmpl_len + 32 + log_id_len + names_total + (int64_t)log_len
+            + (int64_t)norm_len + 16LL * (n_caps + (int64_t)tn_caps)
+            + varint_size((uint64_t)now) * 2 + 20;
+        if (o + bound > out_cap) { free(scratch); free(tcaps); return -1; }
+
+        o = emit_str(out_buf, o, 1, version, version_len);
+        o = emit_str(out_buf, o, 2, parser_type, parser_type_len);
+        o = emit_str(out_buf, o, 3, parser_id, parser_id_len);
+        o = emit_i32(out_buf, o, 4, event_id);
+        o = emit_str(out_buf, o, 5, tmpl ? tmpl : (const uint8_t *)"", tmpl_len);
+        for (int k = 0; k < tn_caps; k++)
+            o = emit_str(out_buf, o, 6, norm + tcaps[2 * k],
+                         tcaps[2 * k + 1] - tcaps[2 * k]);
+        o = emit_str(out_buf, o, 7, rand_hex + (int64_t)i * 32, 32);
+        o = emit_str(out_buf, o, 8, log_id, log_id_len);
+        o = emit_str(out_buf, o, 9, parser_id, parser_id_len);
+        for (int k = 0; k < n_caps; k++) {
+            const uint8_t *key = name_data + name_offsets[k];
+            int key_len = (int)(name_offsets[k + 1] - name_offsets[k]);
+            /* duplicate capture names collapse like dict(zip(names, caps)):
+             * ONE map entry at the first occurrence's position carrying the
+             * LAST occurrence's value — emitting every capture would put
+             * extra wire entries the Python path never serializes (and the
+             * featurizer tokenizes raw wire entries, so downstream features
+             * would diverge by parser path) */
+            int first = 1;
+            for (int j = 0; j < k && first; j++)
+                if ((int)(name_offsets[j + 1] - name_offsets[j]) == key_len &&
+                    memcmp(name_data + name_offsets[j], key, (size_t)key_len) == 0)
+                    first = 0;
+            if (!first) continue;
+            int vidx = k;
+            for (int j = k + 1; j < n_caps; j++)
+                if ((int)(name_offsets[j + 1] - name_offsets[j]) == key_len &&
+                    memcmp(name_data + name_offsets[j], key, (size_t)key_len) == 0)
+                    vidx = j;
+            int64_t sub_len = 1 + varint_size((uint64_t)key_len) + key_len
+                + 1 + varint_size((uint64_t)caps_l[vidx]) + caps_l[vidx];
+            o = emit_varint(out_buf, o, (10u << 3) | 2);
+            o = emit_varint(out_buf, o, (uint64_t)sub_len);
+            o = emit_str(out_buf, o, 1, key, key_len);
+            o = emit_str(out_buf, o, 2, caps_s[vidx], caps_l[vidx]);
+        }
+        o = emit_i32(out_buf, o, 11, (int32_t)now);
+        o = emit_i32(out_buf, o, 12, (int32_t)now);
+        status[i] = 1;
+        out_offsets[i + 1] = o;
+    }
+    free(scratch);
+    free(tcaps);
+    return o;
+}

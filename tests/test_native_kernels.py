@@ -130,3 +130,257 @@ class TestMapOverflowParity:
         det._featurize_python_rows([raw], tokens_py, ok_py, [0])
         assert ok_py.all()
         np.testing.assert_array_equal(tokens_native, tokens_py)
+
+
+class TestParseBatchKernelParity:
+    """dm_parse_batch (round 5): the fused MatcherParser row — decode +
+    header extraction + normalization + template match + ParserSchema
+    encode — must be FIELD-IDENTICAL to the Python batch path for every
+    row it emits, and must flag (not guess at) everything else."""
+
+    AUDIT_FORMAT = "type=<Type> msg=audit(<Time>): <Content>"
+
+    def _parser(self, tmp_path, templates=None, **params):
+        import yaml
+
+        from detectmateservice_tpu.library.parsers.template_matcher import (
+            MatcherParser,
+        )
+
+        cfg = {"method_type": "matcher_parser", "auto_config": False,
+               "log_format": params.pop("log_format", self.AUDIT_FORMAT),
+               "params": {"remove_spaces": False, **params}}
+        if templates is not None:
+            tf = tmp_path / "templates.txt"
+            tf.write_text("\n".join(templates) + "\n")
+            cfg["params"]["path_templates"] = str(tf)
+        parser = MatcherParser(config={"parsers": {"MatcherParser": cfg}})
+        assert parser._parse_native is not None, "fused kernel must be active"
+        return parser
+
+    @staticmethod
+    def _fields(raw):
+        from detectmateservice_tpu.schemas import schemas_pb2 as pb
+
+        if raw is None:
+            return None
+        m = pb.ParserSchema()
+        m.ParseFromString(raw)
+        # parsedLogID is random and the timestamps can straddle a second —
+        # assert their SHAPE, compare everything else exactly
+        assert len(m.parsedLogID) == 32 and int(m.parsedLogID, 16) >= 0
+        assert m.receivedTimestamp > 1_700_000_000
+        assert m.parsedTimestamp == m.receivedTimestamp
+        return {
+            "version": getattr(m, "__version__"),
+            "parserType": m.parserType, "parserID": m.parserID,
+            "EventID": m.EventID, "template": m.template,
+            "variables": list(m.variables), "logID": m.logID, "log": m.log,
+            "map": dict(m.logFormatVariables),
+        }
+
+    def _assert_parity(self, parser, payloads):
+        errors = []
+        parser.count_processing_errors = (      # capture, don't metric
+            lambda n, what: errors.append(n))
+        native = parser.process_batch(list(payloads))
+        n_err_native = sum(errors)
+        errors.clear()
+        python = parser._process_batch_python(list(payloads))
+        n_err_python = sum(errors)
+        assert len(native) == len(python)
+        for i, (a, b) in enumerate(zip(native, python)):
+            assert self._fields(a) == self._fields(b), f"row {i} diverged"
+        assert n_err_native == n_err_python
+        return native
+
+    def audit_payloads(self, n=64):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        return [LogSchema(logID=str(i),
+                          log=f'type=SYSCALL msg=audit(17000{i % 7}.{i}): '
+                              f'arch=c000003e syscall={i % 30} pid={300 + i} '
+                              f'uid={i % 3} comm="cron"').serialize()
+                for i in range(n)]
+
+    def test_standard_audit_flow_with_templates(self, tmp_path):
+        parser = self._parser(tmp_path, templates=[
+            "arch=<*> syscall=<*> pid=<*> uid=<*> comm=<*>",
+            "connection closed",
+        ])
+        out = self._assert_parity(parser, self.audit_payloads())
+        assert all(o is not None for o in out)
+        assert self._fields(out[0])["EventID"] == 1
+
+    def test_no_templates_event_id_minus_one(self, tmp_path):
+        """EventID -1 exercises the negative-int32 varint encoding (upb
+        sign-extends to 64 bits; a 32-bit encoder would corrupt it)."""
+        parser = self._parser(tmp_path)
+        out = self._assert_parity(parser, self.audit_payloads(8))
+        assert self._fields(out[0])["EventID"] == -1
+
+    def test_no_log_format(self, tmp_path):
+        parser = self._parser(tmp_path, log_format=None,
+                              templates=["type=<*> msg=audit(<*>): <*>"])
+        self._assert_parity(parser, self.audit_payloads(8))
+
+    def test_header_mismatch_keeps_whole_line_as_content(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, templates=["no match here"])
+        payloads = [LogSchema(logID="1", log="completely different shape").serialize()]
+        out = self._assert_parity(parser, payloads)
+        assert self._fields(out[0])["map"] == {}
+
+    def test_blank_lines_filtered(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path)
+        payloads = [LogSchema(logID="1", log="   \t \n").serialize(),
+                    LogSchema(logID="2", log="").serialize()]
+        out = self._assert_parity(parser, payloads)
+        assert out == [None, None]
+
+    def test_undecodable_strict_counts_errors(self, tmp_path):
+        parser = self._parser(tmp_path)
+        self._assert_parity(parser, [b"\xff\xfe garbage \xff",
+                                     *self.audit_payloads(2)])
+
+    def test_accept_raw_bare_line_and_json(self, tmp_path):
+        parser = self._parser(tmp_path, accept_raw_lines=True,
+                              templates=["type=<*> msg=audit(<*>): <*>"])
+        line = b'type=LOGIN msg=audit(1700.5): pid=9 uid=1\n'
+        json_rec = (b'{"message": "type=LOGIN msg=audit(1700.9): pid=7 uid=0",'
+                    b' "logSource": "/var/log/a", "hostname": "h1"}\n')
+        out = self._assert_parity(parser, [line, json_rec,
+                                           *self.audit_payloads(2)])
+        assert all(o is not None for o in out)
+        assert self._fields(out[0])["map"]["Time"] == "1700.5"
+        assert self._fields(out[1])["map"]["Time"] == "1700.9"
+
+    def test_unicode_content_in_captures(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path,
+                              templates=["user=<*> action=<*>"])
+        payloads = [LogSchema(logID="u", log=(
+            "type=AUTH msg=audit(1.1): user=Jürgen-日本 action=ログイン"
+        )).serialize()]
+        out = self._assert_parity(parser, payloads)
+        f = self._fields(out[0])
+        assert f["variables"] == ["Jürgen-日本", "ログイン"]
+
+    def test_normalization_flags_ascii(self, tmp_path):
+        parser = self._parser(tmp_path, lowercase=True,
+                              remove_punctuation=True, remove_spaces=True,
+                              templates=["archc000003esyscall<*>pid<*>uid<*>commcron"])
+        self._assert_parity(parser, self.audit_payloads(16))
+
+    def test_lowercase_nonascii_falls_back_identically(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, lowercase=True,
+                              templates=["straße <*>"])
+        payloads = [LogSchema(logID="1",
+                              log="type=X msg=audit(1.0): STRASSE Straße 7").serialize()]
+        self._assert_parity(parser, payloads)
+
+    def test_format_ending_with_capture_is_greedy(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, log_format="<Level>: <Rest>")
+        payloads = [LogSchema(logID="1", log="WARN: a: b: c").serialize()]
+        out = self._assert_parity(parser, payloads)
+        assert self._fields(out[0])["map"] == {"Level": "WARN", "Rest": "a: b: c"}
+
+    def test_format_with_leading_capture_and_suffix_literal(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, log_format="<Head> end")
+        payloads = [LogSchema(logID="1", log="x end y end").serialize(),
+                    LogSchema(logID="2", log="no suffix").serialize()]
+        out = self._assert_parity(parser, payloads)
+        # non-greedy + anchored suffix: capture runs to the LAST ' end'
+        assert self._fields(out[0])["map"] == {"Head": "x end y"}
+
+    def test_adjacent_captures(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, log_format="<A><B> tail")
+        payloads = [LogSchema(logID="1", log="payload tail").serialize()]
+        out = self._assert_parity(parser, payloads)
+        # non-greedy first capture is empty; second takes the span
+        assert self._fields(out[0])["map"] == {"A": "", "B": "payload"}
+
+    def test_duplicate_capture_names_last_wins(self, tmp_path):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, log_format="<X>-<X>")
+        payloads = [LogSchema(logID="1", log="first-second").serialize()]
+        out = self._assert_parity(parser, payloads)
+        assert self._fields(out[0])["map"] == {"X": "second"}
+
+    def test_single_process_matches_native_batch_fields(self, tmp_path):
+        parser = self._parser(tmp_path, templates=[
+            "arch=<*> syscall=<*> pid=<*> uid=<*> comm=<*>"])
+        payload = self.audit_payloads(1)[0]
+        single = parser.process(payload)
+        batch = parser.process_batch([payload])[0]
+        assert self._fields(single) == self._fields(batch)
+
+    def test_trailing_newline_in_envelope_log_matches_python(self, tmp_path):
+        """Python's `$` matches before a trailing newline and `.` never
+        crosses one — newline-bearing logs must take the Python path (and
+        so produce identical captures), not diverge natively."""
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, templates=["pid=<*> uid=<*>"])
+        payloads = [
+            LogSchema(logID="1", log="type=X msg=audit(1.0): pid=7 uid=0\n").serialize(),
+            LogSchema(logID="2", log="type=X msg=audit(1.0): pid=8\nuid=1").serialize(),
+        ]
+        self._assert_parity(parser, payloads)
+
+    def test_wrong_wire_type_fields_are_not_envelopes(self, tmp_path):
+        """A payload whose only recognizable field numbers carry the WRONG
+        wire type parses with all HasField false — in accept_raw mode it is
+        a bare line, never an empty envelope (which would filter it)."""
+        parser = self._parser(tmp_path, accept_raw_lines=True,
+                              log_format=None)
+        # field 5 (hostname, declared string) encoded as varint: Python
+        # treats it as unknown -> bare-line path; it is also printable text
+        payload = b"\x28\x31"  # tag(5,varint) + value 0x31 — also text "(1"
+        out = self._assert_parity(parser, [payload])
+        assert out[0] is not None  # processed as a line, not dropped
+
+    def test_duplicate_names_serialize_one_wire_entry(self, tmp_path):
+        """Byte-level: duplicate capture names must not put extra map
+        entries on the wire (the featurizer tokenizes raw wire entries, so
+        extra entries would skew downstream features by parser path)."""
+        from detectmateservice_tpu.schemas import LogSchema
+
+        parser = self._parser(tmp_path, log_format="<X>-<X>")
+        out = parser.process_batch(
+            [LogSchema(logID="1", log="first-second").serialize()])
+        raw = out[0]
+        n_map_entries = 0
+        i = 0
+        while i < len(raw):  # count top-level field-10 tags
+            tag = raw[i]
+            if tag == (10 << 3) | 2:
+                n_map_entries += 1
+            i += 1
+            if tag & 7 == 2:  # LEN field: skip its payload
+                ln = 0
+                shift = 0
+                while raw[i] & 0x80:
+                    ln |= (raw[i] & 0x7F) << shift
+                    shift += 7
+                    i += 1
+                ln |= raw[i] << shift
+                i += 1 + ln
+            elif tag & 7 == 0:
+                while raw[i] & 0x80:
+                    i += 1
+                i += 1
+        assert n_map_entries == 1
