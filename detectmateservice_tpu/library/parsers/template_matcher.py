@@ -338,6 +338,14 @@ class MatcherParser(CoreComponent):
 
     def _process_batch_native(self, batch: List[bytes]) -> List[Optional[bytes]]:
         status, blob, ends = self._parse_native.parse_batch(batch)
+        return self._assemble_native_outputs(status, ends, blob,
+                                             batch.__getitem__)
+
+    def _assemble_native_outputs(self, status, ends, blob, raw_fn):
+        """Shared status→outputs dispatch for the batch and frames kernels:
+        1 = emitted bytes, 0 = filtered (None), -1 = re-run the row's raw
+        payload (``raw_fn(i)``) through the exact-semantics Python path,
+        counting its decode errors once per batch."""
         outs: List[Optional[bytes]] = []
         decode_errors = 0
         status_list = status.tolist()
@@ -348,13 +356,54 @@ class MatcherParser(CoreComponent):
             elif st == 0:
                 outs.append(None)   # blank line: filtered
             else:
-                out, err = self._parse_row_python(batch[i])
+                out, err = self._parse_row_python(raw_fn(i))
                 decode_errors += err
                 outs.append(out)
         if decode_errors:
             self.count_processing_errors(decode_errors,
                                          "undecodable LogSchema message(s)")
         return outs
+
+    def process_frames(self, frames: List[bytes]):
+        """Fused wire-frame hot path (engine contract, opt-in): RAW wire
+        frames in, ``(outputs, n_messages, n_lines)`` out — the parser
+        service's analog of the detector's ``process_frames``. Frame
+        expansion AND the whole parse row run in one C pass
+        (``dm_parse_frames``); the engine loop holds no per-message Python
+        objects. Without the kernel — including an older committed library
+        that has dm_parse_batch but not the frames symbol — frames expand
+        in Python and delegate to ``process_batch``: same semantics,
+        classic costs, never a dropped burst."""
+        if self._parse_native is None or not self._parse_native.supports_frames:
+            from ...engine.framing import FramingError, unpack_batch
+
+            msgs: List[bytes] = []
+            n_corrupt = 0
+            for frame in frames:
+                try:
+                    unpacked = unpack_batch(frame)
+                except FramingError:
+                    n_corrupt += 1
+                    continue
+                if unpacked is None:
+                    if frame:
+                        msgs.append(frame)
+                else:
+                    msgs.extend(m for m in unpacked if m)
+            if n_corrupt:
+                self.count_processing_errors(n_corrupt,
+                                             "corrupt batch frame(s)")
+            n_lines = sum(
+                max(1, d.count(b"\n") + (0 if d.endswith(b"\n") else 1))
+                for d in msgs)
+            return self.process_batch(msgs), len(msgs), n_lines
+        pf = self._parse_native.parse_frames(frames)
+        if pf.n_corrupt_frames:
+            self.count_processing_errors(pf.n_corrupt_frames,
+                                         "corrupt batch frame(s)")
+        outs = self._assemble_native_outputs(pf.status, pf.ends, pf.out_blob,
+                                             pf.raw)
+        return outs, len(pf.status), pf.n_lines
 
     def _parse_row_python(self, data: bytes):
         """Exact-semantics fallback for one kernel-flagged row: the batch

@@ -133,6 +133,28 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int8),
         ]
         lib.dm_parse_batch.restype = ctypes.c_int64
+    if hasattr(lib, "dm_parse_frames"):
+        lib.dm_parse_frames.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int8),
+        ]
+        lib.dm_parse_frames.restype = ctypes.c_int64
     return lib
 
 
@@ -141,6 +163,12 @@ _lib = _load()
 _I64P = ctypes.POINTER(ctypes.c_int64)
 _I32P = ctypes.POINTER(ctypes.c_int32)
 _U8P = ctypes.POINTER(ctypes.c_uint8)
+
+# 1-element placeholders handed to the parse kernels when no template
+# matcher is configured (n_templates == 0: the C side never dereferences)
+_ZERO_I64 = np.zeros(1, dtype=np.int64)
+_ZERO_I32 = np.zeros(1, dtype=np.int32)
+_ZERO_U8 = np.zeros(1, dtype=np.uint8)
 
 
 def _pack(chunks: Sequence[bytes]) -> Tuple[bytes, np.ndarray]:
@@ -435,6 +463,36 @@ class ParseKernel:
         self._names_total = int(self._name_offsets[-1])
         self._tmpl_max = max((len(t.encode()) for t in raw_templates),
                              default=0)
+        # an older committed library can carry dm_parse_batch without the
+        # frames variant; callers must check before routing frames here
+        self.supports_frames = hasattr(_lib, "dm_parse_frames")
+
+    def _seg_args(self):
+        """The 7-tuple of template-matcher arrays (or the empty stub)."""
+        m = self._matcher
+        if m is not None:
+            return (m._seg_blob, m._seg_offsets_p, m._counts_p,
+                    m._starts_p, m._ends_p, len(m._templates), m._max_caps)
+        return (b"", _ZERO_I64.ctypes.data_as(_I64P),
+                _ZERO_I32.ctypes.data_as(_I32P),
+                _ZERO_U8.ctypes.data_as(_U8P),
+                _ZERO_U8.ctypes.data_as(_U8P), 0, 1)
+
+    def _run_with_capacity(self, blob_len: int, n_rows: int, invoke):
+        """Allocate the output buffer from the shared worst-case estimate
+        and retry the C call with a grown buffer while it reports
+        insufficient capacity. ``invoke(out_array, cap) -> used`` (< 0 means
+        too small). ONE home for the estimate and the retry policy — the
+        batch and frames entry points must never diverge on them."""
+        cap = int(blob_len * 2 + n_rows * (256 + self._tmpl_max
+                                           + self._names_total) + 1024)
+        for _ in range(4):
+            out = np.empty(cap, dtype=np.uint8)
+            used = invoke(out, cap)
+            if used >= 0:
+                return out[:used].tobytes()
+            cap *= 4
+        raise MemoryError("parse kernel output buffer kept overflowing")
 
     def parse_batch(self, payloads: Sequence[bytes]):
         """→ (status int8 array, out blob bytes, offsets int64 array)."""
@@ -447,21 +505,11 @@ class ParseKernel:
         out_offsets = np.zeros(n + 1, dtype=np.int64)
         rand_hex = os.urandom(16 * n).hex().encode() if n else b""
         now = int(time.time())
-        m = self._matcher
-        if m is not None:
-            seg = (m._seg_blob, m._seg_offsets_p, m._counts_p,
-                   m._starts_p, m._ends_p, len(m._templates), m._max_caps)
-        else:
-            seg = (b"", _ZERO_I64.ctypes.data_as(_I64P),
-                   _ZERO_I32.ctypes.data_as(_I32P),
-                   _ZERO_U8.ctypes.data_as(_U8P),
-                   _ZERO_U8.ctypes.data_as(_U8P), 0, 1)
+        seg = self._seg_args()
         version, method_type, parser_id = self._consts
-        cap = int(len(blob) * 2 + n * (256 + self._tmpl_max
-                                       + self._names_total) + 1024)
-        for _ in range(4):
-            out = np.empty(cap, dtype=np.uint8)
-            used = int(_lib.dm_parse_batch(
+
+        def invoke(out, cap):
+            return int(_lib.dm_parse_batch(
                 blob, offsets.ctypes.data_as(_I64P), n, self._accept_raw,
                 self._lit_blob, self._lit_offsets_p, self._n_lits,
                 self._name_blob, self._name_offsets_p,
@@ -474,14 +522,81 @@ class ParseKernel:
                 out.ctypes.data_as(_U8P), cap,
                 out_offsets.ctypes.data_as(_I64P),
                 status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))))
-            if used >= 0:
-                # slice BEFORE materializing: tobytes() on the full
-                # capacity-sized array would memcpy cap bytes per call
-                return status, out[:used].tobytes(), out_offsets
-            cap *= 4
-        raise MemoryError("dm_parse_batch output buffer kept overflowing")
+
+        out_blob = self._run_with_capacity(len(blob), n, invoke)
+        return status, out_blob, out_offsets
+
+    def parse_frames(self, frames: Sequence[bytes]) -> "ParsedFrames":
+        """Wire frames (packed batch frames and/or single messages) →
+        serialized ParserSchema bytes per contained message, one C crossing
+        for the whole burst (count pass + dm_parse_frames) — the parser
+        service's analog of the detector's featurize_frames."""
+        import os
+        import time
+
+        blob, offsets = _pack(frames)
+        n_frames = len(frames)
+        counts = np.zeros(n_frames, dtype=np.int32)
+        corrupt = np.zeros(n_frames, dtype=np.uint8)
+        lines = np.zeros(1, dtype=np.int64)
+        total = int(_lib.dm_count_frame_msgs(
+            blob, offsets.ctypes.data_as(_I64P), n_frames,
+            counts.ctypes.data_as(_I32P), corrupt.ctypes.data_as(_U8P),
+            lines.ctypes.data_as(_I64P)))
+        status = np.full(total, -1, dtype=np.int8)
+        out_offsets = np.zeros(total + 1, dtype=np.int64)
+        spans = np.zeros((total, 2), dtype=np.int64)
+        if total == 0:
+            return ParsedFrames(status, b"", out_offsets, blob, spans,
+                                int(corrupt.sum()), int(lines[0]))
+        rand_hex = os.urandom(16 * total).hex().encode()
+        now = int(time.time())
+        seg = self._seg_args()
+        version, method_type, parser_id = self._consts
+
+        def invoke(out, cap):
+            return int(_lib.dm_parse_frames(
+                blob, offsets.ctypes.data_as(_I64P), n_frames,
+                counts.ctypes.data_as(_I32P), corrupt.ctypes.data_as(_U8P),
+                self._accept_raw,
+                self._lit_blob, self._lit_offsets_p, self._n_lits,
+                self._name_blob, self._name_offsets_p,
+                self._content_cap, self._norm_flags,
+                seg[0], seg[1], seg[2], seg[3], seg[4], seg[5],
+                self._tmpl_blob, self._tmpl_offsets_p, seg[6],
+                version, len(version), method_type, len(method_type),
+                parser_id, len(parser_id),
+                now, rand_hex,
+                out.ctypes.data_as(_U8P), cap,
+                spans.ctypes.data_as(_I64P),
+                out_offsets.ctypes.data_as(_I64P),
+                status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))))
+
+        out_blob = self._run_with_capacity(len(blob), total, invoke)
+        return ParsedFrames(status, out_blob, out_offsets, blob, spans,
+                            int(corrupt.sum()), int(lines[0]))
 
 
-_ZERO_I64 = np.zeros(1, dtype=np.int64)
-_ZERO_I32 = np.zeros(1, dtype=np.int32)
-_ZERO_U8 = np.zeros(1, dtype=np.uint8)
+class ParsedFrames:
+    """Result of ``ParseKernel.parse_frames``: per-message outputs plus lazy
+    raw access for the fallback/error paths (same shape as FrameBatch)."""
+
+    __slots__ = ("status", "out_blob", "ends", "frames_blob", "spans",
+                 "n_corrupt_frames", "n_lines")
+
+    def __init__(self, status, out_blob, ends, frames_blob, spans,
+                 n_corrupt_frames, n_lines):
+        self.status = status              # [m] int8: 1 ok / 0 filtered / -1
+        self.out_blob = out_blob          # packed ParserSchema bytes
+        self.ends = ends                  # [m+1] prefix ends into out_blob
+        self.frames_blob = frames_blob
+        self.spans = spans                # [m, 2] raw-byte spans per message
+        self.n_corrupt_frames = n_corrupt_frames
+        self.n_lines = n_lines
+
+    def __len__(self) -> int:
+        return len(self.status)
+
+    def raw(self, i: int) -> bytes:
+        s, e = self.spans[i]
+        return self.frames_blob[s:e]

@@ -671,242 +671,369 @@ static int64_t varint_size(uint64_t v) {
     return n;
 }
 
-int64_t dm_parse_batch(
-    const uint8_t *payloads, const int64_t *offsets, int n, int accept_raw,
-    /* log_format: n_lits literal segments, n_lits-1 captures between them
-       (n_lits == 0 => no log_format configured) */
-    const uint8_t *lit_data, const int64_t *lit_offsets, int n_lits,
-    const uint8_t *name_data, const int64_t *name_offsets,
-    int content_cap, /* index of the capture named Content, -1 = none */
-    int norm_flags,
-    /* pre-normalized template segments (TemplateMatcher layout) + the raw
-       template strings for the output's template field */
-    const uint8_t *seg_data, const int64_t *seg_offsets,
-    const int32_t *seg_counts, const uint8_t *starts_wild,
-    const uint8_t *ends_wild, int n_templates,
-    const uint8_t *tmpl_data, const int64_t *tmpl_offsets,
-    int max_caps,
-    /* constants + per-batch entropy */
-    const uint8_t *version, int version_len,
-    const uint8_t *parser_type, int parser_type_len,
-    const uint8_t *parser_id, int parser_id_len,
-    int64_t now, const uint8_t *rand_hex, /* n * 32 hex chars */
-    uint8_t *out_buf, int64_t out_cap, int64_t *out_offsets, int8_t *status)
-{
-    int n_caps_fmt = n_lits > 0 ? n_lits - 1 : 0;
-    int64_t o = 0;
-    out_offsets[0] = 0;
-    /* scratch for normalized content: grown to the largest payload */
-    int scratch_cap = 0;
-    uint8_t *scratch = NULL;
-    int32_t *tcaps = (int32_t *)malloc(sizeof(int32_t) * 2 * (size_t)(max_caps > 0 ? max_caps : 1));
-    if (!tcaps) return -1;
+/* Config + output state shared by the batch and frames drivers. */
+typedef struct {
+    int accept_raw;
+    const uint8_t *lit_data; const int64_t *lit_offsets; int n_lits;
+    const uint8_t *name_data; const int64_t *name_offsets;
+    int content_cap;
+    int norm_flags;
+    const uint8_t *seg_data; const int64_t *seg_offsets;
+    const int32_t *seg_counts; const uint8_t *starts_wild;
+    const uint8_t *ends_wild; int n_templates;
+    const uint8_t *tmpl_data; const int64_t *tmpl_offsets;
+    int max_caps;
+    const uint8_t *version; int version_len;
+    const uint8_t *parser_type; int parser_type_len;
+    const uint8_t *parser_id; int parser_id_len;
+    int64_t now; const uint8_t *rand_hex;
+    uint8_t *out_buf; int64_t out_cap;
+    /* mutable per-call state */
+    int64_t o;
+    uint8_t *scratch; int scratch_cap;
+    int32_t *tcaps;
+} parse_ctx_t;
 
-    for (int i = 0; i < n; i++) {
-        const uint8_t *pay = payloads + offsets[i];
-        int pay_len = (int)(offsets[i + 1] - offsets[i]);
-        status[i] = -1; /* default: Python handles it */
+/* Parse one payload. Fills status_out (1 emitted / 0 filtered / -1 Python)
+ * and advances ctx->o. Returns 0, or -1 on out-of-capacity/OOM (caller
+ * aborts the whole call and retries with a bigger buffer). */
+static int parse_one_row(parse_ctx_t *ctx, const uint8_t *pay, int pay_len,
+                         int64_t row_idx, int8_t *status_out) {
+    int n_caps_fmt = ctx->n_lits > 0 ? ctx->n_lits - 1 : 0;
+    *status_out = -1; /* default: Python handles it */
 
-        /* 1. LogSchema decode (fields: logID=2, log=3; presence of 1-5) */
-        const uint8_t *log = NULL; int log_len = 0;
-        const uint8_t *log_id = NULL; int log_id_len = 0;
-        int presence = 0, parse_ok = 1;
-        {
-            cursor_t c = { pay, pay + pay_len };
-            while (c.p < c.end) {
-                uint64_t tag;
-                if (!read_varint(&c, &tag)) { parse_ok = 0; break; }
-                uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
-                if (field == 0) { parse_ok = 0; break; }
-                if (wt == 2 && (field == 2 || field == 3)) {
-                    uint64_t l;
-                    if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { parse_ok = 0; break; }
-                    if (field == 2) { log_id = c.p; log_id_len = (int)l; }
-                    else { log = c.p; log_len = (int)l; }
-                    c.p += l;
-                    presence = 1;
-                } else {
-                    /* presence mirrors HasField(): only a CORRECT wire type
-                     * (all LogSchema fields 1-5 are strings, wt 2) counts —
-                     * a wrong-wire-type field is an unknown field to proto3
-                     * and must not make a payload look like an envelope */
-                    if (wt == 2 && field >= 1 && field <= 5) presence = 1;
-                    if (!skip_field(&c, wt)) { parse_ok = 0; break; }
-                }
+    /* 1. LogSchema decode (fields: logID=2, log=3; presence of 1-5) */
+    const uint8_t *log = NULL; int log_len = 0;
+    const uint8_t *log_id = NULL; int log_id_len = 0;
+    int presence = 0, parse_ok = 1;
+    {
+        cursor_t c = { pay, pay + pay_len };
+        while (c.p < c.end) {
+            uint64_t tag;
+            if (!read_varint(&c, &tag)) { parse_ok = 0; break; }
+            uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+            if (field == 0) { parse_ok = 0; break; }
+            if (wt == 2 && (field == 2 || field == 3)) {
+                uint64_t l;
+                if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { parse_ok = 0; break; }
+                if (field == 2) { log_id = c.p; log_id_len = (int)l; }
+                else { log = c.p; log_len = (int)l; }
+                c.p += l;
+                presence = 1;
+            } else {
+                /* presence mirrors HasField(): only a CORRECT wire type
+                 * (all LogSchema fields 1-5 are strings, wt 2) counts --
+                 * a wrong-wire-type field is an unknown field to proto3
+                 * and must not make a payload look like an envelope */
+                if (wt == 2 && field >= 1 && field <= 5) presence = 1;
+                if (!skip_field(&c, wt)) { parse_ok = 0; break; }
             }
         }
-        if (parse_ok && (!accept_raw || presence)) {
-            if (log == NULL) { log = pay; log_len = 0; }
-            if (log_id == NULL) { log_id = pay; log_id_len = 0; }
-        } else if (accept_raw) {
-            /* raw-line shape: JSON records go to Python; strip ONE
-             * trailing newline (the single_value formatter's add_newline) */
-            if (pay_len > 0 && pay[0] == '{') { out_offsets[i + 1] = o; continue; }
-            log = pay; log_len = pay_len;
-            if (log_len > 0 && log[log_len - 1] == '\n') log_len--;
-            log_id = pay; log_id_len = 0;
-        } else {
-            out_offsets[i + 1] = o; continue; /* strict parse error -> Python */
-        }
-        if (!utf8_valid(log, log_len) || !utf8_valid(log_id, log_id_len)) {
-            out_offsets[i + 1] = o; continue;
-        }
-
-        /* 2. blank filter (Python: `if not log_line.strip(): return None`) */
-        int bc = blank_class(log, log_len);
-        if (bc == -1) { out_offsets[i + 1] = o; continue; }
-        if (bc == 1) { status[i] = 0; out_offsets[i + 1] = o; continue; }
-
-        /* Embedded newlines change the regex semantics the header
-         * extraction mirrors (Python's `.` never crosses `\n`, and `$`
-         * also matches BEFORE a trailing newline) — those rows go to
-         * Python rather than risking divergent captures. Rare: upstream
-         * tailers split on newlines. */
-        if (memchr(log, '\n', (size_t)log_len) != NULL) {
-            out_offsets[i + 1] = o; continue;
-        }
-
-        /* 3. header extraction */
-        const uint8_t *caps_s[64]; int caps_l[64];
-        int n_caps = 0, header_matched = 0;
-        if (n_lits > 0 && n_caps_fmt <= 64) {
-            const uint8_t *pos = log;
-            const uint8_t *end = log + log_len;
-            const uint8_t *lit0 = lit_data + lit_offsets[0];
-            int lit0_len = (int)(lit_offsets[1] - lit_offsets[0]);
-            int okflag = 1;
-            if (lit0_len > 0) {
-                if (end - pos < lit0_len || memcmp(pos, lit0, (size_t)lit0_len) != 0)
-                    okflag = 0;
-                else
-                    pos += lit0_len;
-            }
-            for (int c = 0; okflag && c < n_caps_fmt; c++) {
-                const uint8_t *lit = lit_data + lit_offsets[c + 1];
-                int lit_len = (int)(lit_offsets[c + 2] - lit_offsets[c + 1]);
-                if (c == n_caps_fmt - 1) {
-                    if (lit_len == 0) {
-                        caps_s[c] = pos; caps_l[c] = (int)(end - pos);
-                        pos = end;
-                    } else if (end - log >= lit_len &&
-                               end - lit_len >= pos &&
-                               memcmp(end - lit_len, lit, (size_t)lit_len) == 0) {
-                        caps_s[c] = pos; caps_l[c] = (int)(end - lit_len - pos);
-                        pos = end;
-                    } else {
-                        okflag = 0;
-                    }
-                } else if (lit_len == 0) {
-                    caps_s[c] = pos; caps_l[c] = 0; /* adjacent captures */
-                } else {
-                    const uint8_t *found = find_lit(pos, end, lit, lit_len);
-                    if (!found) { okflag = 0; break; }
-                    caps_s[c] = pos; caps_l[c] = (int)(found - pos);
-                    pos = found + lit_len;
-                }
-            }
-            if (okflag && n_caps_fmt == 0) {
-                /* capture-free format: anchored whole-line equality */
-                okflag = (lit0_len == log_len);
-            }
-            if (okflag) { header_matched = 1; n_caps = n_caps_fmt; }
-        } else if (n_lits > 0) {
-            out_offsets[i + 1] = o; continue; /* >64 captures: Python */
-        }
-
-        const uint8_t *content = log; int content_len = log_len;
-        if (header_matched && content_cap >= 0 && content_cap < n_caps) {
-            content = caps_s[content_cap];
-            content_len = caps_l[content_cap];
-        }
-
-        /* 4. normalize content for matching */
-        if ((norm_flags & NORM_LOWER)) {
-            int high = 0;
-            for (int k = 0; k < content_len; k++)
-                if (content[k] >= 0x80) { high = 1; break; }
-            if (high) { out_offsets[i + 1] = o; continue; } /* Unicode lower() */
-        }
-        const uint8_t *norm = content; int norm_len = content_len;
-        if (norm_flags) {
-            if (content_len > scratch_cap) {
-                free(scratch);
-                scratch_cap = content_len * 2 + 256;
-                scratch = (uint8_t *)malloc((size_t)scratch_cap);
-                if (!scratch) { free(tcaps); return -1; }
-            }
-            norm_len = normalize_span(content, content_len, scratch, norm_flags);
-            norm = scratch;
-        }
-
-        /* 5. template match + captures */
-        int event_id = -1;
-        const uint8_t *tmpl = NULL; int tmpl_len = 0;
-        int32_t tn_caps = 0;
-        if (n_templates > 0) {
-            int idx = match_extract_one(norm, norm_len, seg_data, seg_offsets,
-                                        seg_counts, starts_wild, ends_wild,
-                                        n_templates, tcaps, max_caps, &tn_caps);
-            if (idx == -2) { out_offsets[i + 1] = o; continue; }
-            if (idx >= 0) {
-                event_id = idx + 1;
-                tmpl = tmpl_data + tmpl_offsets[idx];
-                tmpl_len = (int)(tmpl_offsets[idx + 1] - tmpl_offsets[idx]);
-            }
-        }
-
-        /* 6. capacity check then emit */
-        int64_t names_total = n_caps ? (name_offsets[n_caps] - name_offsets[0]) : 0;
-        int64_t bound = 64 + version_len + parser_type_len + 2 * parser_id_len
-            + tmpl_len + 32 + log_id_len + names_total + (int64_t)log_len
-            + (int64_t)norm_len + 16LL * (n_caps + (int64_t)tn_caps)
-            + varint_size((uint64_t)now) * 2 + 20;
-        if (o + bound > out_cap) { free(scratch); free(tcaps); return -1; }
-
-        o = emit_str(out_buf, o, 1, version, version_len);
-        o = emit_str(out_buf, o, 2, parser_type, parser_type_len);
-        o = emit_str(out_buf, o, 3, parser_id, parser_id_len);
-        o = emit_i32(out_buf, o, 4, event_id);
-        o = emit_str(out_buf, o, 5, tmpl ? tmpl : (const uint8_t *)"", tmpl_len);
-        for (int k = 0; k < tn_caps; k++)
-            o = emit_str(out_buf, o, 6, norm + tcaps[2 * k],
-                         tcaps[2 * k + 1] - tcaps[2 * k]);
-        o = emit_str(out_buf, o, 7, rand_hex + (int64_t)i * 32, 32);
-        o = emit_str(out_buf, o, 8, log_id, log_id_len);
-        o = emit_str(out_buf, o, 9, parser_id, parser_id_len);
-        for (int k = 0; k < n_caps; k++) {
-            const uint8_t *key = name_data + name_offsets[k];
-            int key_len = (int)(name_offsets[k + 1] - name_offsets[k]);
-            /* duplicate capture names collapse like dict(zip(names, caps)):
-             * ONE map entry at the first occurrence's position carrying the
-             * LAST occurrence's value — emitting every capture would put
-             * extra wire entries the Python path never serializes (and the
-             * featurizer tokenizes raw wire entries, so downstream features
-             * would diverge by parser path) */
-            int first = 1;
-            for (int j = 0; j < k && first; j++)
-                if ((int)(name_offsets[j + 1] - name_offsets[j]) == key_len &&
-                    memcmp(name_data + name_offsets[j], key, (size_t)key_len) == 0)
-                    first = 0;
-            if (!first) continue;
-            int vidx = k;
-            for (int j = k + 1; j < n_caps; j++)
-                if ((int)(name_offsets[j + 1] - name_offsets[j]) == key_len &&
-                    memcmp(name_data + name_offsets[j], key, (size_t)key_len) == 0)
-                    vidx = j;
-            int64_t sub_len = 1 + varint_size((uint64_t)key_len) + key_len
-                + 1 + varint_size((uint64_t)caps_l[vidx]) + caps_l[vidx];
-            o = emit_varint(out_buf, o, (10u << 3) | 2);
-            o = emit_varint(out_buf, o, (uint64_t)sub_len);
-            o = emit_str(out_buf, o, 1, key, key_len);
-            o = emit_str(out_buf, o, 2, caps_s[vidx], caps_l[vidx]);
-        }
-        o = emit_i32(out_buf, o, 11, (int32_t)now);
-        o = emit_i32(out_buf, o, 12, (int32_t)now);
-        status[i] = 1;
-        out_offsets[i + 1] = o;
     }
-    free(scratch);
-    free(tcaps);
-    return o;
+    if (parse_ok && (!ctx->accept_raw || presence)) {
+        if (log == NULL) { log = pay; log_len = 0; }
+        if (log_id == NULL) { log_id = pay; log_id_len = 0; }
+    } else if (ctx->accept_raw) {
+        /* raw-line shape: JSON records go to Python; strip ONE trailing
+         * newline (the single_value formatter's add_newline) */
+        if (pay_len > 0 && pay[0] == '{') return 0;
+        log = pay; log_len = pay_len;
+        if (log_len > 0 && log[log_len - 1] == '\n') log_len--;
+        log_id = pay; log_id_len = 0;
+    } else {
+        return 0; /* strict parse error -> Python */
+    }
+    if (!utf8_valid(log, log_len) || !utf8_valid(log_id, log_id_len))
+        return 0;
+
+    /* 2. blank filter (Python: `if not log_line.strip(): return None`) */
+    int bc = blank_class(log, log_len);
+    if (bc == -1) return 0;
+    if (bc == 1) { *status_out = 0; return 0; }
+
+    /* Embedded newlines change the regex semantics the header extraction
+     * mirrors (Python's `.` never crosses `\n`, and `$` also matches
+     * BEFORE a trailing newline) -- those rows go to Python rather than
+     * risking divergent captures. Rare: upstream tailers split on
+     * newlines. */
+    if (memchr(log, '\n', (size_t)log_len) != NULL) return 0;
+
+    /* 3. header extraction */
+    const uint8_t *caps_s[64]; int caps_l[64];
+    int n_caps = 0, header_matched = 0;
+    if (ctx->n_lits > 0 && n_caps_fmt <= 64) {
+        const uint8_t *pos = log;
+        const uint8_t *end = log + log_len;
+        const uint8_t *lit0 = ctx->lit_data + ctx->lit_offsets[0];
+        int lit0_len = (int)(ctx->lit_offsets[1] - ctx->lit_offsets[0]);
+        int okflag = 1;
+        if (lit0_len > 0) {
+            if (end - pos < lit0_len || memcmp(pos, lit0, (size_t)lit0_len) != 0)
+                okflag = 0;
+            else
+                pos += lit0_len;
+        }
+        for (int c = 0; okflag && c < n_caps_fmt; c++) {
+            const uint8_t *lit = ctx->lit_data + ctx->lit_offsets[c + 1];
+            int lit_len = (int)(ctx->lit_offsets[c + 2] - ctx->lit_offsets[c + 1]);
+            if (c == n_caps_fmt - 1) {
+                if (lit_len == 0) {
+                    caps_s[c] = pos; caps_l[c] = (int)(end - pos);
+                    pos = end;
+                } else if (end - log >= lit_len &&
+                           end - lit_len >= pos &&
+                           memcmp(end - lit_len, lit, (size_t)lit_len) == 0) {
+                    caps_s[c] = pos; caps_l[c] = (int)(end - lit_len - pos);
+                    pos = end;
+                } else {
+                    okflag = 0;
+                }
+            } else if (lit_len == 0) {
+                caps_s[c] = pos; caps_l[c] = 0; /* adjacent captures */
+            } else {
+                const uint8_t *found = find_lit(pos, end, lit, lit_len);
+                if (!found) { okflag = 0; break; }
+                caps_s[c] = pos; caps_l[c] = (int)(found - pos);
+                pos = found + lit_len;
+            }
+        }
+        if (okflag && n_caps_fmt == 0) {
+            /* capture-free format: anchored whole-line equality */
+            okflag = (lit0_len == log_len);
+        }
+        if (okflag) { header_matched = 1; n_caps = n_caps_fmt; }
+    } else if (ctx->n_lits > 0) {
+        return 0; /* >64 captures: Python */
+    }
+
+    const uint8_t *content = log; int content_len = log_len;
+    if (header_matched && ctx->content_cap >= 0 && ctx->content_cap < n_caps) {
+        content = caps_s[ctx->content_cap];
+        content_len = caps_l[ctx->content_cap];
+    }
+
+    /* 4. normalize content for matching */
+    if ((ctx->norm_flags & NORM_LOWER)) {
+        int high = 0;
+        for (int k = 0; k < content_len; k++)
+            if (content[k] >= 0x80) { high = 1; break; }
+        if (high) return 0; /* Unicode lower() */
+    }
+    const uint8_t *norm = content; int norm_len = content_len;
+    if (ctx->norm_flags) {
+        if (content_len > ctx->scratch_cap) {
+            free(ctx->scratch);
+            ctx->scratch_cap = content_len * 2 + 256;
+            ctx->scratch = (uint8_t *)malloc((size_t)ctx->scratch_cap);
+            if (!ctx->scratch) { ctx->scratch_cap = 0; return -1; }
+        }
+        norm_len = normalize_span(content, content_len, ctx->scratch,
+                                  ctx->norm_flags);
+        norm = ctx->scratch;
+    }
+
+    /* 5. template match + captures */
+    int event_id = -1;
+    const uint8_t *tmpl = NULL; int tmpl_len = 0;
+    int32_t tn_caps = 0;
+    if (ctx->n_templates > 0) {
+        int idx = match_extract_one(norm, norm_len, ctx->seg_data,
+                                    ctx->seg_offsets, ctx->seg_counts,
+                                    ctx->starts_wild, ctx->ends_wild,
+                                    ctx->n_templates, ctx->tcaps,
+                                    ctx->max_caps, &tn_caps);
+        if (idx == -2) return 0;
+        if (idx >= 0) {
+            event_id = idx + 1;
+            tmpl = ctx->tmpl_data + ctx->tmpl_offsets[idx];
+            tmpl_len = (int)(ctx->tmpl_offsets[idx + 1] - ctx->tmpl_offsets[idx]);
+        }
+    }
+
+    /* 6. capacity check then emit */
+    int64_t names_total = n_caps
+        ? (ctx->name_offsets[n_caps] - ctx->name_offsets[0]) : 0;
+    int64_t bound = 64 + ctx->version_len + ctx->parser_type_len
+        + 2 * ctx->parser_id_len + tmpl_len + 32 + log_id_len + names_total
+        + (int64_t)log_len + (int64_t)norm_len
+        + 16LL * (n_caps + (int64_t)tn_caps)
+        + varint_size((uint64_t)ctx->now) * 2 + 20;
+    if (ctx->o + bound > ctx->out_cap) return -1;
+
+    uint8_t *out_buf = ctx->out_buf;
+    int64_t o = ctx->o;
+    o = emit_str(out_buf, o, 1, ctx->version, ctx->version_len);
+    o = emit_str(out_buf, o, 2, ctx->parser_type, ctx->parser_type_len);
+    o = emit_str(out_buf, o, 3, ctx->parser_id, ctx->parser_id_len);
+    o = emit_i32(out_buf, o, 4, event_id);
+    o = emit_str(out_buf, o, 5, tmpl ? tmpl : (const uint8_t *)"", tmpl_len);
+    for (int k = 0; k < tn_caps; k++)
+        o = emit_str(out_buf, o, 6, norm + ctx->tcaps[2 * k],
+                     ctx->tcaps[2 * k + 1] - ctx->tcaps[2 * k]);
+    o = emit_str(out_buf, o, 7, ctx->rand_hex + row_idx * 32, 32);
+    o = emit_str(out_buf, o, 8, log_id, log_id_len);
+    o = emit_str(out_buf, o, 9, ctx->parser_id, ctx->parser_id_len);
+    for (int k = 0; k < n_caps; k++) {
+        const uint8_t *key = ctx->name_data + ctx->name_offsets[k];
+        int key_len = (int)(ctx->name_offsets[k + 1] - ctx->name_offsets[k]);
+        /* duplicate capture names collapse like dict(zip(names, caps)):
+         * ONE map entry at the first occurrence's position carrying the
+         * LAST occurrence's value -- emitting every capture would put
+         * extra wire entries the Python path never serializes (and the
+         * featurizer tokenizes raw wire entries, so downstream features
+         * would diverge by parser path) */
+        int first = 1;
+        for (int j = 0; j < k && first; j++)
+            if ((int)(ctx->name_offsets[j + 1] - ctx->name_offsets[j]) == key_len &&
+                memcmp(ctx->name_data + ctx->name_offsets[j], key, (size_t)key_len) == 0)
+                first = 0;
+        if (!first) continue;
+        int vidx = k;
+        for (int j = k + 1; j < n_caps; j++)
+            if ((int)(ctx->name_offsets[j + 1] - ctx->name_offsets[j]) == key_len &&
+                memcmp(ctx->name_data + ctx->name_offsets[j], key, (size_t)key_len) == 0)
+                vidx = j;
+        int64_t sub_len = 1 + varint_size((uint64_t)key_len) + key_len
+            + 1 + varint_size((uint64_t)caps_l[vidx]) + caps_l[vidx];
+        o = emit_varint(out_buf, o, (10u << 3) | 2);
+        o = emit_varint(out_buf, o, (uint64_t)sub_len);
+        o = emit_str(out_buf, o, 1, key, key_len);
+        o = emit_str(out_buf, o, 2, caps_s[vidx], caps_l[vidx]);
+    }
+    o = emit_i32(out_buf, o, 11, (int32_t)ctx->now);
+    o = emit_i32(out_buf, o, 12, (int32_t)ctx->now);
+    ctx->o = o;
+    *status_out = 1;
+    return 0;
+}
+
+#define PARSE_CTX_ARGS \
+    int accept_raw, \
+    const uint8_t *lit_data, const int64_t *lit_offsets, int n_lits, \
+    const uint8_t *name_data, const int64_t *name_offsets, \
+    int content_cap, int norm_flags, \
+    const uint8_t *seg_data, const int64_t *seg_offsets, \
+    const int32_t *seg_counts, const uint8_t *starts_wild, \
+    const uint8_t *ends_wild, int n_templates, \
+    const uint8_t *tmpl_data, const int64_t *tmpl_offsets, int max_caps, \
+    const uint8_t *version, int version_len, \
+    const uint8_t *parser_type, int parser_type_len, \
+    const uint8_t *parser_id, int parser_id_len, \
+    int64_t now, const uint8_t *rand_hex, \
+    uint8_t *out_buf, int64_t out_cap
+
+static int parse_ctx_init(parse_ctx_t *ctx, PARSE_CTX_ARGS) {
+    ctx->accept_raw = accept_raw;
+    ctx->lit_data = lit_data; ctx->lit_offsets = lit_offsets; ctx->n_lits = n_lits;
+    ctx->name_data = name_data; ctx->name_offsets = name_offsets;
+    ctx->content_cap = content_cap; ctx->norm_flags = norm_flags;
+    ctx->seg_data = seg_data; ctx->seg_offsets = seg_offsets;
+    ctx->seg_counts = seg_counts; ctx->starts_wild = starts_wild;
+    ctx->ends_wild = ends_wild; ctx->n_templates = n_templates;
+    ctx->tmpl_data = tmpl_data; ctx->tmpl_offsets = tmpl_offsets;
+    ctx->max_caps = max_caps;
+    ctx->version = version; ctx->version_len = version_len;
+    ctx->parser_type = parser_type; ctx->parser_type_len = parser_type_len;
+    ctx->parser_id = parser_id; ctx->parser_id_len = parser_id_len;
+    ctx->now = now; ctx->rand_hex = rand_hex;
+    ctx->out_buf = out_buf; ctx->out_cap = out_cap;
+    ctx->o = 0;
+    ctx->scratch = NULL; ctx->scratch_cap = 0;
+    ctx->tcaps = (int32_t *)malloc(sizeof(int32_t) * 2
+                                   * (size_t)(max_caps > 0 ? max_caps : 1));
+    return ctx->tcaps ? 0 : -1;
+}
+
+static void parse_ctx_free(parse_ctx_t *ctx) {
+    free(ctx->scratch);
+    free(ctx->tcaps);
+}
+
+int64_t dm_parse_batch(
+    const uint8_t *payloads, const int64_t *offsets, int n, PARSE_CTX_ARGS,
+    int64_t *out_offsets, int8_t *status)
+{
+    parse_ctx_t ctx;
+    if (parse_ctx_init(&ctx, accept_raw, lit_data, lit_offsets, n_lits,
+                       name_data, name_offsets, content_cap, norm_flags,
+                       seg_data, seg_offsets, seg_counts, starts_wild,
+                       ends_wild, n_templates, tmpl_data, tmpl_offsets,
+                       max_caps, version, version_len, parser_type,
+                       parser_type_len, parser_id, parser_id_len, now,
+                       rand_hex, out_buf, out_cap) != 0)
+        return -1;
+    out_offsets[0] = 0;
+    for (int i = 0; i < n; i++) {
+        if (parse_one_row(&ctx, payloads + offsets[i],
+                          (int)(offsets[i + 1] - offsets[i]), i,
+                          status + i) != 0) {
+            parse_ctx_free(&ctx);
+            return -1;
+        }
+        out_offsets[i + 1] = ctx.o;
+    }
+    int64_t used = ctx.o;
+    parse_ctx_free(&ctx);
+    return used;
+}
+
+/* Frames variant: parse every message of every (pre-validated, via
+ * dm_count_frame_msgs) frame straight out of the wire blob. Also fills
+ * spans[2m..] = [start, end) byte offsets of each message into the frames
+ * blob, so the Python fallback path can slice flagged rows lazily —
+ * the engine loop holds no per-message Python objects in parser services
+ * either, completing the round-3 detector story. */
+int64_t dm_parse_frames(
+    const uint8_t *frames, const int64_t *frame_offsets, int n_frames,
+    const int32_t *counts, const uint8_t *corrupt, PARSE_CTX_ARGS,
+    int64_t *spans, int64_t *out_offsets, int8_t *status)
+{
+    parse_ctx_t ctx;
+    if (parse_ctx_init(&ctx, accept_raw, lit_data, lit_offsets, n_lits,
+                       name_data, name_offsets, content_cap, norm_flags,
+                       seg_data, seg_offsets, seg_counts, starts_wild,
+                       ends_wild, n_templates, tmpl_data, tmpl_offsets,
+                       max_caps, version, version_len, parser_type,
+                       parser_type_len, parser_id, parser_id_len, now,
+                       rand_hex, out_buf, out_cap) != 0)
+        return -1;
+    out_offsets[0] = 0;
+    int64_t m = 0;
+    for (int i = 0; i < n_frames; i++) {
+        const uint8_t *base = frames + frame_offsets[i];
+        int len = (int)(frame_offsets[i + 1] - frame_offsets[i]);
+        if (corrupt[i] || counts[i] == 0) continue;
+        if (!frame_is_batch(base, len)) {
+            spans[2 * m] = frame_offsets[i];
+            spans[2 * m + 1] = frame_offsets[i + 1];
+            if (parse_one_row(&ctx, base, len, m, status + m) != 0) {
+                parse_ctx_free(&ctx);
+                return -1;
+            }
+            out_offsets[m + 1] = ctx.o;
+            m++;
+            continue;
+        }
+        cursor_t c = { base + 4, base + len };
+        uint64_t n_msgs;
+        read_varint(&c, &n_msgs);          /* pre-validated by the count pass */
+        for (uint64_t k = 0; k < n_msgs; k++) {
+            uint64_t mlen;
+            read_varint(&c, &mlen);
+            if (mlen > 0) {                /* packed empties: filtered, no row */
+                spans[2 * m] = frame_offsets[i] + (c.p - base);
+                spans[2 * m + 1] = spans[2 * m] + (int64_t)mlen;
+                if (parse_one_row(&ctx, c.p, (int)mlen, m, status + m) != 0) {
+                    parse_ctx_free(&ctx);
+                    return -1;
+                }
+                out_offsets[m + 1] = ctx.o;
+                m++;
+            }
+            c.p += mlen;
+        }
+    }
+    int64_t used = ctx.o;
+    parse_ctx_free(&ctx);
+    return used;
 }

@@ -384,3 +384,64 @@ class TestParseBatchKernelParity:
                     i += 1
                 i += 1
         assert n_map_entries == 1
+
+    def test_process_frames_matches_process_batch(self, tmp_path):
+        """The frames path (packed batch frames + bare single-message
+        frames) must produce the same fields, in order, as expanding the
+        frames and running process_batch."""
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        parser = self._parser(tmp_path, templates=[
+            "arch=<*> syscall=<*> pid=<*> uid=<*> comm=<*>"])
+        payloads = self.audit_payloads(24)
+        frames = [pack_batch(payloads[:10]), payloads[10],
+                  pack_batch(payloads[11:24])]
+        outs, n_msgs, n_lines = parser.process_frames(frames)
+        assert n_msgs == 24
+        # n_lines follows the ENGINE's newline-count rule over raw payload
+        # bytes (protobuf blobs legitimately contain 0x0A tag bytes)
+        expected_lines = sum(
+            max(1, p.count(b"\n") + (0 if p.endswith(b"\n") else 1))
+            for p in payloads)
+        assert n_lines == expected_lines
+        ref = parser.process_batch(payloads)
+        assert [self._fields(a) for a in outs] == [self._fields(b) for b in ref]
+
+    def test_process_frames_counts_corrupt_frames(self, tmp_path):
+        parser = self._parser(tmp_path)
+        errors = []
+        parser.count_processing_errors = lambda n, what: errors.append((n, what))
+        bad = b"\xd7DM\x01\xff\xff\xff\xff"          # batch magic, bogus body
+        outs, n_msgs, _ = parser.process_frames([bad, self.audit_payloads(1)[0]])
+        assert n_msgs == 1 and len(outs) == 1
+        assert any("corrupt" in what for _, what in errors)
+
+    def test_process_frames_python_fallback_matches(self, tmp_path):
+        """Kill the kernel on one instance: the Python fallback must keep
+        the same contract (fields + counts), just slower."""
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        parser = self._parser(tmp_path, templates=["arch=<*> syscall=<*>"])
+        payloads = self.audit_payloads(8)
+        frames = [pack_batch(payloads[:5]), payloads[5], pack_batch(payloads[6:])]
+        native = parser.process_frames(frames)
+        parser._parse_native = None
+        fallback = parser.process_frames(frames)
+        assert native[1:] == fallback[1:]  # counts identical
+        assert ([self._fields(a) for a in native[0]]
+                == [self._fields(b) for b in fallback[0]])
+
+    def test_process_frames_flagged_rows_fall_back_per_row(self, tmp_path):
+        """A frame mixing kernel-clean rows with Python-only rows (JSON
+        record in accept_raw mode) emits both correctly in order."""
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        parser = self._parser(tmp_path, accept_raw_lines=True)
+        json_rec = (b'{"message": "type=A msg=audit(2.2): x=1", '
+                    b'"hostname": "h"}\n')
+        payloads = [self.audit_payloads(1)[0], json_rec,
+                    b'type=B msg=audit(3.3): y=2\n']
+        outs, n_msgs, _ = parser.process_frames([pack_batch(payloads)])
+        assert n_msgs == 3
+        assert self._fields(outs[1])["map"]["Time"] == "2.2"
+        assert self._fields(outs[2])["map"]["Time"] == "3.3"
