@@ -43,6 +43,8 @@ class NewValueDetector(CoreDetector):
         # (scope, instance, label) -> set of seen values
         self._seen: Dict[Tuple[str, str, str], Set[str]] = {}
         self._plan_cache: Dict[Any, list] = {}  # event_id -> watch plan
+        self._scan_kernel = None                # native steady-state scan
+        self._scan_sig = None                   # (n plans, n seen values)
 
     # ------------------------------------------------------------------
     def _watched(self, input_: ParserSchema):
@@ -78,6 +80,18 @@ class NewValueDetector(CoreDetector):
     def apply_config(self) -> None:
         super().apply_config()
         self._plan_cache = {}  # reconfigure may change the watched fields
+        self._drop_scan_kernel()
+
+    def _drop_scan_kernel(self) -> None:
+        """Hard-invalidate the native scan. The staleness SIGNATURE only
+        tracks counts, which cannot see a reconfigure that remaps watched
+        fields onto the same plan/seen counts, or a state restore with a
+        coincidentally equal value count — reusing the old table there
+        could wrongly PROVE rows alert-free. Every plan/state REPLACEMENT
+        must come through here; only monotonic value inserts may rely on
+        the signature."""
+        self._scan_kernel = None
+        self._scan_sig = None
 
     def _watch_plan(self, event_id) -> list:
         """Prebuilt (key, scope, label, kind, pos) list for one event id.
@@ -101,6 +115,41 @@ class NewValueDetector(CoreDetector):
                                  header, str(var.pos) if header else var.pos))
         return plan
 
+    def _ensure_scan_kernel(self):
+        """(Re)build the native steady-state scan when the plan set or the
+        seen-value counts changed (training, restore, reconfigure, new event
+        ids, alert_once inserts). A kernel that is merely STALE can only
+        over-flag rows to the exact Python path — never suppress an alert —
+        so the signature check is a perf refresh, not a correctness gate."""
+        try:
+            from ...utils import matchkern
+
+            if not matchkern.has_nvd_kernel():
+                return None
+        except Exception:
+            return None
+        sig = (len(self._plan_cache),
+               sum(len(s) for s in self._seen.values()))
+        if self._scan_kernel is not None and sig == self._scan_sig:
+            return self._scan_kernel
+        key_ids: Dict[Tuple[str, str, str], int] = {}
+        plans = {}
+        for event_id, plan in self._plan_cache.items():
+            rows = []
+            for key, _scope, _label, header, pos in plan:
+                kid = key_ids.setdefault(key, len(key_ids))
+                rows.append((kid, header, pos))
+            plans[event_id] = rows
+        seen_items = [(kid, value)
+                      for key, kid in key_ids.items()
+                      for value in self._seen.get(key, ())]
+        try:
+            self._scan_kernel = matchkern.NvdScanKernel(plans, seen_items)
+            self._scan_sig = sig
+        except Exception:
+            self._scan_kernel = None
+        return self._scan_kernel
+
     def process_batch(self, batch) -> list:
         """Batched engine contract, field-equivalent to ``process`` (pinned
         by test_process_batch_matches_process): decodes straight into pb2,
@@ -118,7 +167,19 @@ class NewValueDetector(CoreDetector):
         outs: list = []
         decode_errors = 0
         build_errors = 0
-        for data in batch:
+        # native steady-state scan (dm_nvd_scan): after training, rows the
+        # exact C table PROVES alert-free skip the Python body entirely —
+        # flagged rows (possible new value, decode error, unknown event)
+        # fall through to it unchanged
+        verdicts = None
+        if self._trained >= cfg.data_use_training and plans:
+            kernel = self._ensure_scan_kernel()
+            if kernel is not None:
+                verdicts = kernel.scan(batch).tolist()
+        for row_i, data in enumerate(batch):
+            if verdicts is not None and verdicts[row_i] == 0:
+                outs.append(None)
+                continue
             msg = _pb.ParserSchema()
             try:
                 msg.ParseFromString(data)
@@ -230,6 +291,7 @@ class NewValueDetector(CoreDetector):
         self._seen = {
             tuple(k.split("|", 2)): set(v) for k, v in state.get("seen", {}).items()
         }
+        self._drop_scan_kernel()  # restored state REPLACES the seen sets
 
 
 class NewValueComboDetectorConfig(CoreDetectorConfig):

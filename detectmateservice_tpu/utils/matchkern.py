@@ -155,6 +155,27 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int8),
         ]
         lib.dm_parse_frames.restype = ctypes.c_int64
+    if hasattr(lib, "dm_nvd_scan"):
+        lib.dm_nvd_build.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+        ]
+        lib.dm_nvd_build.restype = ctypes.c_int
+        lib.dm_nvd_scan.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int8),
+        ]
     return lib
 
 
@@ -600,3 +621,92 @@ class ParsedFrames:
     def raw(self, i: int) -> bytes:
         s, e = self.spans[i]
         return self.frames_blob[s:e]
+
+
+def has_nvd_kernel() -> bool:
+    return hasattr(_lib, "dm_nvd_scan")
+
+
+NVD_EVENT_NONE = -(2 ** 63)  # C sentinel for "no EventID" (INT64_MIN)
+
+
+class NvdScanKernel:
+    """NewValueDetector steady-state scan: an EXACT (byte-equality)
+    open-addressing table of (watch-key id, seen value) probed natively
+    per batch. Verdict 0 = proven no-alert; -1 = run the row in Python.
+    A STALE table (Python inserted values since the build, e.g. alert_once)
+    only over-flags rows to Python — it can never suppress an alert — so
+    rebuilds are a perf decision, not a correctness one.
+
+    ``plans`` is {event_id_or_None: [(key_id, is_header, pos_or_name)]};
+    ``seen_items`` is [(key_id, value_str)].
+    """
+
+    def __init__(self, plans, seen_items):
+        events = []
+        offs = [0]
+        key_ids: List[int] = []
+        headers: List[int] = []
+        poss: List[int] = []
+        names: List[bytes] = []
+        for event_id, plan in plans.items():
+            events.append(NVD_EVENT_NONE if event_id is None else int(event_id))
+            for key_id, is_header, pos in plan:
+                key_ids.append(key_id)
+                headers.append(1 if is_header else 0)
+                poss.append(-1 if is_header else int(pos))
+                names.append(str(pos).encode() if is_header else b"")
+            offs.append(len(key_ids))
+        self._events = np.asarray(events, dtype=np.int64)
+        self._offs = np.asarray(offs, dtype=np.int32)
+        self._key_ids = np.asarray(key_ids, dtype=np.int32)
+        self._headers = np.asarray(headers, dtype=np.uint8)
+        self._poss = np.asarray(poss, dtype=np.int32)
+        self._name_blob, self._name_offs = _pack(names)
+        self._n_events = len(events)
+
+        vals = [v.encode() for _, v in seen_items]
+        self._arena, val_offs = _pack(vals)
+        item_keys = np.asarray([k for k, _ in seen_items], dtype=np.int32)
+        cap = 1
+        while cap < 2 * max(1, len(vals)):
+            cap *= 2
+        self._t_key = np.zeros(cap, dtype=np.int32)
+        self._t_hash = np.zeros(cap, dtype=np.uint32)
+        self._t_off = np.zeros(cap, dtype=np.int64)
+        self._t_len = np.full(cap, -1, dtype=np.int32)
+        self._capacity = cap
+        if vals:
+            rc = _lib.dm_nvd_build(
+                item_keys.ctypes.data_as(_I32P), self._arena,
+                val_offs.ctypes.data_as(_I64P), len(vals),
+                self._t_key.ctypes.data_as(_I32P),
+                self._t_hash.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                self._t_off.ctypes.data_as(_I64P),
+                self._t_len.ctypes.data_as(_I32P), cap)
+            if rc != 0:
+                raise RuntimeError("nvd table build overflow")
+        # cache pointer conversions (same lesson as TemplateMatcher)
+        self._p = (self._events.ctypes.data_as(_I64P),
+                   self._offs.ctypes.data_as(_I32P),
+                   self._key_ids.ctypes.data_as(_I32P),
+                   self._headers.ctypes.data_as(_U8P),
+                   self._poss.ctypes.data_as(_I32P),
+                   self._name_offs.ctypes.data_as(_I64P),
+                   self._t_key.ctypes.data_as(_I32P),
+                   self._t_hash.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                   self._t_off.ctypes.data_as(_I64P),
+                   self._t_len.ctypes.data_as(_I32P))
+
+    def scan(self, payloads: Sequence[bytes]) -> np.ndarray:
+        n = len(payloads)
+        blob, offsets = _pack(payloads)
+        verdict = np.full(n, -1, dtype=np.int8)
+        p = self._p
+        _lib.dm_nvd_scan(
+            blob, offsets.ctypes.data_as(_I64P), n,
+            p[0], p[1], self._n_events,
+            p[2], p[3], p[4], self._name_blob, p[5],
+            p[6], p[7], p[8], p[9], self._capacity, self._arena,
+            verdict.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+        return verdict

@@ -1037,3 +1037,186 @@ int64_t dm_parse_frames(
     parse_ctx_free(&ctx);
     return used;
 }
+
+/* ---------------- NVD steady-state scan (dm_nvd_scan) ----------------
+ *
+ * NewValueDetector's post-training hot path is a set-membership scan:
+ * ~99% of messages contain only already-seen values and produce None.
+ * This kernel runs that scan natively against an EXACT open-addressing
+ * table of (watch key id, value bytes) built from the Python seen-sets.
+ *
+ * One-sided contract (same fallback philosophy as dm_parse_batch):
+ * verdict 0 means PROVEN no-alert — every watched value of the row was
+ * found in the exact table (byte equality; str equality over valid UTF-8
+ * is byte equality) with training over. ANYTHING else — a value absent
+ * from the table, decode failure, an event id without a shipped plan,
+ * >64 variables/map entries — is verdict -1 and the row re-runs through
+ * the exact Python path. A STALE table (values added Python-side since
+ * the build, e.g. alert_once inserts) only contains FEWER values, so
+ * staleness can only over-flag rows to Python — never suppress an alert.
+ */
+
+static uint32_t nvd_hash(int32_t key_id, const uint8_t *val, int len) {
+    uint32_t inv = 0xFFFFFFFFu;
+    for (int k = 0; k < 4; k++) {
+        uint8_t b = (uint8_t)((uint32_t)key_id >> (8 * k));
+        inv = dm_crc_table[(inv ^ b) & 0xFF] ^ (inv >> 8);
+    }
+    for (int k = 0; k < len; k++)
+        inv = dm_crc_table[(inv ^ val[k]) & 0xFF] ^ (inv >> 8);
+    return inv ^ 0xFFFFFFFFu;
+}
+
+/* Build the table (capacity = power of two > n_vals, t_len prefilled -1).
+ * Duplicate (key_id, value) pairs collapse. Returns 0, -1 on a full table
+ * (caller sized it wrong). */
+int dm_nvd_build(const int32_t *key_ids, const uint8_t *vals,
+                 const int64_t *val_offs, int64_t n_vals,
+                 int32_t *t_key, uint32_t *t_hash, int64_t *t_off,
+                 int32_t *t_len, int64_t capacity) {
+    int64_t mask = capacity - 1;
+    for (int64_t i = 0; i < n_vals; i++) {
+        const uint8_t *v = vals + val_offs[i];
+        int len = (int)(val_offs[i + 1] - val_offs[i]);
+        uint32_t h = nvd_hash(key_ids[i], v, len);
+        int64_t idx = (int64_t)(h & (uint32_t)mask);
+        int64_t steps = 0;
+        while (t_len[idx] >= 0) {
+            if (t_hash[idx] == h && t_key[idx] == key_ids[i] &&
+                t_len[idx] == len &&
+                memcmp(vals + t_off[idx], v, (size_t)len) == 0)
+                break;                        /* duplicate: already present */
+            idx = (idx + 1) & mask;
+            if (++steps > capacity) return -1;
+        }
+        if (t_len[idx] < 0) {
+            t_key[idx] = key_ids[i];
+            t_hash[idx] = h;
+            t_off[idx] = val_offs[i];
+            t_len[idx] = len;
+        }
+    }
+    return 0;
+}
+
+#define NVD_MAX_VARS 64
+#define NVD_EVENT_NONE INT64_MIN
+
+void dm_nvd_scan(
+    const uint8_t *payloads, const int64_t *offsets, int n,
+    const int64_t *plan_events, const int32_t *plan_offs, int n_events,
+    const int32_t *watch_key_ids, const uint8_t *watch_is_header,
+    const int32_t *watch_pos,
+    const uint8_t *watch_name_data, const int64_t *watch_name_offs,
+    const int32_t *t_key, const uint32_t *t_hash, const int64_t *t_off,
+    const int32_t *t_len, int64_t t_capacity, const uint8_t *arena,
+    int8_t *verdict)
+{
+    int64_t mask = t_capacity - 1;
+    for (int i = 0; i < n; i++) {
+        const uint8_t *pay = payloads + offsets[i];
+        int pay_len = (int)(offsets[i + 1] - offsets[i]);
+        verdict[i] = -1;                      /* default: Python row */
+
+        /* parse ParserSchema: EventID(4, varint), variables(6, rep str),
+         * logFormatVariables(10, map) */
+        const uint8_t *var_p[NVD_MAX_VARS]; int var_l[NVD_MAX_VARS];
+        map_entry_t maps[MAX_MAP_ENTRIES];
+        int n_vars = 0, n_maps = 0, overflow = 0, bad = 0;
+        int64_t event_id = NVD_EVENT_NONE;
+        cursor_t c = { pay, pay + pay_len };
+        while (c.p < c.end) {
+            uint64_t tag;
+            if (!read_varint(&c, &tag)) { bad = 1; break; }
+            uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+            if (field == 0) { bad = 1; break; }
+            if (field == 4 && wt == 0) {
+                uint64_t v;
+                if (!read_varint(&c, &v)) { bad = 1; break; }
+                event_id = (int64_t)(int32_t)(uint32_t)v; /* int32 semantics */
+            } else if (field == 6 && wt == 2) {
+                uint64_t l;
+                if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { bad = 1; break; }
+                if (!utf8_valid(c.p, (int)l)) { bad = 1; break; }
+                if (n_vars < NVD_MAX_VARS) {
+                    var_p[n_vars] = c.p; var_l[n_vars] = (int)l; n_vars++;
+                } else {
+                    overflow = 1;
+                }
+                c.p += l;
+            } else if (field == 10 && wt == 2) {
+                uint64_t l;
+                if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { bad = 1; break; }
+                if (n_maps < MAX_MAP_ENTRIES) {
+                    if (!parse_map_entry(c.p, (int)l, &maps[n_maps])) { bad = 1; break; }
+                    if (!utf8_valid(maps[n_maps].key, maps[n_maps].key_len) ||
+                        !utf8_valid(maps[n_maps].val, maps[n_maps].val_len)) {
+                        bad = 1; break;
+                    }
+                    n_maps++;
+                } else {
+                    overflow = 1;
+                }
+                c.p += l;
+            } else if (wt == 2 && (field <= 3 || field == 5
+                                   || (field >= 7 && field <= 9))) {
+                /* declared string fields (1,2,3,5,7,8,9): Python's upb
+                 * validates their UTF-8 at parse time and raises — a
+                 * verdict-0 row must not silently swallow what the Python
+                 * path would count as a decode error. Unknown field
+                 * numbers stay unvalidated, exactly like upb. */
+                uint64_t l;
+                if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { bad = 1; break; }
+                if (!utf8_valid(c.p, (int)l)) { bad = 1; break; }
+                c.p += l;
+            } else if (!skip_field(&c, wt)) {
+                bad = 1; break;
+            }
+        }
+        if (bad || overflow) continue;        /* Python decides */
+
+        /* plan lookup (linear: event counts are small) */
+        int e = -1;
+        for (int k = 0; k < n_events; k++)
+            if (plan_events[k] == event_id) { e = k; break; }
+        if (e < 0) continue;                  /* plan not shipped: Python */
+
+        int all_seen = 1;
+        for (int w = plan_offs[e]; all_seen && w < plan_offs[e + 1]; w++) {
+            const uint8_t *val = NULL; int val_len = 0;
+            if (watch_is_header[w]) {
+                const uint8_t *nm = watch_name_data + watch_name_offs[w];
+                int nm_len = (int)(watch_name_offs[w + 1] - watch_name_offs[w]);
+                for (int m = 0; m < n_maps; m++) {
+                    if (maps[m].key_len == nm_len &&
+                        memcmp(maps[m].key, nm, (size_t)nm_len) == 0) {
+                        val = maps[m].val ? maps[m].val : (const uint8_t *)"";
+                        val_len = maps[m].val_len;
+                        /* keep scanning: proto3 maps are last-wins */
+                    }
+                }
+            } else {
+                int pos = watch_pos[w];
+                if (pos >= 0 && pos < n_vars) {
+                    val = var_p[pos]; val_len = var_l[pos];
+                }
+            }
+            if (val == NULL) continue;        /* missing value: no check */
+            uint32_t h = nvd_hash(watch_key_ids[w], val, val_len);
+            int64_t idx = (int64_t)(h & (uint32_t)mask);
+            int found = 0;
+            int64_t steps = 0;
+            while (t_len[idx] >= 0) {
+                if (t_hash[idx] == h && t_key[idx] == watch_key_ids[w] &&
+                    t_len[idx] == val_len &&
+                    memcmp(arena + t_off[idx], val, (size_t)val_len) == 0) {
+                    found = 1; break;
+                }
+                idx = (idx + 1) & mask;
+                if (++steps > t_capacity) break;
+            }
+            if (!found) all_seen = 0;         /* possible new value */
+        }
+        if (all_seen) verdict[i] = 0;
+    }
+}

@@ -445,3 +445,189 @@ class TestParseBatchKernelParity:
         assert n_msgs == 3
         assert self._fields(outs[1])["map"]["Time"] == "2.2"
         assert self._fields(outs[2])["map"]["Time"] == "3.3"
+
+
+class TestNvdScanKernelParity:
+    """dm_nvd_scan: the steady-state set-membership filter must be EXACT on
+    its 0-verdicts (proven no-alert) and conservative everywhere else —
+    outputs, alerts, and state evolution must be indistinguishable from the
+    pure-Python path."""
+
+    def _build(self, **cfg):
+        from detectmateservice_tpu.library.detectors.new_value_detector import (
+            NewValueDetector,
+        )
+
+        base = {"method_type": "new_value_detector", "auto_config": False,
+                "data_use_training": 8,
+                "global": {"gi": {"header_variables": [{"pos": "Type"}],
+                                  "variables": [{"pos": 0}]}},
+                "events": {"1": {"e1": {"variables": [{"pos": 1}]}}}}
+        base.update(cfg)
+        return NewValueDetector(config={"detectors": {"NewValueDetector": base}})
+
+    def _pair(self, **cfg):
+        native, python = self._build(**cfg), self._build(**cfg)
+        python._ensure_scan_kernel = lambda: None
+        return native, python
+
+    @staticmethod
+    def _msg(event=1, variables=("a", "b"), type_="SYSCALL", log_id="1"):
+        from detectmateservice_tpu.schemas import ParserSchema
+
+        kw = {} if event is None else {"EventID": event}
+        return ParserSchema(variables=list(variables), logID=log_id,
+                            logFormatVariables={"Type": type_}, **kw).serialize()
+
+    def _assert_parity(self, native, python, payloads):
+        from detectmateservice_tpu.schemas import DetectorSchema
+
+        a = native.process_batch(list(payloads))
+        b = python.process_batch(list(payloads))
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert (x is None) == (y is None)
+            if x is not None:
+                da, db = DetectorSchema.from_bytes(x), DetectorSchema.from_bytes(y)
+                assert dict(da.alertsObtain) == dict(db.alertsObtain)
+                assert da.score == db.score
+                assert list(da.logIDs) == list(db.logIDs)
+        assert native._seen == python._seen  # state evolution identical
+        return a
+
+    def _train(self, *dets):
+        train = [self._msg(variables=(f"v{i % 3}", f"w{i % 2}"),
+                           type_=["SYSCALL", "LOGIN"][i % 2], log_id=str(i))
+                 for i in range(8)]
+        for d in dets:
+            d.process_batch(train)
+
+    def test_steady_state_no_alerts_and_kernel_engaged(self):
+        native, python = self._pair()
+        self._train(native, python)
+        payloads = [self._msg(variables=("v1", "w0"), type_="LOGIN",
+                              log_id=str(i)) for i in range(64)]
+        out = self._assert_parity(native, python, payloads)
+        assert all(o is None for o in out)
+        assert native._scan_kernel is not None, "kernel must engage"
+
+    def test_new_values_alert_identically(self):
+        native, python = self._pair()
+        self._train(native, python)
+        payloads = [self._msg(variables=("v0", "w1"), log_id="ok"),
+                    self._msg(variables=("EVIL", "w1"), log_id="bad1"),
+                    self._msg(variables=("v1", "99"), type_="ROOTKIT",
+                              log_id="bad2")]
+        out = self._assert_parity(native, python, payloads)
+        assert out[0] is None and out[1] is not None and out[2] is not None
+
+    def test_alert_once_staleness_is_safe(self):
+        """alert_once inserts values Python-side AFTER the table build: the
+        stale table must keep routing those rows to Python (which then
+        suppresses repeats), never suppress or double-alert natively."""
+        native, python = self._pair(alert_once=True)
+        self._train(native, python)
+        evil = [self._msg(variables=("EVIL", "w0"), log_id=str(i))
+                for i in range(6)]
+        out = self._assert_parity(native, python, evil)
+        assert out[0] is not None                      # first sighting alerts
+        assert all(o is None for o in out[1:])         # alert_once suppresses
+
+    def test_unknown_event_id_and_missing_event_id(self):
+        native, python = self._pair()
+        self._train(native, python)
+        payloads = [self._msg(event=7, variables=("v0", "w0"), log_id="e7"),
+                    self._msg(event=None, variables=("v0", "w0"), log_id="eN")]
+        self._assert_parity(native, python, payloads)
+
+    def test_decode_errors_counted_identically(self):
+        native, python = self._pair()
+        self._train(native, python)
+        counts = {"native": 0, "python": 0}
+        native.count_processing_errors = (
+            lambda n, what, _c=counts: _c.__setitem__("native", _c["native"] + n))
+        python.count_processing_errors = (
+            lambda n, what, _c=counts: _c.__setitem__("python", _c["python"] + n))
+        payloads = [b"\xff\xfenot a proto", self._msg(variables=("v0", "w0"))]
+        self._assert_parity(native, python, payloads)
+        assert counts["native"] == counts["python"] == 1
+
+    def test_unicode_values(self):
+        native, python = self._pair()
+        train = [self._msg(variables=("Jürgen", "日本"), type_="ログ",
+                           log_id=str(i)) for i in range(8)]
+        native.process_batch(train)
+        python.process_batch(train)
+        ok = [self._msg(variables=("Jürgen", "日本"), type_="ログ", log_id="ok")]
+        bad = [self._msg(variables=("Jürgén", "日本"), type_="ログ", log_id="bad")]
+        assert self._assert_parity(native, python, ok) == [None]
+        out = self._assert_parity(native, python, bad)
+        assert out[0] is not None
+
+    def test_checkpoint_restore_rebuilds_table(self):
+        native, python = self._pair()
+        self._train(native, python)
+        state = native.state_dict()
+        fresh = self._build()
+        fresh.load_state_dict(state)
+        fresh._trained = 8
+        payloads = [self._msg(variables=("v0", "w0"), log_id="ok"),
+                    self._msg(variables=("NEW", "w0"), log_id="bad")]
+        out = fresh.process_batch(payloads)
+        assert out[0] is None and out[1] is not None
+
+    def test_reconfigure_remapping_watched_fields_invalidates_table(self):
+        """A reconfigure that remaps watched fields onto the SAME plan and
+        seen counts must not reuse the old table — that would wrongly prove
+        rows alert-free against the pre-reconfigure field positions."""
+        native = self._build(**{"global": {"gi": {"variables": [{"pos": 0}]}},
+                                "events": {}})
+        train = [self._msg(variables=(f"v{i % 3}", "CONST"), log_id=str(i))
+                 for i in range(8)]
+        native.process_batch(train)
+        native.process_batch([self._msg(variables=("v0", "x"), log_id="warm")])
+        assert native._scan_kernel is not None
+        # remap the single watcher from position 0 to position 1: same plan
+        # count, same seen count — only the field changed
+        native.config = native.config.model_copy(update={
+            "global_": {"gi": type(native.config.global_["gi"])(
+                variables=[{"pos": 1}])}})
+        native.apply_config()
+        out = native.process_batch(
+            [self._msg(variables=("v0", "NEVER-SEEN"), log_id="bad")])
+        assert out[0] is not None, "stale table suppressed the alert"
+
+    def test_live_state_restore_invalidates_table(self):
+        native, python = self._pair()
+        self._train(native, python)
+        native.process_batch([self._msg(variables=("v0", "w0"), log_id="warm")])
+        assert native._scan_kernel is not None
+        # restore DIFFERENT seen-sets with identical counts onto the live
+        # instance: the old table must not answer for the new state
+        state = native.state_dict()
+        state["seen"] = {k: [f"other-{i}" for i in range(len(v))]
+                         for k, v in state["seen"].items()}
+        native.load_state_dict(state)
+        out = native.process_batch(
+            [self._msg(variables=("v0", "w0"), log_id="now-unknown")])
+        assert out[0] is not None, "pre-restore table suppressed the alert"
+
+    def test_invalid_utf8_in_unwatched_field_counts_error(self):
+        """Invalid UTF-8 in a string field the scan does not watch (logID)
+        must still surface as a decode error — upb rejects it at parse, and
+        a verdict-0 shortcut would silently undercount."""
+        native, python = self._pair()
+        self._train(native, python)
+        ok = self._msg(variables=("v0", "w0"), log_id="x")
+        # splice an invalid-UTF-8 logID (field 8) onto an otherwise
+        # all-seen message
+        bad = ok + b"\x42\x02\xff\xfe"
+        counts = {"native": 0, "python": 0}
+        native.count_processing_errors = (
+            lambda n, w, _c=counts: _c.__setitem__("native", _c["native"] + n))
+        python.count_processing_errors = (
+            lambda n, w, _c=counts: _c.__setitem__("python", _c["python"] + n))
+        a = native.process_batch([bad])
+        b = python.process_batch([bad])
+        assert a == b == [None]
+        assert counts["native"] == counts["python"] == 1
