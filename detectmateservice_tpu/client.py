@@ -2,8 +2,10 @@
 
 Parity with the reference client (reference: src/service/client.py:27-120):
 subcommands ``start`` / ``stop`` / ``status`` / ``metrics`` /
-``reconfigure [--persist]`` against ``--url``, plus the TPU-build addition
-``checkpoint`` (save component state to the service's checkpoint_dir).
+``reconfigure [--persist]`` against ``--url``, plus the TPU-build additions
+``checkpoint`` (save component state to the service's checkpoint_dir) and
+``trace [--chrome] [-o FILE]`` (read the pipeline flight recorder; --chrome
+fetches a Perfetto-loadable trace-event document).
 Uses stdlib urllib — no extra dependencies.
 """
 from __future__ import annotations
@@ -62,6 +64,12 @@ class DetectMateClient:
         """Save component state to the service's checkpoint_dir now."""
         return self._request("POST", "/admin/checkpoint")
 
+    def trace(self, chrome: bool = False) -> Any:
+        """Read the pipeline flight recorder (slowest + sampled traces);
+        ``chrome=True`` returns a Perfetto-loadable trace-event document."""
+        suffix = "?format=chrome" if chrome else ""
+        return self._request("GET", "/admin/trace" + suffix)
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
@@ -75,6 +83,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("status")
     sub.add_parser("metrics")
     sub.add_parser("checkpoint")
+    trace = sub.add_parser(
+        "trace", help="read the pipeline flight recorder (/admin/trace)")
+    trace.add_argument("--chrome", action="store_true",
+                       help="fetch Chrome trace-event JSON (Perfetto-loadable)")
+    trace.add_argument("-o", "--out",
+                       help="write the result to a file instead of stdout")
     reconf = sub.add_parser("reconfigure")
     reconf.add_argument("config_file", help="YAML file with the new component config")
     reconf.add_argument("--persist", action="store_true")
@@ -86,6 +100,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             with open(args.config_file, "r", encoding="utf-8") as fh:
                 config = yaml.safe_load(fh) or {}
             result = client.reconfigure(config, persist=args.persist)
+        elif args.command == "trace":
+            result = client.trace(chrome=args.chrome)
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as fh:
+                    json.dump(result, fh, indent=2)
+                print(f"wrote {args.out}")
+                return 0
         else:
             result = getattr(client, args.command)()
     except (urllib.error.URLError, OSError) as exc:

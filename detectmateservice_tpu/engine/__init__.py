@@ -1,4 +1,6 @@
 from .engine import Engine, EngineException, Processor, BatchProcessor
+from .framing import Hop, TraceContext
+from .tracing import FlightRecorder
 from .socket import (
     EngineSocket,
     EngineSocketFactory,
@@ -18,6 +20,9 @@ __all__ = [
     "EngineException",
     "Processor",
     "BatchProcessor",
+    "Hop",
+    "TraceContext",
+    "FlightRecorder",
     "EngineSocket",
     "EngineSocketFactory",
     "TransportAgain",

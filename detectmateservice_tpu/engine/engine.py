@@ -31,11 +31,23 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import List, Optional, Protocol, runtime_checkable
 
 from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
 from . import metrics as m
-from .framing import FramingError, frame_msg_count, pack_batch, unpack_batch
+from .framing import (
+    MAGIC_V2,
+    FramingError,
+    Hop,
+    TraceContext,
+    frame_msg_count,
+    pack_batch,
+    unpack_batch,
+    unwrap_trace,
+    wrap_trace,
+)
+from .tracing import FlightRecorder
 from .socket import (
     EngineSocket,
     EngineSocketFactory,
@@ -111,6 +123,31 @@ class Engine:
             component_type=settings.component_type,
             component_id=settings.component_id or "unknown",
         )
+
+        # pipeline tracing (engine_trace): hop stamping + the flight
+        # recorder behind GET /admin/trace. Inbound v2 headers are stripped
+        # even when tracing is off (clean downgrade for v1-only peers);
+        # stamping/forwarding only happens when this sender opted in. Trace
+        # handling rides the batch-frame magic detection, so the autodetect
+        # gate governs it too.
+        self._trace_enabled = bool(
+            getattr(settings, "engine_trace", False)
+            and getattr(settings, "engine_frame_autodetect", True))
+        self._trace_stage = (getattr(settings, "trace_stage", None)
+                             or settings.component_name
+                             or settings.component_type)
+        self._trace_terminal = getattr(settings, "trace_terminal", None)
+        # FIFO of (TraceContext, recv_ns) for frames of the burst being
+        # dispatched; consumed by outgoing v2 frames, finalized at burst end
+        self._trace_pending: deque = deque()
+        self.trace_recorder = FlightRecorder(
+            max_slowest=getattr(settings, "trace_slowest", 32),
+            max_sampled=getattr(settings, "trace_sampled", 128),
+            sample_every=getattr(settings, "trace_sample_every", 64))
+        if self._trace_enabled:
+            self._dwell_obs = m.PIPELINE_STAGE_DWELL().labels(**self._labels).observe
+            self._transit_obs = m.PIPELINE_TRANSIT().labels(**self._labels).observe
+            self._e2e_obs = m.PIPELINE_E2E_LATENCY().labels(**self._labels).observe
 
         # input socket (close nothing else exists yet on failure)
         self._pair_sock: EngineSocket = self._create_ingress()
@@ -236,6 +273,66 @@ class Engine:
         return self._running
 
     # -- hot loop -------------------------------------------------------
+    def _ingest_trace(self, raw: bytes, err_c) -> Optional[bytes]:
+        """Strip (and, when tracing, record) a v2 trace header from one wire
+        frame. Returns the v1-equivalent payload — byte-identical to what an
+        untraced sender would have emitted — or None when the frame is
+        unusable. One clock read per frame, never per message; a garbled
+        trace block is counted as a framing error but its payload messages
+        survive (the block is skipped by its declared length)."""
+        ctx = None
+        if raw.startswith(MAGIC_V2):
+            try:
+                raw, ctx, damaged = unwrap_trace(raw)
+            except FramingError as exc:
+                err_c.inc()
+                self.logger.error("corrupt traced frame dropped: %s", exc)
+                return None
+            if damaged:
+                err_c.inc()
+                self.logger.warning(
+                    "garbled trace block stripped; payload messages kept")
+        if not self._trace_enabled:
+            return raw
+        now = time.time_ns()
+        if ctx is not None:
+            prev = ctx.hops[-1].send_ns if ctx.hops else ctx.ingest_ns
+            self._transit_obs(max(0, now - prev) / 1e9)
+        else:
+            # untraced inbound (or a damaged block): this stage originates
+            ctx = TraceContext.new(now)
+        self._trace_pending.append((ctx, now))
+        return raw
+
+    def _stamp_trace(self, payload: bytes, now_ns: int) -> bytes:
+        """Complete the oldest pending context's hop and wrap ``payload``
+        as a v2 frame for the downstream stage."""
+        ctx, recv_ns = self._trace_pending.popleft()
+        ctx.hops.append(Hop(self._trace_stage, recv_ns, now_ns))
+        self._dwell_obs(max(0, now_ns - recv_ns) / 1e9)
+        return wrap_trace(payload, ctx)
+
+    def _finalize_traces(self) -> None:
+        """Close out contexts whose frames did not leave as v2 (filtered
+        messages, deferred/pipelined outputs, or a terminal stage). Dwell is
+        observed for every context; e2e latency and the flight recorder fire
+        only at the terminal stage — no forwarding outputs, or the
+        ``trace_terminal`` override — where the trace's life genuinely
+        ends."""
+        if not self._trace_pending:
+            return
+        now = time.time_ns()
+        terminal = (self._trace_terminal if self._trace_terminal is not None
+                    else not self._out_socks)
+        while self._trace_pending:
+            ctx, recv_ns = self._trace_pending.popleft()
+            ctx.hops.append(Hop(self._trace_stage, recv_ns, now))
+            self._dwell_obs(max(0, now - recv_ns) / 1e9)
+            if terminal:
+                e2e = max(0, now - ctx.ingest_ns) / 1e9
+                self._e2e_obs(e2e)
+                self.trace_recorder.record(ctx, e2e)
+
     def _expand_frame(self, raw: bytes, read_b, read_l, err_c) -> List[bytes]:
         """One wire frame → its messages. Batch frames (framing.py) are
         auto-detected by magic — the 0xD7 lead byte cannot open a valid
@@ -250,6 +347,13 @@ class Engine:
         if not getattr(self.settings, "engine_frame_autodetect", True):
             read_l.inc(_count_lines(raw))
             return [raw]
+        # first-byte probe before the slice compare: protobuf payloads never
+        # start 0xD7, so the untraced common case pays one int compare here
+        if self._trace_enabled or (raw[0] == 0xD7
+                                   and raw.startswith(MAGIC_V2)):
+            raw = self._ingest_trace(raw, err_c)
+            if not raw:
+                return []
         try:
             msgs = unpack_batch(raw)
         except FramingError as exc:
@@ -305,6 +409,9 @@ class Engine:
         read_b = m.DATA_READ_BYTES().labels(**self._labels)
         read_l = m.DATA_READ_LINES().labels(**self._labels)
         err_c = m.PROCESSING_ERRORS().labels(**self._labels)
+        # burst-level gauge (set once per dispatch, not per message): pinned
+        # at engine_batch_size means the ingress is saturating the engine
+        ingress_g = m.INGRESS_BACKLOG().labels(**self._labels)
         batch_size = max(1, self.settings.engine_batch_size)
         batch_fn = getattr(self.processor, "process_batch", None)
         use_batches = batch_size > 1 and callable(batch_fn)
@@ -390,29 +497,45 @@ class Engine:
                 # component's per-call batch cap holds to within one
                 # frame's overshoot — without it a sustained packed burst
                 # would hand the component millions of messages per call.
+                # v2 trace headers are stripped HERE, host-side, so the
+                # native expand path (dm_count_frame_msgs /
+                # dm_featurize_frames) only ever sees v1 wire units.
                 read_b.inc(len(raw))
-                frames = [raw]
-                est = [frame_msg_count(raw)]
+                raw = (self._ingest_trace(raw, err_c)
+                       if self._trace_enabled or raw.startswith(MAGIC_V2)
+                       else raw)
+                frames = [raw] if raw else []
+                est = [frame_msg_count(raw) if raw else 0]
 
                 def on_frame(nxt: bytes) -> None:
                     read_b.inc(len(nxt))
+                    if self._trace_enabled or nxt.startswith(MAGIC_V2):
+                        nxt = self._ingest_trace(nxt, err_c)
+                        if not nxt:
+                            return
                     frames.append(nxt)
                     est[0] += frame_msg_count(nxt)
 
                 self._collect_burst(time.monotonic() + batch_timeout_s,
                                     lambda: batch_size - est[0], on_frame)
+                if not frames:
+                    continue
+                ingress_g.set(est[0])
                 try:
                     outs, _n_msgs, n_lines = frames_fn(frames)
                 except Exception as exc:
                     err_c.inc(len(frames))
                     self.logger.error("process_frames() raised: %s", exc)
+                    self._finalize_traces()
                     continue
                 read_l.inc(n_lines)
                 self._send_results(outs)
+                self._finalize_traces()
                 continue
 
             msgs = self._expand_frame(raw, read_b, read_l, err_c)
             if not msgs:
+                self._finalize_traces()
                 continue
             origin = self._pair_sock.last_origin if track_origins else None
 
@@ -426,6 +549,8 @@ class Engine:
                         continue
                     if out is not None:
                         self._send_results([out], [origin])
+                if self._trace_pending:
+                    self._finalize_traces()
                 continue
 
             # micro-batch mode: drain what arrived within the window. The
@@ -453,6 +578,7 @@ class Engine:
                 on_burst_frame,
                 per_frame=(track_origins and
                            getattr(self._pair_sock, "peer_count", 1) > 1))
+            ingress_g.set(len(batch))
             # a packed ingress frame can carry more messages than
             # engine_batch_size; re-chunk so the component never sees a batch
             # beyond the configured cap (its memory/latency contract)
@@ -472,6 +598,8 @@ class Engine:
                                        batch_origins[start:start + batch_size])
                 else:
                     self._send_results(outs)
+            if self._trace_pending:
+                self._finalize_traces()
 
         # loop exiting (stop requested): drain the pipeline before sockets
         # close — flush_final (when provided) also waits out work the
@@ -482,6 +610,7 @@ class Engine:
                 self._send_results(final_fn())
             except Exception as exc:
                 self.logger.error("flush at stop raised: %s", exc)
+        self._finalize_traces()
 
     # -- fan-out --------------------------------------------------------
     def _send_results(self, outs, origins=None) -> None:
@@ -495,13 +624,23 @@ class Engine:
         message's originating-connection token for reply mode on a fan-in
         listener: replies route to the exact requester instead of the
         last-recv heuristic. Packing only groups consecutive same-origin
-        replies — a packed frame has one destination."""
+        replies — a packed frame has one destination.
+
+        With tracing enabled and forwarding outputs, each outgoing frame
+        consumes the oldest pending trace context (FIFO — exact when frames
+        map 1:1 through the stage, approximate under merging/re-chunking)
+        and leaves as a v2 traced frame; replies (no outputs) never carry
+        trace headers — that stage is the pipeline terminal."""
         frame_batch = getattr(self.settings, "engine_frame_batch", 1)
         if origins is not None and len(origins) == len(outs):
             pending = [(o, origins[i]) for i, o in enumerate(outs)
                        if o is not None]
         else:
             pending = [(o, None) for o in outs if o is not None]
+        attach = bool(self._trace_enabled and self._out_socks
+                      and not self._trace_terminal
+                      and self._trace_pending and pending)
+        now_ns = time.time_ns() if attach else 0  # one clock read per call
         start = 0
         while start < len(pending):
             end = start + 1
@@ -515,11 +654,17 @@ class Engine:
             chunk = [p[0] for p in pending[start:end]]
             origin = pending[start][1]
             if len(chunk) == 1:
-                self._send_to_outputs(chunk[0], origin=origin)
+                data, lines = chunk[0], None
             else:
-                self._send_to_outputs(pack_batch(chunk),
-                                      lines=sum(map(_count_lines, chunk)),
-                                      origin=origin)
+                data = pack_batch(chunk)
+                lines = sum(map(_count_lines, chunk))
+            if attach and self._trace_pending:
+                # line/byte metrics must count payload, not header, bytes —
+                # a varint inside the trace block can collide with '\n'
+                if lines is None:
+                    lines = _count_lines(data)
+                data = self._stamp_trace(data, now_ns)
+            self._send_to_outputs(data, lines=lines, origin=origin)
             start = end
 
     def _send_to_outputs(self, data: bytes, lines: Optional[int] = None,
@@ -587,7 +732,9 @@ class Engine:
             # ``out_stop_drain_ms`` window starting when the stop flag is
             # first observed — aggregate, so a multi-message final flush
             # stays inside the 2 s stop-join deadline.
+            backlog_g = m.OUTPUT_SEND_BACKLOG().labels(**self._labels)
             pending_socks = list(self._out_socks)
+            waited = False
             while pending_socks:
                 if not self._running or self._stop_event.is_set():
                     if self._stop_drain_deadline is None:
@@ -610,13 +757,20 @@ class Engine:
                         continue
                     mark_sent()
                 if len(still) == len(pending_socks):
+                    # gauge only touched on the already-slow stalled path,
+                    # so an unobstructed send pays nothing for it
+                    backlog_g.set(len(still))
+                    waited = True
                     time.sleep(0.001)
                 pending_socks = still
             for _ in pending_socks:  # stop-drain deadline expired
                 dropped_b.inc(len(data))
                 dropped_l.inc(lines)
+            if waited:
+                backlog_g.set(0)
             return any_ok
 
+        waited = False
         for sock in self._out_socks:
             sent = False
             for _ in range(self.settings.engine_retry_count):
@@ -625,6 +779,10 @@ class Engine:
                     sent = True
                     break
                 except TransportAgain:
+                    if not waited:
+                        # gauge only touched once a peer actually stalls
+                        m.OUTPUT_SEND_BACKLOG().labels(**self._labels).set(1)
+                        waited = True
                     time.sleep(_RETRY_SLEEP_S)
                 except TransportError as exc:
                     self.logger.warning("output send failed hard: %s", exc)
@@ -634,4 +792,6 @@ class Engine:
             else:
                 dropped_b.inc(len(data))
                 dropped_l.inc(lines)
+        if waited:
+            m.OUTPUT_SEND_BACKLOG().labels(**self._labels).set(0)
         return any_ok

@@ -17,12 +17,34 @@ protobuf) can begin with it: receivers can safely auto-detect batch frames
 and stay wire-compatible with single-message peers. Senders only emit batch
 frames when ``engine_frame_batch > 1`` is configured, so interop with
 reference-style peers is the default.
+
+Wire format (version 2, traced frames — opt-in via ``engine_trace``):
+
+    0xD7 'D' 'M' 0x02 | varint trace_len | trace block | payload
+
+``payload`` is a complete v1 wire unit — either a v1 batch frame or a plain
+single message — so downgrading a v2 frame for a v1-only peer is a slice:
+everything after the trace block, byte-identical to what an untraced sender
+would have emitted. The trace block:
+
+    trace_id (8 bytes) | varint ingest_ns | varint n_hops
+    | n_hops × (varint name_len | name utf-8 | varint recv_ns | varint send_ns)
+
+Timestamps are ``time.time_ns()`` epoch nanoseconds — comparable across the
+processes of one pipeline host (and across NTP-synced hosts to clock-sync
+precision). The length prefix exists for damage containment: a garbled trace
+block is skipped by its declared length and the payload messages survive
+(the error is counted); only a declared length running past the frame end
+loses the frame.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+import itertools
+import os
+from typing import List, NamedTuple, Optional, Tuple
 
 MAGIC = b"\xd7DM\x01"
+MAGIC_V2 = b"\xd7DM\x02"
 
 
 class FramingError(ValueError):
@@ -68,11 +90,21 @@ def pack_batch(messages: List[bytes]) -> bytes:
 
 def frame_msg_count(data: bytes) -> int:
     """Cheap message-count estimate for burst sizing: the header varint of a
-    batch frame, 1 for a single message, 0 for an empty/garbled header. Does
-    NOT validate the body — use ``unpack_batch`` (or the native kernel's
-    count pass) for that."""
+    batch frame, 1 for a single message, 0 for an empty/garbled header.
+    v2 (traced) frames are counted by their payload. Does NOT validate the
+    body — use ``unpack_batch`` (or the native kernel's count pass) for
+    that."""
     if not data:
         return 0
+    if data.startswith(MAGIC_V2):
+        try:
+            trace_len, pos = _get_varint(data, len(MAGIC_V2))
+        except FramingError:
+            return 0
+        start = pos + trace_len
+        if start > len(data):
+            return 0
+        return frame_msg_count(data[start:])
     if not data.startswith(MAGIC):
         return 1
     try:
@@ -80,6 +112,124 @@ def frame_msg_count(data: bytes) -> int:
     except FramingError:
         return 0
     return count
+
+
+# -- trace context (v2 frames) ----------------------------------------------
+
+# trace-id stream: one getrandom() at import, then a counter (GIL-atomic
+# ``next``) — collision-safe within a process by construction, across
+# processes by the 64-bit random base
+_TRACE_ID_BASE = int.from_bytes(os.urandom(8), "big")
+_TRACE_ID_SEQ = itertools.count()
+
+
+class Hop(NamedTuple):
+    """One stage transit record: when the frame entered and left the stage."""
+
+    stage: str
+    recv_ns: int
+    send_ns: int
+
+
+class TraceContext:
+    """Per-frame trace state threaded through the wire (v2 trace block)."""
+
+    __slots__ = ("trace_id", "ingest_ns", "hops")
+
+    def __init__(self, trace_id: int, ingest_ns: int,
+                 hops: Optional[List[Hop]] = None) -> None:
+        self.trace_id = trace_id
+        self.ingest_ns = ingest_ns
+        self.hops: List[Hop] = hops if hops is not None else []
+
+    @classmethod
+    def new(cls, ingest_ns: int) -> "TraceContext":
+        # random 64-bit base + per-process counter, not os.urandom per
+        # trace: id generation sits on the per-frame ingest path and a
+        # getrandom(2) syscall there costs more than the whole hop stamp
+        return cls((_TRACE_ID_BASE + next(_TRACE_ID_SEQ))
+                   & 0xFFFFFFFFFFFFFFFF, ingest_ns)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, TraceContext)
+                and self.trace_id == other.trace_id
+                and self.ingest_ns == other.ingest_ns
+                and self.hops == other.hops)
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id:#018x}, ingest={self.ingest_ns},"
+                f" hops={self.hops!r})")
+
+
+def pack_trace_block(ctx: TraceContext) -> bytes:
+    out = bytearray(ctx.trace_id.to_bytes(8, "big"))
+    _put_varint(out, ctx.ingest_ns)
+    _put_varint(out, len(ctx.hops))
+    for hop in ctx.hops:
+        name = hop.stage.encode("utf-8")
+        _put_varint(out, len(name))
+        out += name
+        _put_varint(out, hop.recv_ns)
+        _put_varint(out, hop.send_ns)
+    return bytes(out)
+
+
+def parse_trace_block(block: bytes) -> TraceContext:
+    """Trace block bytes → TraceContext; raises FramingError on damage."""
+    if len(block) < 8:
+        raise FramingError("trace block shorter than the 8-byte trace id")
+    trace_id = int.from_bytes(block[:8], "big")
+    ingest_ns, pos = _get_varint(block, 8)
+    n_hops, pos = _get_varint(block, pos)
+    hops: List[Hop] = []
+    for _ in range(n_hops):
+        name_len, pos = _get_varint(block, pos)
+        end = pos + name_len
+        if end > len(block):
+            raise FramingError("truncated hop name in trace block")
+        try:
+            stage = block[pos:end].decode("utf-8")
+        except UnicodeDecodeError as exc:
+            raise FramingError(f"non-UTF-8 hop name in trace block: {exc}")
+        pos = end
+        recv_ns, pos = _get_varint(block, pos)
+        send_ns, pos = _get_varint(block, pos)
+        hops.append(Hop(stage, recv_ns, send_ns))
+    if pos != len(block):
+        raise FramingError("trailing bytes after trace block hops")
+    return TraceContext(trace_id, ingest_ns, hops)
+
+
+def wrap_trace(payload: bytes, ctx: TraceContext) -> bytes:
+    """Payload (a v1 batch frame or a plain single message) → v2 frame."""
+    block = pack_trace_block(ctx)
+    out = bytearray(MAGIC_V2)
+    _put_varint(out, len(block))
+    out += block
+    out += payload
+    return bytes(out)
+
+
+def unwrap_trace(data: bytes) -> Tuple[bytes, Optional[TraceContext], bool]:
+    """v2 frame → ``(payload, trace, trace_damaged)``.
+
+    Non-v2 input passes through as ``(data, None, False)``. A v2 frame whose
+    trace block is internally garbled still yields its payload — the block is
+    skipped by its declared length and ``trace_damaged`` is True so the
+    caller can count a framing error without dropping the payload messages.
+    Only a declared trace length running past the frame end (no payload can
+    exist) raises FramingError."""
+    if not data.startswith(MAGIC_V2):
+        return data, None, False
+    trace_len, pos = _get_varint(data, len(MAGIC_V2))
+    start = pos + trace_len
+    if start > len(data):
+        raise FramingError("trace block length exceeds frame size")
+    try:
+        ctx = parse_trace_block(data[pos:start])
+    except FramingError:
+        return data[start:], None, True
+    return data[start:], ctx, False
 
 
 def unpack_batch(data: bytes) -> Optional[List[bytes]]:

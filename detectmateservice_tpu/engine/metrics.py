@@ -3,7 +3,15 @@
 The reference keeps one module-global registry and needs idempotent metric
 creation because tests build several services per process (reference:
 src/service/core.py:45-52 scans ``REGISTRY._collector_to_names``). We keep a
-private name → collector map instead of scanning private registry state.
+private name → collector map instead: every series this package emits is
+declared below via ``_series`` and created exactly once through
+``get_or_create``, whose cache — not private prometheus_client registry
+state — is the authority for "already exists".
+
+``REGISTERED_SERIES`` maps every declared exposition name to its metric
+class; tests/test_observability.py derives the dashboard-sync known-series
+set from it, so a new series here is automatically held to dashboard
+coverage.
 
 Metric names and label sets are the reference's observable contract
 (reference: src/service/core.py:24-61, src/service/features/engine.py:14-54,
@@ -12,9 +20,9 @@ docs/prometheus.md:29-47) and must not change.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Sequence, Type
+from typing import Callable, Dict, Sequence, Type
 
-from prometheus_client import REGISTRY, Counter, Enum, Gauge, Histogram
+from prometheus_client import Counter, Enum, Gauge, Histogram
 
 _LOCK = threading.Lock()
 _CACHE: Dict[str, object] = {}
@@ -27,21 +35,18 @@ def get_or_create(
     labelnames: Sequence[str] = (),
     **kwargs,
 ):
-    """Return the process-wide collector for ``name``, creating it once."""
+    """Return the process-wide collector for ``name``, creating it once.
+
+    All of this package's metric creation funnels through here under one
+    lock, so our ``_CACHE`` is the single source of truth — a duplicate
+    ``ValueError`` from prometheus_client would mean some *other* code
+    registered the name first, which is a real conflict to surface, not one
+    to paper over by scanning the registry's private state."""
     with _LOCK:
         found = _CACHE.get(name)
         if found is not None:
             return found
-        try:
-            metric = metric_cls(name, documentation, labelnames=labelnames, **kwargs)
-        except ValueError:
-            # registered by someone else (e.g. an earlier non-cached path):
-            # locate it in the default registry
-            for collector, names in list(REGISTRY._collector_to_names.items()):
-                if name in names or any(n.startswith(name) for n in names):
-                    _CACHE[name] = collector
-                    return collector
-            raise
+        metric = metric_cls(name, documentation, labelnames=labelnames, **kwargs)
         _CACHE[name] = metric
         return metric
 
@@ -49,38 +54,85 @@ def get_or_create(
 # -- reference metric contract (labels: component_type, component_id) -------
 LABELS = ("component_type", "component_id")
 
+# every exposition name this package can emit → metric class; the declared
+# lambda registry tests iterate (see module docstring)
+REGISTERED_SERIES: Dict[str, Type] = {}
+
+
+def _series(metric_cls: Type, name: str, documentation: str,
+            labelnames: Sequence[str] = LABELS, **kwargs) -> Callable:
+    REGISTERED_SERIES[name] = metric_cls
+    return lambda: get_or_create(metric_cls, name, documentation,
+                                 labelnames, **kwargs)
+
+
 # engine-owned series (reference: engine.py:14-54)
-DATA_READ_BYTES = lambda: get_or_create(Counter, "data_read_bytes_total", "Bytes read from the engine socket", LABELS)
-DATA_READ_LINES = lambda: get_or_create(Counter, "data_read_lines_total", "Lines read from the engine socket", LABELS)
-DATA_WRITTEN_BYTES = lambda: get_or_create(Counter, "data_written_bytes_total", "Bytes written to outputs", LABELS)
-DATA_WRITTEN_LINES = lambda: get_or_create(Counter, "data_written_lines_total", "Lines written to outputs", LABELS)
-DATA_DROPPED_BYTES = lambda: get_or_create(Counter, "data_dropped_bytes_total", "Bytes dropped on slow/dead outputs", LABELS)
-DATA_DROPPED_LINES = lambda: get_or_create(Counter, "data_dropped_lines_total", "Lines dropped on slow/dead outputs", LABELS)
-PROCESSING_ERRORS = lambda: get_or_create(Counter, "processing_errors_total", "Exceptions raised by process()", LABELS)
+DATA_READ_BYTES = _series(Counter, "data_read_bytes_total", "Bytes read from the engine socket")
+DATA_READ_LINES = _series(Counter, "data_read_lines_total", "Lines read from the engine socket")
+DATA_WRITTEN_BYTES = _series(Counter, "data_written_bytes_total", "Bytes written to outputs")
+DATA_WRITTEN_LINES = _series(Counter, "data_written_lines_total", "Lines written to outputs")
+DATA_DROPPED_BYTES = _series(Counter, "data_dropped_bytes_total", "Bytes dropped on slow/dead outputs")
+DATA_DROPPED_LINES = _series(Counter, "data_dropped_lines_total", "Lines dropped on slow/dead outputs")
+PROCESSING_ERRORS = _series(Counter, "processing_errors_total", "Exceptions raised by process()")
 
 # service-owned series (reference: core.py:24-61)
-ENGINE_RUNNING = lambda: get_or_create(Enum, "engine_running", "Engine run state", LABELS, states=["running", "stopped"])
-ENGINE_STARTS = lambda: get_or_create(Counter, "engine_starts_total", "Engine starts", LABELS)
-PROCESSING_DURATION = lambda: get_or_create(
+ENGINE_RUNNING = _series(Enum, "engine_running", "Engine run state", states=["running", "stopped"])
+ENGINE_STARTS = _series(Counter, "engine_starts_total", "Engine starts")
+PROCESSING_DURATION = _series(
     Histogram,
     "processing_duration_seconds",
     "End-to-end process() duration",
-    LABELS,
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
 )
-DATA_PROCESSED_BYTES = lambda: get_or_create(Counter, "data_processed_bytes_total", "Bytes handed to process()", LABELS)
-DATA_PROCESSED_LINES = lambda: get_or_create(Counter, "data_processed_lines_total", "Lines handed to process()", LABELS)
+DATA_PROCESSED_BYTES = _series(Counter, "data_processed_bytes_total", "Bytes handed to process()")
+DATA_PROCESSED_LINES = _series(Counter, "data_processed_lines_total", "Lines handed to process()")
 
 # TPU-build additions: per-chip throughput (BASELINE.json north star asks the
 # /metrics endpoint to report per-chip rates; new series, new 'device' label,
 # existing series untouched)
 DEVICE_LABELS = ("component_type", "component_id", "device")
-DEVICE_BATCHES = lambda: get_or_create(Counter, "detector_device_batches_total", "Scored batches per device", DEVICE_LABELS)
-DEVICE_LINES = lambda: get_or_create(Counter, "detector_device_lines_total", "Scored lines per device", DEVICE_LABELS)
-BATCH_SIZE_HIST = lambda: get_or_create(
+DEVICE_BATCHES = _series(Counter, "detector_device_batches_total", "Scored batches per device", DEVICE_LABELS)
+DEVICE_LINES = _series(Counter, "detector_device_lines_total", "Scored lines per device", DEVICE_LABELS)
+BATCH_SIZE_HIST = _series(
     Histogram,
     "detector_batch_size",
     "Dispatched micro-batch sizes",
-    LABELS,
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+
+# pipeline tracing series (engine_trace: true — engine.py hop stamping).
+# Stage dwell and transit are observed by every tracing stage; e2e only by
+# the terminal stage (no forwarding outputs), so its count is the pipeline's
+# completed-trace count, not a per-hop multiple.
+_DWELL_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+PIPELINE_STAGE_DWELL = _series(
+    Histogram,
+    "pipeline_stage_dwell_seconds",
+    "Frame time inside this stage: ingress recv to egress send",
+    buckets=_DWELL_BUCKETS,
+)
+PIPELINE_TRANSIT = _series(
+    Histogram,
+    "pipeline_transit_seconds",
+    "Wire + queue time from the upstream stage's send to this stage's recv",
+    buckets=_DWELL_BUCKETS,
+)
+PIPELINE_E2E_LATENCY = _series(
+    Histogram,
+    "pipeline_e2e_latency_seconds",
+    "Pipeline ingest to terminal-stage completion (terminal stage only)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0),
+)
+INGRESS_BACKLOG = _series(
+    Gauge,
+    "engine_ingress_backlog",
+    "Messages drained into the current dispatch burst; pinned at "
+    "engine_batch_size means the ingress is saturated",
+)
+OUTPUT_SEND_BACKLOG = _series(
+    Gauge,
+    "output_send_backlog",
+    "Output sockets currently waiting on a full peer queue",
 )

@@ -1,0 +1,119 @@
+"""Pipeline flight recorder: bounded in-memory store of completed traces.
+
+The terminal stage of a traced pipeline (the engine with no forwarding
+outputs — reply mode or an output component) finalizes each frame's
+TraceContext and hands it here with its end-to-end latency. The recorder
+keeps two bounded views:
+
+* the N **slowest** traces seen since start/reset (a min-heap on e2e), so
+  the tail that matters for debugging is never evicted by volume, and
+* a **sampled** ring of every Kth completed trace, so the recorder also
+  shows what *normal* looks like.
+
+``GET /admin/trace`` (web/server.py) serves ``snapshot()`` as JSON and
+``chrome_events()`` as a Chrome trace-event document loadable in Perfetto /
+chrome://tracing — each hop becomes a complete ("X") slice on its stage's
+track, and inter-stage wire+queue time becomes a "transit" slice, so the
+pipeline bottleneck is visible as the widest box.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from collections import deque
+from typing import Any, Dict, List
+
+from .framing import TraceContext
+
+
+def trace_to_dict(ctx: TraceContext, e2e_s: float) -> Dict[str, Any]:
+    return {
+        "trace_id": f"{ctx.trace_id:016x}",
+        "ingest_ns": ctx.ingest_ns,
+        "e2e_seconds": e2e_s,
+        "hops": [
+            {"stage": h.stage, "recv_ns": h.recv_ns, "send_ns": h.send_ns}
+            for h in ctx.hops
+        ],
+    }
+
+
+class FlightRecorder:
+    def __init__(self, max_slowest: int = 32, max_sampled: int = 128,
+                 sample_every: int = 64) -> None:
+        self._lock = threading.Lock()
+        self._max_slowest = max(1, max_slowest)
+        self._sample_every = max(1, sample_every)
+        # heap entries carry a tiebreak counter: equal-e2e dicts must never
+        # be compared by heapq
+        self._tiebreak = itertools.count()
+        self._slowest: List[tuple] = []  # min-heap of (e2e_s, n, trace_dict)
+        self._sampled: deque = deque(maxlen=max(1, max_sampled))
+        self._completed = 0
+
+    def record(self, ctx: TraceContext, e2e_s: float) -> None:
+        entry = trace_to_dict(ctx, e2e_s)
+        with self._lock:
+            self._completed += 1
+            if len(self._slowest) < self._max_slowest:
+                heapq.heappush(self._slowest,
+                               (e2e_s, next(self._tiebreak), entry))
+            elif e2e_s > self._slowest[0][0]:
+                heapq.heapreplace(self._slowest,
+                                  (e2e_s, next(self._tiebreak), entry))
+            if self._completed % self._sample_every == 1 or self._sample_every == 1:
+                self._sampled.append(entry)
+
+    @property
+    def completed(self) -> int:
+        with self._lock:
+            return self._completed
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            slowest = [e[2] for e in sorted(self._slowest,
+                                            key=lambda e: -e[0])]
+            sampled = list(self._sampled)
+            completed = self._completed
+        return {"completed": completed, "slowest": slowest,
+                "sampled": sampled}
+
+    def chrome_events(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (Perfetto / chrome://tracing loadable)."""
+        snap = self.snapshot()
+        seen = set()
+        events: List[Dict[str, Any]] = []
+        for trace in snap["slowest"] + snap["sampled"]:
+            if trace["trace_id"] in seen:
+                continue
+            seen.add(trace["trace_id"])
+            pid = int(trace["trace_id"], 16) % (1 << 31)
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"trace {trace['trace_id']}"},
+            })
+            prev_send = trace["ingest_ns"]
+            for hop in trace["hops"]:
+                if hop["recv_ns"] > prev_send:
+                    events.append({
+                        "name": "transit", "cat": "pipeline", "ph": "X",
+                        "pid": pid, "tid": 0,
+                        "ts": prev_send / 1000.0,
+                        "dur": (hop["recv_ns"] - prev_send) / 1000.0,
+                    })
+                events.append({
+                    "name": hop["stage"], "cat": "pipeline", "ph": "X",
+                    "pid": pid, "tid": 0,
+                    "ts": hop["recv_ns"] / 1000.0,
+                    "dur": max(0, hop["send_ns"] - hop["recv_ns"]) / 1000.0,
+                    "args": {"trace_id": trace["trace_id"]},
+                })
+                prev_send = hop["send_ns"]
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slowest.clear()
+            self._sampled.clear()
+            self._completed = 0

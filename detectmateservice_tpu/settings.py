@@ -166,6 +166,28 @@ class ServiceSettings(BaseModel):
     # that could legitimately begin with b"\xd7DM\x01" (UTF-8 "×DM…") must
     # disable detection or such a payload would be mis-split/dropped.
     engine_frame_autodetect: bool = True
+    # pipeline tracing (engine/framing.py v2 frames): opt-in PER SENDER like
+    # engine_frame_batch — when true this engine stamps hop records and emits
+    # v2 traced frames downstream; framework receivers auto-detect and strip
+    # or propagate them. Leave false (the default) on links whose peer is a
+    # v1-only or raw-protobuf consumer: wire bytes then stay byte-identical
+    # to the untraced format. Requires engine_frame_autodetect (v2 headers
+    # ride the same magic-byte detection as batch frames).
+    engine_trace: bool = False
+    # stage name stamped into hop records; defaults to component_name or
+    # component_type so a 3-stage pipeline reads parser→detector→output
+    trace_stage: Optional[str] = None
+    # terminal-stage override. Default (None) = auto: a stage with no
+    # forwarding outputs finalizes traces (observes e2e, feeds the flight
+    # recorder). Set true on a stage that DOES forward (e.g. an output
+    # writer with a downstream sink that is not a framework engine): it
+    # finalizes instead of propagating, and its downstream sees plain v1.
+    trace_terminal: Optional[bool] = None
+    # flight recorder bounds (engine/tracing.py): N slowest traces kept,
+    # ring of sampled traces, and the 1-in-K completed-trace sampling rate
+    trace_slowest: int = Field(default=32, ge=1, le=1024)
+    trace_sampled: int = Field(default=128, ge=1, le=8192)
+    trace_sample_every: int = Field(default=64, ge=1)
     # fan-out under backpressure: "drop" = the reference contract (bounded
     # retries with 10 ms sleeps, then drop + count — engine.py:286-296);
     # "block" = flow control (send blocks until the peer drains), the right

@@ -12,7 +12,10 @@ The reference runs FastAPI/uvicorn on a thread with signal handlers disabled
 (reference: server.py:40-42); this environment has neither, so the server is a
 stdlib ``ThreadingHTTPServer`` on a daemon thread — same observable surface,
 zero extra dependencies. The TPU build adds ``POST /admin/profile`` to capture
-a jax.profiler trace (closes the tracing gap noted in SURVEY.md §5.1).
+a jax.profiler trace and ``GET /admin/trace`` to read the engine's pipeline
+flight recorder — ``?format=chrome`` returns a Perfetto/chrome://tracing
+loadable trace-event document (closes the tracing gap noted in SURVEY.md
+§5.1 at both the device and the pipeline layer).
 """
 from __future__ import annotations
 
@@ -21,6 +24,7 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
 
@@ -98,10 +102,26 @@ def _make_handler(service):
 
         # -- routes ----------------------------------------------------
         def do_GET(self) -> None:
-            if self.path == "/metrics":
+            parsed = urlparse(self.path)
+            if parsed.path == "/metrics":
                 self._send(200, generate_latest(), CONTENT_TYPE_LATEST)
-            elif self.path == "/admin/status":
+            elif parsed.path == "/admin/status":
                 self._send_json(200, service._create_status_report())
+            elif parsed.path == "/admin/trace":
+                query = parse_qs(parsed.query)
+                fmt = (query.get("format") or ["json"])[0]
+                recorder = getattr(service.engine, "trace_recorder", None)
+                if recorder is None:
+                    self._send_json(404, {"detail": "engine has no flight recorder"})
+                elif fmt == "chrome":
+                    self._send_json(200, recorder.chrome_events())
+                elif fmt == "json":
+                    body = recorder.snapshot()
+                    body["tracing_enabled"] = bool(
+                        getattr(service.settings, "engine_trace", False))
+                    self._send_json(200, body)
+                else:
+                    self._send_json(400, {"detail": f"unknown format {fmt!r}"})
             else:
                 self._send_json(404, {"detail": "not found"})
 

@@ -12,19 +12,16 @@ from pathlib import Path
 
 import yaml
 
+from detectmateservice_tpu.engine import metrics as _metrics
+
 OPS = Path(__file__).resolve().parent.parent / "ops"
 
-# every series name the exporter can emit (engine/metrics.py), plus the
-# suffixes prometheus_client derives for histograms/enums
-BASE_SERIES = {
-    "data_read_bytes_total", "data_read_lines_total",
-    "data_written_bytes_total", "data_written_lines_total",
-    "data_dropped_bytes_total", "data_dropped_lines_total",
-    "processing_errors_total", "engine_running", "engine_starts_total",
-    "processing_duration_seconds", "data_processed_bytes_total",
-    "data_processed_lines_total", "detector_device_batches_total",
-    "detector_device_lines_total", "detector_batch_size",
-}
+# every series name the exporter can emit, DERIVED from the declared lambda
+# registry in engine/metrics.py — a series added there is automatically held
+# to dashboard coverage here and can never silently drift out of the sync
+# check — plus the suffixes prometheus_client derives for histograms/enums
+BASE_SERIES = set(_metrics.REGISTERED_SERIES)
+assert "data_read_bytes_total" in BASE_SERIES  # registry sanity anchor
 DERIVED = {f"{n}_bucket" for n in BASE_SERIES} | {
     f"{n}_count" for n in BASE_SERIES} | {f"{n}_sum" for n in BASE_SERIES}
 KNOWN = BASE_SERIES | DERIVED
@@ -60,6 +57,17 @@ class TestGrafanaDashboard:
                      if "_" in m and m not in _PROMQL_KEYWORDS}
             unknown = names - KNOWN
             assert not unknown, f"panel {title!r} queries unknown metrics {unknown}"
+
+    def test_pipeline_tracing_series_have_panels(self):
+        """Reverse direction of the sync check: every pipeline-tracing
+        series the exporter declares is actually queried by some panel, so
+        the stage-dwell / e2e / backlog views cannot rot away."""
+        exprs = "\n".join(e for _, e in dashboard_exprs())
+        tracing = [n for n in BASE_SERIES
+                   if n.startswith("pipeline_") or n.endswith("_backlog")]
+        assert tracing, "metrics registry lost the pipeline tracing series"
+        for base in tracing:
+            assert re.search(rf"\b{base}", exprs), f"no panel queries {base}"
 
 
 class TestPrometheusScrapeConfig:

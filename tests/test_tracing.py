@@ -1,0 +1,379 @@
+"""Pipeline tracing: v2 traced wire frames, hop stamping, flight recorder.
+
+Tier-1 coverage for the cross-stage tracing layer:
+
+* the v2 wire format (framing.py): roundtrip, downgrade-by-slice, damage
+  containment (a garbled trace block never costs the payload messages),
+* v2 ↔ v1 interop through real engines — a trace-disabled engine strips
+  headers cleanly so v1-only peers see byte-identical v1 traffic,
+* the 3-stage in-process smoke: parser → detector → output with tracing on,
+  `/admin/trace` returns complete traces with monotonically ordered hops and
+  `/metrics` exposes the pipeline series (the PR's acceptance criterion).
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from detectmateservice_tpu.engine import Engine
+from detectmateservice_tpu.engine.framing import (
+    MAGIC,
+    MAGIC_V2,
+    FramingError,
+    Hop,
+    TraceContext,
+    frame_msg_count,
+    pack_batch,
+    pack_trace_block,
+    parse_trace_block,
+    unpack_batch,
+    unwrap_trace,
+    wrap_trace,
+    _put_varint,
+)
+from detectmateservice_tpu.engine.tracing import FlightRecorder
+from detectmateservice_tpu.settings import ServiceSettings
+
+from conftest import wait_until
+
+
+def make_settings(addr, outs=(), **kw):
+    return ServiceSettings(
+        component_type="core", engine_addr=addr, out_addr=list(outs),
+        log_to_file=False, **kw,
+    )
+
+
+def sample_ctx():
+    ctx = TraceContext.new(1_000_000)
+    ctx.hops.append(Hop("parser", 1_000_100, 1_000_900))
+    return ctx
+
+
+class TestTraceWireFormat:
+    def test_trace_block_roundtrip(self):
+        ctx = sample_ctx()
+        ctx.hops.append(Hop("detector", 1_001_000, 1_002_000))
+        assert parse_trace_block(pack_trace_block(ctx)) == ctx
+
+    def test_wrap_unwrap_roundtrip_batch_and_single(self):
+        ctx = sample_ctx()
+        for payload in (pack_batch([b"aa", b"bb", b"cc"]), b"one message"):
+            frame = wrap_trace(payload, ctx)
+            assert frame.startswith(MAGIC_V2)
+            got, got_ctx, damaged = unwrap_trace(frame)
+            assert (got, got_ctx, damaged) == (payload, ctx, False)
+
+    def test_downgrade_is_a_slice_byte_identical_v1(self):
+        """The payload section of a v2 frame IS the v1 wire unit — what an
+        untraced sender would have emitted, byte for byte."""
+        v1 = pack_batch([b"x" * 40, b"y"])
+        payload, _, _ = unwrap_trace(wrap_trace(v1, sample_ctx()))
+        assert payload == v1
+        assert unpack_batch(payload) == [b"x" * 40, b"y"]
+
+    def test_v1_and_plain_frames_pass_through(self):
+        v1 = pack_batch([b"m1", b"m2"])
+        assert unwrap_trace(v1) == (v1, None, False)
+        assert unwrap_trace(b"\x0aplain protobuf-ish") == (
+            b"\x0aplain protobuf-ish", None, False)
+
+    def test_frame_msg_count_on_v2_frames(self):
+        ctx = sample_ctx()
+        assert frame_msg_count(wrap_trace(pack_batch([b"a"] * 7), ctx)) == 7
+        assert frame_msg_count(wrap_trace(b"single", ctx)) == 1
+        # truncated declared length -> unusable frame counts 0
+        assert frame_msg_count(MAGIC_V2 + b"\x7f" + b"short") == 0
+
+    def test_garbled_trace_block_keeps_payload(self):
+        """Damage inside the declared block length is contained: payload
+        survives, caller is told to count a framing error."""
+        payload = pack_batch([b"keep", b"me"])
+        block = pack_trace_block(sample_ctx())[:-2] + b"\xff\xff"
+        frame = bytearray(MAGIC_V2)
+        _put_varint(frame, len(block))
+        frame += block + payload
+        got, ctx, damaged = unwrap_trace(bytes(frame))
+        assert got == payload
+        assert ctx is None
+        assert damaged
+
+    def test_trace_length_past_frame_end_raises(self):
+        with pytest.raises(FramingError):
+            unwrap_trace(MAGIC_V2 + b"\x7f" + b"way too short")
+
+
+class TestFlightRecorder:
+    def test_keeps_n_slowest_and_samples(self):
+        rec = FlightRecorder(max_slowest=3, max_sampled=8, sample_every=1)
+        for i in range(10):
+            ctx = TraceContext.new(i)
+            ctx.hops.append(Hop("s", i, i + 5))
+            rec.record(ctx, float(i))
+        snap = rec.snapshot()
+        assert snap["completed"] == 10
+        assert [t["e2e_seconds"] for t in snap["slowest"]] == [9.0, 8.0, 7.0]
+        assert len(snap["sampled"]) == 8  # ring evicted the oldest
+
+    def test_chrome_events_are_complete_slices(self):
+        rec = FlightRecorder(sample_every=1)
+        ctx = TraceContext.new(1_000)
+        ctx.hops.append(Hop("parser", 2_000, 5_000))
+        ctx.hops.append(Hop("output", 9_000, 12_000))
+        rec.record(ctx, 11e-6)
+        doc = rec.chrome_events()
+        slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        names = [e["name"] for e in slices]
+        # ingest->parser transit, parser dwell, parser->output transit, dwell
+        assert names == ["transit", "parser", "transit", "output"]
+        for e in slices:
+            assert e["dur"] > 0
+
+
+class EchoProcessor:
+    def process(self, data: bytes):
+        return data
+
+
+class TestTraceInterop:
+    """Satellite: v2 ↔ v1 frame interop through real engines."""
+
+    def test_untraced_sender_wire_is_byte_identical_v1(self, inproc_factory):
+        """engine_trace defaults off: nothing on the wire changes."""
+        sub = inproc_factory.create("inproc://ti0out")
+        sub.recv_timeout = 2000
+        engine = Engine(make_settings("inproc://ti0", ["inproc://ti0out"]),
+                        EchoProcessor(), inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://ti0")
+        client.send(b"untouched payload")
+        assert sub.recv() == b"untouched payload"
+        engine.stop()
+
+    def test_traced_sender_emits_v2_with_v1_payload(self, inproc_factory):
+        sub = inproc_factory.create("inproc://ti1out")
+        sub.recv_timeout = 2000
+        engine = Engine(
+            make_settings("inproc://ti1", ["inproc://ti1out"],
+                          engine_trace=True, trace_stage="parser"),
+            EchoProcessor(), inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://ti1")
+        client.send(b"hello")
+        frame = sub.recv()
+        assert frame.startswith(MAGIC_V2)
+        payload, ctx, damaged = unwrap_trace(frame)
+        # the payload slice is exactly the v1 bytes an untraced sender emits
+        assert (payload, damaged) == (b"hello", False)
+        assert [h.stage for h in ctx.hops] == ["parser"]
+        assert ctx.hops[0].recv_ns <= ctx.hops[0].send_ns
+        engine.stop()
+
+    def test_v1_peer_sees_v2_originated_traffic_unchanged(self, inproc_factory):
+        """A trace-disabled engine strips the v2 header (clean downgrade):
+        its v1-only downstream sees plain v1 traffic."""
+        sub = inproc_factory.create("inproc://ti2out")
+        sub.recv_timeout = 2000
+        # stage B: tracing OFF, forwards to the v1-only peer
+        stage_b = Engine(make_settings("inproc://ti2b", ["inproc://ti2out"]),
+                         EchoProcessor(), inproc_factory)
+        # stage A: tracing ON
+        stage_a = Engine(
+            make_settings("inproc://ti2a", ["inproc://ti2b"],
+                          engine_trace=True),
+            EchoProcessor(), inproc_factory)
+        stage_b.start()
+        stage_a.start()
+        client = inproc_factory.create_output("inproc://ti2a")
+        client.send(b"survives the downgrade")
+        out = sub.recv()
+        assert out == b"survives the downgrade"
+        assert not out.startswith(MAGIC_V2)
+        stage_a.stop()
+        stage_b.stop()
+
+    def test_garbled_trace_block_counts_error_keeps_messages(self, inproc_factory):
+        """A corrupted trace block is a framing error, but the payload
+        messages still flow (echoed back in reply mode)."""
+        from detectmateservice_tpu.engine import metrics as m
+
+        engine = Engine(make_settings("inproc://ti3"), EchoProcessor(),
+                        inproc_factory)
+        labels = engine._labels
+        errs = m.PROCESSING_ERRORS().labels(**labels)
+        before = errs._value.get()
+        engine.start()
+        client = inproc_factory.create_output("inproc://ti3")
+        client.recv_timeout = 2000
+        payload = pack_batch([b"msg one", b"msg two"])
+        block = pack_trace_block(sample_ctx())[:-2] + b"\xff\xff"
+        frame = bytearray(MAGIC_V2)
+        _put_varint(frame, len(block))
+        frame += block + payload
+        client.send(bytes(frame))
+        got = {client.recv(), client.recv()}
+        assert got == {b"msg one", b"msg two"}
+        assert errs._value.get() == before + 1
+        engine.stop()
+
+    def test_truncated_trace_frame_dropped_engine_survives(self, inproc_factory):
+        from detectmateservice_tpu.engine import metrics as m
+
+        engine = Engine(make_settings("inproc://ti4"), EchoProcessor(),
+                        inproc_factory)
+        errs = m.PROCESSING_ERRORS().labels(**engine._labels)
+        before = errs._value.get()
+        engine.start()
+        client = inproc_factory.create_output("inproc://ti4")
+        client.recv_timeout = 2000
+        client.send(MAGIC_V2 + b"\x7f" + b"short")  # declared len > frame
+        client.send(b"still alive")
+        assert client.recv() == b"still alive"
+        assert errs._value.get() == before + 1
+        assert engine.running
+        engine.stop()
+
+    def test_trace_terminal_override_finalizes_despite_outputs(
+            self, inproc_factory):
+        """trace_terminal: true — a forwarding stage (e.g. an output writer
+        with a non-framework downstream) completes traces itself and sends
+        its downstream plain v1 bytes."""
+        sub = inproc_factory.create("inproc://ti6out")
+        sub.recv_timeout = 2000
+        engine = Engine(
+            make_settings("inproc://ti6", ["inproc://ti6out"],
+                          engine_trace=True, trace_terminal=True,
+                          trace_sample_every=1),
+            EchoProcessor(), inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://ti6")
+        client.send(wrap_trace(b"record", sample_ctx()))
+        out = sub.recv()
+        assert out == b"record"          # downstream sees plain v1
+        assert wait_until(lambda: engine.trace_recorder.completed >= 1, 5.0)
+        trace = engine.trace_recorder.snapshot()["sampled"][0]
+        assert trace["hops"][-1]["stage"] == "core"
+        assert trace["e2e_seconds"] > 0
+        engine.stop()
+
+    def test_frame_msg_count_drives_burst_sizing_on_v2(self, inproc_factory):
+        """A traced packed frame expands to its payload messages exactly
+        (frame_msg_count is v2-aware, so micro-batch burst caps hold)."""
+        sub = inproc_factory.create("inproc://ti5out")
+        sub.recv_timeout = 2000
+        engine = Engine(
+            make_settings("inproc://ti5", ["inproc://ti5out"],
+                          engine_batch_size=8, engine_trace=True),
+            EchoProcessor(), inproc_factory)
+        engine.start()
+        client = inproc_factory.create_output("inproc://ti5")
+        client.send(wrap_trace(pack_batch([b"a", b"b", b"c"]), sample_ctx()))
+        got = set()
+        for _ in range(3):
+            frame = sub.recv()
+            payload, _, _ = unwrap_trace(frame)
+            msgs = unpack_batch(payload)
+            got.update(msgs if msgs is not None else [payload])
+        assert got == {b"a", b"b", b"c"}
+        engine.stop()
+
+
+def _http_json(port: int, path: str):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _http_text(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.read().decode()
+
+
+class TestThreeStageTraceSmoke:
+    """Satellite: tier-1 smoke — a 3-stage in-process pipeline with tracing
+    on exposes complete, monotonically ordered traces on /admin/trace and
+    non-empty pipeline series on /metrics."""
+
+    def test_pipeline_traces_end_to_end(self, run_service, inproc_factory):
+        from detectmateservice_tpu.core import Service
+
+        def settings(stage, addr, outs=()):
+            return ServiceSettings(
+                component_type="core", component_name=f"smoke-{stage}",
+                trace_stage=stage, engine_addr=addr, out_addr=list(outs),
+                engine_trace=True, trace_sample_every=1,
+                http_port=0, log_to_file=False)
+
+        output = Service(settings("output", "inproc://smoke3"),
+                         socket_factory=inproc_factory)
+        detector = Service(settings("detector", "inproc://smoke2",
+                                    ["inproc://smoke3"]),
+                           socket_factory=inproc_factory)
+        parser = Service(settings("parser", "inproc://smoke1",
+                                  ["inproc://smoke2"]),
+                         socket_factory=inproc_factory)
+        for svc in (output, detector, parser):
+            run_service(svc)
+
+        client = inproc_factory.create_output("inproc://smoke1")
+        for i in range(25):
+            client.send(f"burst line {i}\n".encode())
+
+        port = output.web_server.port
+        assert wait_until(
+            lambda: _http_json(port, "/admin/trace")["completed"] >= 1, 10.0)
+
+        body = _http_json(port, "/admin/trace")
+        assert body["tracing_enabled"] is True
+        traces = body["slowest"] + body["sampled"]
+        assert traces
+        for trace in traces:
+            stages = [h["stage"] for h in trace["hops"]]
+            assert stages == ["parser", "detector", "output"]
+            stamps = [t for h in trace["hops"]
+                      for t in (h["recv_ns"], h["send_ns"])]
+            assert stamps == sorted(stamps), "hop timestamps not monotonic"
+            assert trace["hops"][0]["recv_ns"] >= trace["ingest_ns"]
+            assert trace["e2e_seconds"] > 0
+
+        # acceptance criterion: the pipeline series are non-empty on /metrics
+        metrics = _http_text(port, "/metrics")
+        for needle in ("pipeline_stage_dwell_seconds_count",
+                       "pipeline_transit_seconds_count",
+                       "pipeline_e2e_latency_seconds_count"):
+            assert needle in metrics
+        e2e_counts = [
+            line for line in metrics.splitlines()
+            if line.startswith("pipeline_e2e_latency_seconds_count")
+            and not line.rstrip().endswith(" 0.0")]
+        assert e2e_counts, "no terminal stage observed e2e latency"
+
+        # chrome export loads as trace-event JSON
+        doc = _http_json(port, "/admin/trace?format=chrome")
+        slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert any(e["name"] == "detector" for e in slices)
+
+        # the CLI client surface drives the same endpoint
+        from detectmateservice_tpu.client import DetectMateClient
+        cli = DetectMateClient(f"http://127.0.0.1:{port}")
+        assert cli.trace()["completed"] >= 1
+        assert "traceEvents" in cli.trace(chrome=True)
+
+    def test_trace_disabled_recorder_stays_empty(self, run_service,
+                                                 inproc_factory):
+        from detectmateservice_tpu.core import Service
+
+        svc = Service(
+            ServiceSettings(component_type="core", engine_addr="inproc://ntr1",
+                            http_port=0, log_to_file=False),
+            socket_factory=inproc_factory)
+        run_service(svc)
+        client = inproc_factory.create_output("inproc://ntr1")
+        client.recv_timeout = 2000
+        client.send(b"ping")
+        assert client.recv() == b"ping"
+        body = _http_json(svc.web_server.port, "/admin/trace")
+        assert body["completed"] == 0
+        assert body["tracing_enabled"] is False
