@@ -99,6 +99,18 @@ BATCH_SIZE_HIST = _series(
     "Dispatched micro-batch sizes",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
 )
+# fused native featurization (utils/matchkern dm_featurize_batch/_frames):
+# native = rows the C kernel tokenized; fallback = rows it flagged for the
+# exact-parity Python path (plus every row when the kernel is unavailable
+# or native_featurize is off). fallback/(native+fallback) is the fraction
+# of traffic NOT riding the fast path — a sustained rise means malformed
+# or parity-hostile payloads are eating the featurization budget.
+FEATURIZE_NATIVE_ROWS = _series(
+    Counter, "featurize_native_rows_total",
+    "Rows featurized by the native (C, row-parallel) kernel")
+FEATURIZE_FALLBACK_ROWS = _series(
+    Counter, "featurize_fallback_rows_total",
+    "Rows featurized by the Python fallback path (kernel-flagged or kernel unavailable)")
 
 # pipeline tracing series (engine_trace: true — engine.py hop stamping).
 # Stage dwell and transit are observed by every tracing stage; e2e only by

@@ -39,6 +39,13 @@ _SRC_PATH = _PKG_DIR.parent / "native" / "transport" / "dmtransport.cpp"
 # keep in sync with dmtransport.cpp
 _OK, _ETIMEOUT, _EAGAIN, _ECLOSED, _EERR, _ETOOBIG = 0, -1, -2, -3, -4, -5
 
+# Feature version this binding expects the library to report
+# (dmt_feature_version; stamped by native/build.sh, defaulted in the .cpp).
+# A mismatch raises ImportError so "auto" backend selection falls back to
+# the Python transport LOUDLY instead of serving an older wire surface.
+# Bump in lockstep with the default in native/transport/dmtransport.cpp.
+DMT_FEATURE_VERSION = 2
+
 _INITIAL_BUF = 16 * 1024 * 1024  # starting recv buffer; grows on demand —
                                  # oversized frames are stashed native-side
                                  # (dmt_pending_size) and retried, never lost
@@ -74,6 +81,16 @@ def _rebuild() -> None:
             os.unlink(tmp)
 
 
+def _lib_feature_version(lib: ctypes.CDLL) -> int:
+    """Version the loaded library reports; 0 for pre-versioning builds."""
+    try:
+        fn = lib.dmt_feature_version
+    except AttributeError:
+        return 0
+    fn.restype = ctypes.c_int
+    return int(fn())
+
+
 def _load() -> ctypes.CDLL:
     if _stale():
         if not _SRC_PATH.exists() and not _LIB_PATH.exists():
@@ -91,6 +108,21 @@ def _load() -> ctypes.CDLL:
         # surface as ImportError so "auto" backend selection falls back to
         # the pure-Python transport
         raise ImportError(f"cannot load native transport: {exc}")
+    if _lib_feature_version(lib) != DMT_FEATURE_VERSION:
+        # stale binary: rebuild when the source is present (os.replace swaps
+        # the inode, so re-dlopen maps the new object), else fail loudly
+        if _SRC_PATH.exists():
+            try:
+                _rebuild()
+                lib = ctypes.CDLL(str(_LIB_PATH))
+            except (subprocess.SubprocessError, OSError):
+                pass
+        got = _lib_feature_version(lib)
+        if got != DMT_FEATURE_VERSION:
+            raise ImportError(
+                f"stale native transport library {_LIB_PATH}: reports "
+                f"feature version {got}, bindings expect "
+                f"{DMT_FEATURE_VERSION} — rebuild with native/build.sh")
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.dmt_listen.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.dmt_listen.restype = ctypes.c_void_p

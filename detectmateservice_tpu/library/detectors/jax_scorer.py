@@ -85,6 +85,18 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # is queued at dispatch-call time, workers only fill it in. 0 = dispatch
     # inline (the right choice on local CPU, where dispatch is ~free).
     upload_workers: int = 0
+    # fused native featurization: serialized ParserSchema -> token matrix in
+    # one GIL-free C call (wire-format walk + tokenize + crc32 hash), rows
+    # sharded over a small pthread pool. On by default whenever the native
+    # library loads; rows the kernel cannot featurize with byte-exact parity
+    # (invalid UTF-8, >64 header entries, ASCII-lowering unicode) fall back
+    # to the Python tokenizer per row. featurize_native_rows_total /
+    # featurize_fallback_rows_total count the split. Off = always Python.
+    native_featurize: bool = True
+    # featurization pool width: 0 = auto (min(4, cores)); the pool is
+    # process-wide (one pool in the C layer), so the widest configured
+    # detector wins. See docs/configuration.md for sizing guidance.
+    featurize_threads: int = 0
     # batches at or below this size score on a CPU-jitted twin of the model
     # (host-resident params) instead of the accelerator: a lone message costs
     # ~1 ms on host vs 2 host↔device round-trips on a remote/tunneled TPU
@@ -178,6 +190,11 @@ class JaxScorerDetector(CoreDetector):
         self._host_warm_thread = None
         self._ready_supported: Optional[bool] = None   # jax.Array.is_ready seen?
         self._metrics_labels = None
+        self._feat_counters = None  # (native_rows, fallback_rows) label pair
+        if self.config.featurize_threads > 0:
+            kern = self._matchkern()
+            if kern is not None:
+                kern.set_featurize_threads(self.config.featurize_threads)
         # in-flight scored batches (_InflightSlot), oldest first
         from collections import deque
 
@@ -594,29 +611,63 @@ class JaxScorerDetector(CoreDetector):
             parts.extend(f"{k}={lfv[k]}" for k in sorted(lfv))
         self._tokenizer.encode_into(" ".join(parts), out_row)
 
-    def _featurize_raw_batch(self, batch: List[bytes]):
-        """Serialized ParserSchema bytes → ([N, S] int32 tokens, [N] ok bool).
-
-        Native kernel when built (protobuf wire parse + tokenize + hash in C,
-        ~20× the Python path); Python fallback otherwise — both produce
-        identical rows (pinned by tests/test_native_kernels.py)."""
+    def _matchkern(self):
+        """The native featurize module, or None (knob off / not built)."""
+        if not self.config.native_featurize:
+            return None
         try:
             from ...utils import matchkern
 
-            tokens, ok = matchkern.featurize_batch(
+            return matchkern
+        except ImportError:
+            return None
+
+    def _count_featurize_rows(self, native: int, fallback: int) -> None:
+        """featurize_native_rows_total / featurize_fallback_rows_total —
+        which path tokenized how many rows (label children cached: this
+        runs once per micro-batch on the hot path)."""
+        if not native and not fallback:
+            return
+        if self._feat_counters is None:
+            from ...engine import metrics as m
+
+            labels = dict(component_type=self.config.method_type,
+                          component_id=self.name)
+            self._feat_counters = (
+                m.FEATURIZE_NATIVE_ROWS().labels(**labels),
+                m.FEATURIZE_FALLBACK_ROWS().labels(**labels))
+        if native:
+            self._feat_counters[0].inc(native)
+        if fallback:
+            self._feat_counters[1].inc(fallback)
+
+    def _featurize_raw_batch(self, batch: List[bytes]):
+        """Serialized ParserSchema bytes → ([N, S] int32 tokens, [N] ok bool).
+
+        Native kernel when built and ``native_featurize`` is on (protobuf
+        wire parse + tokenize + hash in C, GIL-free and row-parallel);
+        Python fallback otherwise — both produce identical rows (pinned by
+        tests/test_native_kernels.py)."""
+        kern = self._matchkern()
+        if kern is not None:
+            tokens, ok = kern.featurize_batch(
                 batch, self.config.seq_len, self.config.vocab_size
             )
-            if not ok.all():
+            if ok.all():
+                self._count_featurize_rows(len(batch), 0)
+            else:
                 # the native kernel refuses rows it cannot featurize with
                 # exact parity (e.g. >64 header-map entries); retry those in
                 # Python so only genuinely corrupt messages stay failed
-                self._featurize_python_rows(batch, tokens, ok, np.flatnonzero(~ok))
+                flagged = np.flatnonzero(~ok)
+                self._featurize_python_rows(batch, tokens, ok, flagged)
+                self._count_featurize_rows(len(batch) - len(flagged),
+                                           len(flagged))
             return tokens, ok
-        except ImportError:
-            pass
         tokens = np.zeros((len(batch), self.config.seq_len), np.int32)
         ok = np.zeros(len(batch), dtype=bool)
         self._featurize_python_rows(batch, tokens, ok, range(len(batch)))
+        self._count_featurize_rows(0, len(batch))
         return tokens, ok
 
     def _featurize_python_rows(self, batch: List[bytes], tokens: np.ndarray,
@@ -714,9 +765,8 @@ class JaxScorerDetector(CoreDetector):
         materialized and delegated to ``process_batch`` (same semantics,
         per-message bookkeeping) — only the fitted steady state takes the
         vectorized path, which is exactly when throughput matters."""
-        try:
-            from ...utils import matchkern
-        except ImportError:
+        matchkern = self._matchkern()
+        if matchkern is None:
             msgs: List[bytes] = []
             n_corrupt = 0
             for frame in frames:
@@ -749,12 +799,16 @@ class JaxScorerDetector(CoreDetector):
             # phase boundary: per-message semantics via the classic path
             raws = [fb.raw(i) for i in range(n)]
             return self.process_batch(raws), n, fb.n_lines
-        if not fb.ok.all():
+        if fb.ok.all():
+            self._count_featurize_rows(n, 0)
+        else:
             # native kernel refused rows (e.g. >64 header-map entries):
             # retry them in Python for exact parity, like the batch path
+            flagged = np.flatnonzero(~fb.ok)
             self._featurize_python_rows(
                 matchkern.SpanRaws(fb.blob, fb.spans), fb.tokens, fb.ok,
-                np.flatnonzero(~fb.ok))
+                flagged)
+            self._count_featurize_rows(n - len(flagged), len(flagged))
         ready: List[Optional[bytes]] = []
         if fb.ok.all():
             tokens, raws = fb.tokens, matchkern.SpanRaws(fb.blob, fb.spans)
@@ -1124,6 +1178,10 @@ class JaxScorerDetector(CoreDetector):
         override clears so the upcoming fit calibrates instead of keeping
         the stale value forever)."""
         super().apply_config()
+        if self.config.featurize_threads > 0:
+            kern = self._matchkern()
+            if kern is not None:
+                kern.set_featurize_threads(self.config.featurize_threads)
         if self.config.score_threshold is not None:
             self._threshold = float(self.config.score_threshold)
         elif self._calib_stats is not None:

@@ -345,10 +345,22 @@ class MatcherParser(CoreComponent):
         """Shared status→outputs dispatch for the batch and frames kernels:
         1 = emitted bytes, 0 = filtered (None), -1 = re-run the row's raw
         payload (``raw_fn(i)``) through the exact-semantics Python path,
-        counting its decode errors once per batch."""
+        counting its decode errors once per batch.
+
+        When the kernel flags (almost) every row — the steady state for a
+        ``@type json`` ingest edge, where every payload starts with ``{`` —
+        the per-row ``parse_line`` fallback would serialize the whole batch
+        through the slowest path. Those batches re-run through the BATCHED
+        Python path instead (one native template scan for the batch, pb2
+        assembly loop), restoring pre-kernel batched throughput; identical
+        fields either way, pinned by test_native_kernels."""
+        status_list = status.tolist()
+        n = len(status_list)
+        flagged = status_list.count(-1)
+        if n > 1 and flagged >= n - n // 8:
+            return self._process_batch_python([raw_fn(i) for i in range(n)])
         outs: List[Optional[bytes]] = []
         decode_errors = 0
-        status_list = status.tolist()
         ends_list = ends.tolist()
         for i, st in enumerate(status_list):
             if st == 1:

@@ -9,6 +9,7 @@ importers fall back to the pure-Python paths on any failure.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 from typing import List, Sequence, Tuple
@@ -18,6 +19,15 @@ import numpy as np
 _PKG_DIR = Path(__file__).resolve().parent.parent
 _LIB_PATH = _PKG_DIR / "_native" / "libdmkern.so"
 _SRC_PATH = _PKG_DIR.parent / "native" / "matchkern" / "dmkern.c"
+
+# Feature version this binding layer expects the library to report
+# (dm_feature_version). native/build.sh stamps the same number into the .so;
+# a mismatch at load time means a stale binary (e.g. an old committed .so on
+# a host without a compiler) and raises ImportError — every importer already
+# falls back to the pure-Python paths, so the failure is loud but safe.
+# Bump IN LOCKSTEP with the default in native/matchkern/dmkern.c whenever a
+# kernel's ABI or semantics change.
+DM_FEATURE_VERSION = 6
 
 
 def _stale() -> bool:
@@ -43,7 +53,7 @@ def _rebuild() -> None:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_LIB_PATH.parent))
     os.close(fd)
     try:
-        subprocess.run(["cc", "-O3", "-shared", "-fPIC", "-o", tmp,
+        subprocess.run(["cc", "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp,
                         str(_SRC_PATH)],
                        check=True, capture_output=True, timeout=120)
         os.chmod(tmp, 0o755)  # mkstemp creates 0600; other users must dlopen
@@ -51,6 +61,16 @@ def _rebuild() -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _lib_feature_version(lib: ctypes.CDLL) -> int:
+    """Version the loaded library reports; 0 for pre-versioning builds."""
+    try:
+        fn = lib.dm_feature_version
+    except AttributeError:
+        return 0
+    fn.restype = ctypes.c_int
+    return int(fn())
 
 
 def _load() -> ctypes.CDLL:
@@ -65,11 +85,31 @@ def _load() -> ctypes.CDLL:
                     raise ImportError(f"cannot build native kernel: {exc}")
                 # no compiler / read-only tree: use the committed library
     lib = ctypes.CDLL(str(_LIB_PATH))
+    if _lib_feature_version(lib) != DM_FEATURE_VERSION:
+        # stale binary (mtimes lie on fresh checkouts): rebuild if possible —
+        # os.replace swaps the inode, so re-dlopen maps the NEW object —
+        # else fail LOUDLY rather than silently running without the newer
+        # kernels (importers fall back to the pure-Python paths)
+        if _SRC_PATH.exists():
+            try:
+                _rebuild()
+                lib = ctypes.CDLL(str(_LIB_PATH))
+            except (subprocess.SubprocessError, OSError):
+                pass
+        got = _lib_feature_version(lib)
+        if got != DM_FEATURE_VERSION:
+            raise ImportError(
+                f"stale native kernel library {_LIB_PATH}: reports feature "
+                f"version {got}, bindings expect {DM_FEATURE_VERSION} — "
+                f"rebuild with native/build.sh")
     lib.dm_featurize_batch.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
         ctypes.c_int, ctypes.c_int32,
     ]
+    lib.dm_featurize_set_threads.argtypes = [ctypes.c_int]
+    lib.dm_featurize_set_threads.restype = ctypes.c_int
+    lib.dm_featurize_get_threads.restype = ctypes.c_int
     lib.dm_encode_batch.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int32,
@@ -180,6 +220,33 @@ def _load() -> ctypes.CDLL:
 
 
 _lib = _load()
+
+
+def set_featurize_threads(n: int) -> int:
+    """Set the featurization pool width; returns the effective width.
+
+    0 (or negative) = auto: min(4, online cores), the conservative default —
+    featurization shares the host with jax dispatch/readback and (on CPU
+    fallback hosts) XLA itself, so grabbing every core hurts more than it
+    helps. The pool is PROCESS-WIDE (the C side keeps one pool); the widest
+    setter wins. Threads spawn lazily on the first large batch and sleep on
+    a condvar between jobs."""
+    return int(_lib.dm_featurize_set_threads(int(n)))
+
+
+def featurize_threads() -> int:
+    """Current featurization pool width (resolving auto to its value)."""
+    return int(_lib.dm_featurize_get_threads())
+
+
+def lib_feature_version() -> int:
+    """Feature version the loaded library reports (== DM_FEATURE_VERSION,
+    enforced at import)."""
+    return _lib_feature_version(_lib)
+
+
+# env override for ops tuning without touching component config; auto default
+set_featurize_threads(int(os.environ.get("DM_FEATURIZE_THREADS", "0") or 0))
 
 _I64P = ctypes.POINTER(ctypes.c_int64)
 _I32P = ctypes.POINTER(ctypes.c_int32)
@@ -502,9 +569,12 @@ class ParseKernel:
     def _run_with_capacity(self, blob_len: int, n_rows: int, invoke):
         """Allocate the output buffer from the shared worst-case estimate
         and retry the C call with a grown buffer while it reports
-        insufficient capacity. ``invoke(out_array, cap) -> used`` (< 0 means
-        too small). ONE home for the estimate and the retry policy — the
-        batch and frames entry points must never diverge on them."""
+        insufficient capacity. ``invoke(out_array, cap) -> used``; -1 means
+        the output buffer was too small (grow and retry), -2 means the C
+        side failed a malloc (real OOM — growing OUR buffer would only dig
+        the hole deeper, so it raises immediately). ONE home for the
+        estimate and the retry policy — the batch and frames entry points
+        must never diverge on them."""
         cap = int(blob_len * 2 + n_rows * (256 + self._tmpl_max
                                            + self._names_total) + 1024)
         for _ in range(4):
@@ -512,6 +582,11 @@ class ParseKernel:
             used = invoke(out, cap)
             if used >= 0:
                 return out[:used].tobytes()
+            if used == -2:
+                raise MemoryError("parse kernel allocation failed (OOM)")
+            if used != -1:
+                raise RuntimeError(
+                    f"parse kernel returned unknown error code {used}")
             cap *= 4
         raise MemoryError("parse kernel output buffer kept overflowing")
 

@@ -4,13 +4,23 @@ set -e
 cd "$(dirname "$0")"
 mkdir -p ../detectmateservice_tpu/_native
 CC="${CC:-cc}"
-$CC -O3 -shared -fPIC -o ../detectmateservice_tpu/_native/libdmkern.so matchkern/dmkern.c -lz
-echo "built detectmateservice_tpu/_native/libdmkern.so"
+# Stamp the feature version the Python bindings expect: the bindings refuse
+# a library reporting a different number, so a stale committed .so fails
+# loudly at import instead of silently bypassing newer kernels. The C
+# sources default to the same numbers for bare `cc` builds.
+KVER=$(sed -n 's/^DM_FEATURE_VERSION = \([0-9][0-9]*\).*/\1/p' \
+    ../detectmateservice_tpu/utils/matchkern.py)
+$CC -O3 -shared -fPIC -pthread ${KVER:+-DDM_FEATURE_VERSION=$KVER} \
+    -o ../detectmateservice_tpu/_native/libdmkern.so matchkern/dmkern.c
+echo "built detectmateservice_tpu/_native/libdmkern.so (feature version ${KVER:-default})"
 if [ -f transport/dmtransport.cpp ]; then
     CXX="${CXX:-c++}"
+    TVER=$(sed -n 's/^DMT_FEATURE_VERSION = \([0-9][0-9]*\).*/\1/p' \
+        ../detectmateservice_tpu/engine/native_transport.py)
     # link the soname directly: this image ships libzmq.so.5 without the
     # -lzmq dev symlink or header (the ABI is declared in the .cpp)
-    $CXX -O2 -std=c++17 -shared -fPIC -o ../detectmateservice_tpu/_native/libdmtransport.so \
+    $CXX -O2 -std=c++17 -shared -fPIC ${TVER:+-DDMT_FEATURE_VERSION=$TVER} \
+        -o ../detectmateservice_tpu/_native/libdmtransport.so \
         transport/dmtransport.cpp -l:libzmq.so.5 -lpthread
-    echo "built detectmateservice_tpu/_native/libdmtransport.so"
+    echo "built detectmateservice_tpu/_native/libdmtransport.so (feature version ${TVER:-default})"
 fi

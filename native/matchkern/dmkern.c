@@ -23,12 +23,27 @@
  *     a lazy-group regex (the regex was the parser stage's hot-path
  *     ceiling at ~45k lines/s on 8-wildcard templates).
  */
+#include <pthread.h>
+#include <stdatomic.h>
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 #define RESERVED 3
 #define CLS_ID 2
+
+/* Feature version of this library build. The Python bindings
+ * (utils/matchkern.py DM_FEATURE_VERSION) expect exactly this number and
+ * refuse to load a library that reports a different one — a stale committed
+ * .so fails LOUDLY at import instead of silently running without the newer
+ * kernels. native/build.sh stamps the value from the bindings; the default
+ * here must match for bare `cc dmkern.c` builds. */
+#ifndef DM_FEATURE_VERSION
+#define DM_FEATURE_VERSION 6
+#endif
+
+int dm_feature_version(void) { return DM_FEATURE_VERSION; }
 
 /* ---------------- tokenizer ---------------- */
 
@@ -140,8 +155,35 @@ static int cmp_map_entry(const void *a, const void *b) {
 
 #define MAX_MAP_ENTRIES 64
 
+static int utf8_valid(const uint8_t *s, int len);
+
+/* Python's str.lower() can mint ASCII-alphanumeric characters out of
+ * exactly two non-ASCII codepoints: U+0130 LATIN CAPITAL LETTER I WITH DOT
+ * ABOVE ('İ'.lower() contains 'i') and U+212A KELVIN SIGN ('K'.lower() is
+ * 'k') — verified by exhaustive scan over the BMP+astral planes. The C
+ * tokenizer lowercases ASCII only, so a span carrying either codepoint
+ * would tokenize differently from the Python path; those rows are flagged
+ * for the Python fallback instead (exact parity beats a silently different
+ * token stream). */
+static int has_ascii_lowering_codepoint(const uint8_t *s, int len) {
+    for (int i = 0; i + 1 < len; i++) {
+        if (s[i] == 0xC4 && s[i + 1] == 0xB0) return 1;              /* U+0130 */
+        if (i + 2 < len && s[i] == 0xE2 && s[i + 1] == 0x84 &&
+            s[i + 2] == 0xAA) return 1;                              /* U+212A */
+    }
+    return 0;
+}
+
+/* A featurizable string span: valid UTF-8 (upb raises on invalid bytes in
+ * declared string fields, so the Python path would reject the whole
+ * message) and free of the two ASCII-lowering codepoints above. */
+static int feat_span_ok(const uint8_t *s, int len) {
+    return utf8_valid(s, len) && !has_ascii_lowering_codepoint(s, len);
+}
+
 /* Featurize one serialized ParserSchema into a zeroed row. Returns 1 on
- * success, 0 on a wire-format error (row left as-is). */
+ * success, 0 on a wire-format error or a row whose token stream cannot be
+ * guaranteed byte-identical to the Python path (row left as-is). */
 static int featurize_one(const uint8_t *msg, int len, int32_t *row,
                          int seq_len, uint32_t vocab) {
     cursor_t c = { msg, msg + len };
@@ -150,9 +192,12 @@ static int featurize_one(const uint8_t *msg, int len, int32_t *row,
     map_entry_t entries[MAX_MAP_ENTRIES];
     int n_entries = 0;
     const uint8_t *template_p = NULL; uint64_t template_len = 0;
-    /* first pass: locate template (5), stream variables (6) after template,
-     * collect map entries (10). Field order on the wire follows field
-     * numbers for our own serializer, so template precedes variables. */
+    /* first pass: locate template (5), collect map entries (10), and
+     * validate EVERY declared string field — upb raises on invalid UTF-8
+     * anywhere in the message, so a row the Python path would reject must
+     * never come back ok=1 with a guessed token stream. Tokenized spans
+     * (template/variables/map) additionally reject the two ASCII-lowering
+     * codepoints (feat_span_ok). */
     while (c.p < c.end) {
         uint64_t tag;
         if (!read_varint(&c, &tag)) return 0;
@@ -160,7 +205,31 @@ static int featurize_one(const uint8_t *msg, int len, int32_t *row,
         if (wt == 2) {
             uint64_t l;
             if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) return 0;
-            if (field == 5) { template_p = c.p; template_len = l; }
+            if (field == 5) {
+                if (!feat_span_ok(c.p, (int)l)) return 0;
+                template_p = c.p; template_len = l;
+            } else if (field == 6) {
+                if (!feat_span_ok(c.p, (int)l)) return 0;
+            } else if (field == 10) {
+                /* more map entries than we can sort: report failure so the
+                 * caller re-featurizes this row in Python (exact parity
+                 * beats a silently different token stream) */
+                if (n_entries >= MAX_MAP_ENTRIES) return 0;
+                if (parse_map_entry(c.p, (int)l, &entries[n_entries])) {
+                    map_entry_t *e = &entries[n_entries];
+                    /* a wire entry omitting key or value means the empty
+                     * string (proto3 map semantics), not a skipped entry */
+                    if (e->key == NULL) e->key = (const uint8_t *)"";
+                    if (e->val == NULL) e->val = (const uint8_t *)"";
+                    if (!feat_span_ok(e->key, e->key_len) ||
+                        !feat_span_ok(e->val, e->val_len))
+                        return 0;
+                    n_entries++;
+                }
+            } else if (field >= 1 && field <= 9) {
+                /* declared strings (1,2,3,7,8,9): parse-time UTF-8 check */
+                if (!utf8_valid(c.p, (int)l)) return 0;
+            }
             c.p += l;
         } else if (!skip_field(&c, wt)) {
             return 0;
@@ -168,7 +237,7 @@ static int featurize_one(const uint8_t *msg, int len, int32_t *row,
     }
     if (template_p && pos < seq_len)
         pos = tokenize_span(template_p, (int)template_len, row, pos, seq_len, vocab);
-    /* second pass: variables in order */
+    /* second pass: variables (6) in wire order, already validated above */
     c.p = msg; c.end = msg + len;
     while (c.p < c.end && pos < seq_len) {
         uint64_t tag;
@@ -179,19 +248,25 @@ static int featurize_one(const uint8_t *msg, int len, int32_t *row,
             if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) return 0;
             if (field == 6)
                 pos = tokenize_span(c.p, (int)l, row, pos, seq_len, vocab);
-            else if (field == 10) {
-                /* more map entries than we can sort: report failure so the
-                 * caller re-featurizes this row in Python (exact parity
-                 * beats a silently different token stream) */
-                if (n_entries >= MAX_MAP_ENTRIES) return 0;
-                if (parse_map_entry(c.p, (int)l, &entries[n_entries]) &&
-                    entries[n_entries].key)
-                    n_entries++;
-            }
             c.p += l;
         } else if (!skip_field(&c, wt)) {
             return 0;
         }
+    }
+    if (n_entries > 1) {
+        /* proto3 maps are last-wins on duplicate wire keys: Python's dict
+         * keeps one entry per key, so earlier occurrences must not emit */
+        int w = 0;
+        for (int i = 0; i < n_entries; i++) {
+            int last = 1;
+            for (int j = i + 1; j < n_entries && last; j++)
+                if (entries[j].key_len == entries[i].key_len &&
+                    memcmp(entries[j].key, entries[i].key,
+                           (size_t)entries[i].key_len) == 0)
+                    last = 0;
+            if (last) entries[w++] = entries[i];
+        }
+        n_entries = w;
     }
     if (n_entries > 0 && pos < seq_len) {
         if (n_entries > 1)  /* the common case is a single header entry */
@@ -205,16 +280,168 @@ static int featurize_one(const uint8_t *msg, int len, int32_t *row,
     return 1;
 }
 
+/* ---------------- row-parallel featurization pool ----------------
+ *
+ * Rows are independent (each featurize_one writes only its own token row,
+ * ok byte, and reads only its own payload span), so a batch shards over a
+ * small persistent pthread pool. The ctypes layer calls through CDLL, which
+ * drops the GIL for the duration of the C call — featurization of one
+ * engine micro-batch runs on all pool threads while the Python engine
+ * thread is free to drain/dispatch.
+ *
+ * Pool discipline: ONE job at a time (run_mu). A second concurrent caller
+ * — two detectors featurizing at once — trylocks, loses, and simply runs
+ * its batch inline on its own calling thread: no queueing, no deadlock,
+ * and the two calls still overlap because neither holds the GIL. Work is
+ * handed out in fixed row chunks via an atomic cursor (rows cost ~0.3 µs,
+ * so per-row stealing would be all contention). */
+
+#define DM_POOL_MAX 16
+#define DM_FEAT_CHUNK 64
+
+static pthread_mutex_t dm_run_mu = PTHREAD_MUTEX_INITIALIZER;
+static pthread_mutex_t dm_pool_mu = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t dm_pool_cv = PTHREAD_COND_INITIALIZER;
+static pthread_cond_t dm_pool_done_cv = PTHREAD_COND_INITIALIZER;
+static int dm_pool_started = 0;      /* live worker threads */
+static int dm_pool_threads = -1;     /* configured width; -1 = auto */
+
+typedef void (*dm_row_fn)(void *arg, int64_t lo, int64_t hi);
+static struct {
+    dm_row_fn fn;
+    void *arg;
+    int64_t n;
+    _Atomic int64_t next;
+    uint64_t gen;                    /* bumped per job, guarded by pool_mu */
+    int active;                      /* workers still to check in for this job */
+    int width;                       /* pool width the job was posted with */
+} dm_job;
+
+static void dm_job_drain(void) {
+    for (;;) {
+        int64_t lo = atomic_fetch_add(&dm_job.next, DM_FEAT_CHUNK);
+        if (lo >= dm_job.n) return;
+        int64_t hi = lo + DM_FEAT_CHUNK;
+        if (hi > dm_job.n) hi = dm_job.n;
+        dm_job.fn(dm_job.arg, lo, hi);
+    }
+}
+
+/* EVERY started worker wakes on every job and checks in exactly once (the
+ * job's active count is sized to the whole pool), but only workers whose
+ * id fits the job's width actually drain rows — a later, NARROWER
+ * set_threads must not let surplus workers check a job in while counted
+ * ones are still writing rows (a caller returning early would hand Python
+ * a half-filled matrix). */
+static void *dm_pool_worker(void *idp) {
+    int id = (int)(intptr_t)idp;
+    uint64_t seen = 0;
+    pthread_mutex_lock(&dm_pool_mu);
+    for (;;) {
+        while (dm_job.gen == seen)
+            pthread_cond_wait(&dm_pool_cv, &dm_pool_mu);
+        seen = dm_job.gen;
+        int participate = id < dm_job.width - 1;
+        pthread_mutex_unlock(&dm_pool_mu);
+        if (participate)
+            dm_job_drain();
+        pthread_mutex_lock(&dm_pool_mu);
+        if (--dm_job.active == 0)
+            pthread_cond_signal(&dm_pool_done_cv);
+    }
+    return NULL;
+}
+
+/* Set the pool width (0/negative = auto: min(4, online cores); capped at
+ * DM_POOL_MAX). Returns the effective width. Threads are created lazily on
+ * the first parallel run and never torn down (they sleep on the condvar). */
+int dm_featurize_set_threads(int n) {
+    pthread_mutex_lock(&dm_pool_mu);
+    if (n <= 0) {
+        long cores = sysconf(_SC_NPROCESSORS_ONLN);
+        n = cores < 1 ? 1 : (cores > 4 ? 4 : (int)cores);
+    }
+    if (n > DM_POOL_MAX) n = DM_POOL_MAX;
+    dm_pool_threads = n;
+    pthread_mutex_unlock(&dm_pool_mu);
+    return n;
+}
+
+int dm_featurize_get_threads(void) {
+    if (dm_pool_threads < 0) dm_featurize_set_threads(0);
+    return dm_pool_threads;
+}
+
+/* Run fn over [0, n) rows, sharded across the pool (calling thread
+ * included). Falls back to inline execution for small batches, a width-1
+ * pool, or when another call already owns the pool. */
+static void dm_run_rows(dm_row_fn fn, void *arg, int64_t n) {
+    int width = dm_featurize_get_threads();
+    if (width <= 1 || n < 2 * DM_FEAT_CHUNK ||
+        pthread_mutex_trylock(&dm_run_mu) != 0) {
+        fn(arg, 0, n);
+        return;
+    }
+    pthread_mutex_lock(&dm_pool_mu);
+    while (dm_pool_started < width - 1) {   /* caller is the width'th worker */
+        pthread_t t;
+        pthread_attr_t attr;
+        pthread_attr_init(&attr);
+        pthread_attr_setdetachstate(&attr, PTHREAD_CREATE_DETACHED);
+        if (pthread_create(&t, &attr, dm_pool_worker,
+                           (void *)(intptr_t)dm_pool_started) != 0) {
+            pthread_attr_destroy(&attr);
+            break;                          /* degraded pool still works */
+        }
+        pthread_attr_destroy(&attr);
+        dm_pool_started++;
+    }
+    dm_job.fn = fn;
+    dm_job.arg = arg;
+    dm_job.n = n;
+    atomic_store(&dm_job.next, 0);
+    dm_job.active = dm_pool_started;        /* every worker checks in */
+    dm_job.width = width;
+    dm_job.gen++;
+    pthread_cond_broadcast(&dm_pool_cv);
+    pthread_mutex_unlock(&dm_pool_mu);
+    dm_job_drain();                         /* caller works its share */
+    pthread_mutex_lock(&dm_pool_mu);
+    while (dm_job.active > 0)
+        pthread_cond_wait(&dm_pool_done_cv, &dm_pool_mu);
+    pthread_mutex_unlock(&dm_pool_mu);
+    pthread_mutex_unlock(&dm_run_mu);
+}
+
+/* Shared row task: featurize spans[2i, 2i+1) of blob into row i. */
+typedef struct {
+    const uint8_t *blob;
+    const int64_t *spans;       /* [2n] start/end pairs */
+    int64_t span_stride;        /* 2 for span pairs, 1 for prefix offsets */
+    int32_t *out;
+    uint8_t *ok;
+    int seq_len;
+    uint32_t vocab;
+} feat_rows_t;
+
+static void feat_rows_run(void *argp, int64_t lo, int64_t hi) {
+    feat_rows_t *a = (feat_rows_t *)argp;
+    for (int64_t i = lo; i < hi; i++) {
+        int64_t s = a->spans[a->span_stride * i];
+        int64_t e = a->spans[a->span_stride == 2 ? 2 * i + 1 : i + 1];
+        a->ok[i] = (uint8_t)featurize_one(a->blob + s, (int)(e - s),
+                                          a->out + i * a->seq_len,
+                                          a->seq_len, a->vocab);
+    }
+}
+
 /* msgs: concatenated message bytes; offsets: n+1 prefix offsets into msgs.
- * out: zeroed [n, seq_len] int32. ok: [n] bytes, 1 = parsed. */
+ * out: zeroed [n, seq_len] int32. ok: [n] bytes, 1 = parsed. Rows shard
+ * over the featurize pool (see above). */
 int dm_featurize_batch(const uint8_t *msgs, const int64_t *offsets, int n,
                        int32_t *out, uint8_t *ok, int seq_len, int32_t vocab) {
-    for (int i = 0; i < n; i++) {
-        const uint8_t *p = msgs + offsets[i];
-        int len = (int)(offsets[i + 1] - offsets[i]);
-        ok[i] = (uint8_t)featurize_one(p, len, out + (int64_t)i * seq_len,
-                                       seq_len, (uint32_t)vocab);
-    }
+    feat_rows_t task = { msgs, offsets, 1, out, ok, seq_len, (uint32_t)vocab };
+    dm_run_rows(feat_rows_run, &task, n);
     return 0;
 }
 
@@ -302,7 +529,12 @@ int64_t dm_count_frame_msgs(const uint8_t *frames, const int64_t *frame_offsets,
  * order then message order: token rows, ok flags, and [start, end) byte
  * spans into the frames blob so Python can lazily slice the raw bytes of
  * just the anomalous messages. Caller sizes the outputs from
- * dm_count_frame_msgs and zeroes `tokens`. Returns messages written. */
+ * dm_count_frame_msgs and zeroes `tokens`. Returns messages written.
+ *
+ * Two phases: a cheap sequential varint walk enumerates the message spans
+ * (frame expansion is inherently serial — each length prefixes the next),
+ * then the independent rows featurize in parallel over the pool straight
+ * from the span table. */
 int64_t dm_featurize_frames(const uint8_t *frames, const int64_t *frame_offsets,
                             int n_frames, const int32_t *counts,
                             const uint8_t *corrupt,
@@ -314,9 +546,6 @@ int64_t dm_featurize_frames(const uint8_t *frames, const int64_t *frame_offsets,
         int len = (int)(frame_offsets[i + 1] - frame_offsets[i]);
         if (corrupt[i] || counts[i] == 0) continue;
         if (!frame_is_batch(base, len)) {
-            ok[m] = (uint8_t)featurize_one(base, len,
-                                           tokens + m * seq_len, seq_len,
-                                           (uint32_t)vocab);
             spans[2 * m] = frame_offsets[i];
             spans[2 * m + 1] = frame_offsets[i + 1];
             m++;
@@ -329,9 +558,6 @@ int64_t dm_featurize_frames(const uint8_t *frames, const int64_t *frame_offsets,
             uint64_t mlen;
             read_varint(&c, &mlen);
             if (mlen > 0) {                /* packed empties: filtered, no row */
-                ok[m] = (uint8_t)featurize_one(c.p, (int)mlen,
-                                               tokens + m * seq_len,
-                                               seq_len, (uint32_t)vocab);
                 spans[2 * m] = frame_offsets[i] + (c.p - base);
                 spans[2 * m + 1] = spans[2 * m] + (int64_t)mlen;
                 m++;
@@ -339,6 +565,8 @@ int64_t dm_featurize_frames(const uint8_t *frames, const int64_t *frame_offsets,
             c.p += mlen;
         }
     }
+    feat_rows_t task = { frames, spans, 2, tokens, ok, seq_len, (uint32_t)vocab };
+    dm_run_rows(feat_rows_run, &task, m);
     return m;
 }
 
@@ -695,8 +923,10 @@ typedef struct {
 } parse_ctx_t;
 
 /* Parse one payload. Fills status_out (1 emitted / 0 filtered / -1 Python)
- * and advances ctx->o. Returns 0, or -1 on out-of-capacity/OOM (caller
- * aborts the whole call and retries with a bigger buffer). */
+ * and advances ctx->o. Returns 0; -1 on output-capacity shortfall (caller
+ * aborts the whole call and retries with a bigger buffer); -2 on malloc
+ * failure (real OOM — retrying with a BIGGER buffer would only dig deeper,
+ * so the binding layer raises instead of growing). */
 static int parse_one_row(parse_ctx_t *ctx, const uint8_t *pay, int pay_len,
                          int64_t row_idx, int8_t *status_out) {
     int n_caps_fmt = ctx->n_lits > 0 ? ctx->n_lits - 1 : 0;
@@ -716,16 +946,28 @@ static int parse_one_row(parse_ctx_t *ctx, const uint8_t *pay, int pay_len,
             if (wt == 2 && (field == 2 || field == 3)) {
                 uint64_t l;
                 if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { parse_ok = 0; break; }
+                /* upb validates UTF-8 on every declared string at parse
+                 * time: invalid bytes mean ParseFromString raises, which
+                 * is parse failure — not a successfully-parsed envelope */
+                if (!utf8_valid(c.p, (int)l)) { parse_ok = 0; break; }
                 if (field == 2) { log_id = c.p; log_id_len = (int)l; }
                 else { log = c.p; log_len = (int)l; }
                 c.p += l;
                 presence = 1;
-            } else {
+            } else if (wt == 2 && field >= 1 && field <= 5) {
                 /* presence mirrors HasField(): only a CORRECT wire type
                  * (all LogSchema fields 1-5 are strings, wt 2) counts --
                  * a wrong-wire-type field is an unknown field to proto3
-                 * and must not make a payload look like an envelope */
-                if (wt == 2 && field >= 1 && field <= 5) presence = 1;
+                 * and must not make a payload look like an envelope.
+                 * UTF-8 is checked on ALL of 1-5 (__version__, logSource,
+                 * hostname too), exactly as dm_nvd_scan validates declared
+                 * strings: upb rejects the whole message on any of them. */
+                uint64_t l;
+                if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { parse_ok = 0; break; }
+                if (!utf8_valid(c.p, (int)l)) { parse_ok = 0; break; }
+                c.p += l;
+                presence = 1;
+            } else {
                 if (!skip_field(&c, wt)) { parse_ok = 0; break; }
             }
         }
@@ -825,7 +1067,7 @@ static int parse_one_row(parse_ctx_t *ctx, const uint8_t *pay, int pay_len,
             free(ctx->scratch);
             ctx->scratch_cap = content_len * 2 + 256;
             ctx->scratch = (uint8_t *)malloc((size_t)ctx->scratch_cap);
-            if (!ctx->scratch) { ctx->scratch_cap = 0; return -1; }
+            if (!ctx->scratch) { ctx->scratch_cap = 0; return -2; }
         }
         norm_len = normalize_span(content, content_len, ctx->scratch,
                                   ctx->norm_flags);
@@ -941,7 +1183,7 @@ static int parse_ctx_init(parse_ctx_t *ctx, PARSE_CTX_ARGS) {
     ctx->scratch = NULL; ctx->scratch_cap = 0;
     ctx->tcaps = (int32_t *)malloc(sizeof(int32_t) * 2
                                    * (size_t)(max_caps > 0 ? max_caps : 1));
-    return ctx->tcaps ? 0 : -1;
+    return ctx->tcaps ? 0 : -2;    /* malloc failure: OOM, not capacity */
 }
 
 static void parse_ctx_free(parse_ctx_t *ctx) {
@@ -961,14 +1203,15 @@ int64_t dm_parse_batch(
                        max_caps, version, version_len, parser_type,
                        parser_type_len, parser_id, parser_id_len, now,
                        rand_hex, out_buf, out_cap) != 0)
-        return -1;
+        return -2;
     out_offsets[0] = 0;
     for (int i = 0; i < n; i++) {
-        if (parse_one_row(&ctx, payloads + offsets[i],
-                          (int)(offsets[i + 1] - offsets[i]), i,
-                          status + i) != 0) {
+        int rc = parse_one_row(&ctx, payloads + offsets[i],
+                               (int)(offsets[i + 1] - offsets[i]), i,
+                               status + i);
+        if (rc != 0) {
             parse_ctx_free(&ctx);
-            return -1;
+            return rc;                 /* -1 grow-and-retry, -2 OOM */
         }
         out_offsets[i + 1] = ctx.o;
     }
@@ -996,7 +1239,7 @@ int64_t dm_parse_frames(
                        max_caps, version, version_len, parser_type,
                        parser_type_len, parser_id, parser_id_len, now,
                        rand_hex, out_buf, out_cap) != 0)
-        return -1;
+        return -2;
     out_offsets[0] = 0;
     int64_t m = 0;
     for (int i = 0; i < n_frames; i++) {
@@ -1006,9 +1249,10 @@ int64_t dm_parse_frames(
         if (!frame_is_batch(base, len)) {
             spans[2 * m] = frame_offsets[i];
             spans[2 * m + 1] = frame_offsets[i + 1];
-            if (parse_one_row(&ctx, base, len, m, status + m) != 0) {
+            int rc = parse_one_row(&ctx, base, len, m, status + m);
+            if (rc != 0) {
                 parse_ctx_free(&ctx);
-                return -1;
+                return rc;
             }
             out_offsets[m + 1] = ctx.o;
             m++;
@@ -1023,9 +1267,10 @@ int64_t dm_parse_frames(
             if (mlen > 0) {                /* packed empties: filtered, no row */
                 spans[2 * m] = frame_offsets[i] + (c.p - base);
                 spans[2 * m + 1] = spans[2 * m] + (int64_t)mlen;
-                if (parse_one_row(&ctx, c.p, (int)mlen, m, status + m) != 0) {
+                int rc = parse_one_row(&ctx, c.p, (int)mlen, m, status + m);
+                if (rc != 0) {
                     parse_ctx_free(&ctx);
-                    return -1;
+                    return rc;
                 }
                 out_offsets[m + 1] = ctx.o;
                 m++;

@@ -117,6 +117,17 @@ static void set_zmq_err(char *errbuf, int errbuf_len, const char *what) {
 
 extern "C" {
 
+// Feature version of this library build: the Python binding
+// (engine/native_transport.py DMT_FEATURE_VERSION) refuses a library that
+// reports a different number, so a stale committed .so fails loudly instead
+// of silently serving an older wire surface. native/build.sh stamps the
+// value from the binding; the default must match for bare builds.
+#ifndef DMT_FEATURE_VERSION
+#define DMT_FEATURE_VERSION 2
+#endif
+
+int dmt_feature_version(void) { return DMT_FEATURE_VERSION; }
+
 // --- construction ----------------------------------------------------------
 
 // Bind a listening pair endpoint. addr is a zmq endpoint (tcp://host:port,

@@ -1,5 +1,7 @@
 """Native kernel parity tests: the C featurizer/tokenizer/template-matcher
 must agree exactly with the pure-Python implementations."""
+import random
+
 import numpy as np
 import pytest
 
@@ -7,6 +9,46 @@ matchkern = pytest.importorskip("detectmateservice_tpu.utils.matchkern")
 
 from detectmateservice_tpu.models.tokenizer import HashTokenizer
 from detectmateservice_tpu.schemas import ParserSchema
+
+
+class TestFeatureVersion:
+    """The checked-in binaries must report the feature version the bindings
+    expect — a stale .so fails loudly at import instead of silently running
+    without the newer kernels (the bindings enforce it; these tests pin the
+    contract end to end, including the C-source default build.sh falls back
+    to when it cannot extract the stamp)."""
+
+    def test_kernel_library_reports_expected_version(self):
+        assert matchkern.lib_feature_version() == matchkern.DM_FEATURE_VERSION
+
+    def test_c_source_default_matches_bindings(self):
+        src = matchkern._SRC_PATH.read_text()
+        assert (f"#define DM_FEATURE_VERSION {matchkern.DM_FEATURE_VERSION}"
+                in src), "bump dmkern.c's default in lockstep with matchkern.py"
+
+    def test_transport_library_reports_expected_version(self):
+        nt = pytest.importorskip(
+            "detectmateservice_tpu.engine.native_transport")
+        assert nt._lib_feature_version(nt._lib) == nt.DMT_FEATURE_VERSION
+        src = nt._SRC_PATH.read_text()
+        assert (f"#define DMT_FEATURE_VERSION {nt.DMT_FEATURE_VERSION}"
+                in src), "bump dmtransport.cpp's default in lockstep"
+
+    def test_version_mismatch_raises_import_error(self, monkeypatch):
+        # doctor the expectation: the on-disk library now looks stale, and
+        # with the rebuild neutered the loader must refuse it loudly
+        monkeypatch.setattr(matchkern, "DM_FEATURE_VERSION",
+                            matchkern.DM_FEATURE_VERSION + 1)
+        monkeypatch.setattr(matchkern, "_rebuild", lambda: None)
+        with pytest.raises(ImportError, match="stale native kernel"):
+            matchkern._load()
+
+    def test_pre_versioning_library_reports_zero(self):
+        class _NoSymbol:
+            def __getattr__(self, name):
+                raise AttributeError(name)
+
+        assert matchkern._lib_feature_version(_NoSymbol()) == 0
 
 
 class TestFeaturizeParity:
@@ -130,6 +172,164 @@ class TestMapOverflowParity:
         det._featurize_python_rows([raw], tokens_py, ok_py, [0])
         assert ok_py.all()
         np.testing.assert_array_equal(tokens_native, tokens_py)
+
+
+class TestFeaturizeFuzzParity:
+    """Differential fuzz: over randomized ParserSchema messages (unicode,
+    truncation at seq_len, ragged/empty variables, header-map ordering) the
+    detector's featurize path must produce token matrices byte-identical to
+    HashTokenizer.encode_parsed — rows the C kernel cannot do exactly are
+    flagged, retried in Python (so the FINAL matrix is always the Python
+    one), and counted in featurize_fallback_rows_total."""
+
+    SEQ_LEN = 24
+    VOCAB = 4096
+
+    # pools chosen to hit the tokenizer's edges: ASCII case folding,
+    # multi-byte separators, the two ASCII-lowering codepoints the kernel
+    # must flag (İ, K), long runs that truncate, and empty strings
+    _POOLS = (
+        "abcdefXYZ0189",
+        "=_-./:!?#@%&*()[]{}",
+        " \t\r\n\x1c\x1d",
+        "céäßøñ",
+        "日本語ログイン検出",
+        "Ωπ𝔘🚀",
+        "\u0130\u212a",    # U+0130 / U+212A: ASCII-lowering
+        "A" * 40,
+    )
+
+    def _rand_text(self, rng, max_len=48):
+        # the ASCII-lowering pool guarantees a Python-fallback row, so keep
+        # it rare — the suite must prove BOTH paths, mostly the native one
+        pool = (self._POOLS[-2] if rng.random() < 0.02
+                else rng.choice(self._POOLS[:-2] + self._POOLS[-1:]))
+        return "".join(rng.choice(pool) for _ in range(rng.randrange(max_len)))
+
+    def _messages(self, rng, n):
+        msgs, expected = [], []
+        tok = HashTokenizer(vocab_size=self.VOCAB, seq_len=self.SEQ_LEN)
+        for i in range(n):
+            template = self._rand_text(rng)
+            variables = [self._rand_text(rng)
+                         for _ in range(rng.randrange(8))]
+            if rng.random() < 0.3:
+                variables.append("")              # empty variable
+            hv = {}
+            for _ in range(rng.randrange(6)):
+                hv[self._rand_text(rng, 12)] = self._rand_text(rng, 20)
+            if rng.random() < 0.1:
+                hv[""] = self._rand_text(rng, 8)  # empty map key
+            msgs.append(ParserSchema(
+                EventID=i, template=template, variables=variables,
+                logID=str(i), logFormatVariables=hv).serialize())
+            expected.append(tok.encode_parsed(template, variables, hv))
+        return msgs, np.stack(expected)
+
+    def test_fuzz_detector_path_matches_python(self):
+        from detectmateservice_tpu.engine import metrics as m
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        rng = random.Random(0xD317)
+        msgs, expected = self._messages(rng, 1200)
+        det = JaxScorerDetector(
+            name="FuzzParityDet",
+            config={"detectors": {"JaxScorerDetector": {
+                "method_type": "jax_scorer", "auto_config": False,
+                "seq_len": self.SEQ_LEN, "vocab_size": self.VOCAB,
+                "data_use_training": 0}}})
+        tokens, ok = det._featurize_raw_batch(msgs)
+        assert ok.all(), "valid serialized messages must all featurize"
+        np.testing.assert_array_equal(tokens, expected)
+        # the two counters partition the batch, and the fuzz pools force a
+        # non-zero fallback share (İ/K rows must not ride the native path)
+        labels = dict(component_type="jax_scorer", component_id="FuzzParityDet")
+        native = m.FEATURIZE_NATIVE_ROWS().labels(**labels)._value.get()
+        fallback = m.FEATURIZE_FALLBACK_ROWS().labels(**labels)._value.get()
+        assert native + fallback == len(msgs)
+        assert fallback > 0, "fuzz pools should have produced flagged rows"
+        assert native > fallback, "most rows must ride the native path"
+
+    def test_fuzz_raw_kernel_flags_never_lie(self):
+        """Every row the raw kernel reports ok=1 must already be byte-exact
+        (no Python retry involved)."""
+        rng = random.Random(0xBEEF)
+        msgs, expected = self._messages(rng, 400)
+        tokens, ok = matchkern.featurize_batch(msgs, self.SEQ_LEN, self.VOCAB)
+        idx = np.flatnonzero(ok)
+        assert len(idx) > 0
+        np.testing.assert_array_equal(tokens[idx], expected[idx])
+
+    def test_ascii_lowering_codepoints_flagged(self):
+        for text in ("\u0130stanbul", "3\u212a resistor",
+                     "deep \u0130 \u212a mix"):
+            raw = ParserSchema(template=text, variables=[],
+                               logFormatVariables={}).serialize()
+            _, ok = matchkern.featurize_batch([raw], 16, 1024)
+            assert not ok[0], text
+
+    def test_invalid_utf8_template_flagged(self):
+        # valid wire shape, invalid UTF-8 in template (field 5): upb would
+        # reject the message, so the kernel must not emit a token stream
+        raw = b"\x2a\x03\xff\xfe\x41"  # field 5, len 3, bad bytes
+        _, ok = matchkern.featurize_batch([raw], 16, 1024)
+        assert not ok[0]
+
+    def test_duplicate_wire_map_keys_last_wins(self):
+        # two wire entries with the same key: proto3 keeps the LAST value;
+        # the kernel must not tokenize both
+        entry1 = b"\x0a\x01k\x12\x01a"     # k -> a
+        entry2 = b"\x0a\x01k\x12\x01b"     # k -> b
+        raw = (b"\x52" + bytes([len(entry1)]) + entry1
+               + b"\x52" + bytes([len(entry2)]) + entry2)
+        c_rows, ok = matchkern.featurize_batch([raw], 16, 1024)
+        assert ok[0]
+        tok = HashTokenizer(vocab_size=1024, seq_len=16)
+        np.testing.assert_array_equal(
+            c_rows[0], tok.encode_parsed("", [], {"k": "b"}))
+
+
+class TestNativeFeaturizeKnob:
+    def _det(self, name, **over):
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        cfg = {"method_type": "jax_scorer", "auto_config": False,
+               "seq_len": 32, "data_use_training": 0, **over}
+        return JaxScorerDetector(
+            name=name, config={"detectors": {"JaxScorerDetector": cfg}})
+
+    def _counts(self, name):
+        from detectmateservice_tpu.engine import metrics as m
+
+        labels = dict(component_type="jax_scorer", component_id=name)
+        return (m.FEATURIZE_NATIVE_ROWS().labels(**labels)._value.get(),
+                m.FEATURIZE_FALLBACK_ROWS().labels(**labels)._value.get())
+
+    def test_off_forces_python_path_and_counts_fallback(self):
+        det = self._det("KnobOffDet", native_featurize=False)
+        assert det._matchkern() is None
+        msgs = [ParserSchema(EventID=i, template="t <*>", variables=[str(i)],
+                             logFormatVariables={"k": "v"}).serialize()
+                for i in range(16)]
+        tokens, ok = det._featurize_raw_batch(msgs)
+        assert ok.all()
+        native, fallback = self._counts("KnobOffDet")
+        assert native == 0 and fallback == len(msgs)
+        # identical rows to the default-on native path
+        det_on = self._det("KnobOnDet")
+        tokens_on, ok_on = det_on._featurize_raw_batch(msgs)
+        assert ok_on.all()
+        np.testing.assert_array_equal(tokens, tokens_on)
+        native_on, fallback_on = self._counts("KnobOnDet")
+        assert native_on == len(msgs) and fallback_on == 0
+
+    def test_explicit_thread_width_applies(self):
+        before = matchkern.featurize_threads()
+        try:
+            self._det("KnobThreadsDet", featurize_threads=2)
+            assert matchkern.featurize_threads() == 2
+        finally:
+            matchkern.set_featurize_threads(before)
 
 
 class TestParseBatchKernelParity:
@@ -340,6 +540,87 @@ class TestParseBatchKernelParity:
             LogSchema(logID="2", log="type=X msg=audit(1.0): pid=8\nuid=1").serialize(),
         ]
         self._assert_parity(parser, payloads)
+
+    @pytest.mark.parametrize("tag", [0x0A, 0x22, 0x2A],
+                             ids=["__version__", "logSource", "hostname"])
+    def test_invalid_utf8_in_any_declared_field_matches_python(self, tmp_path,
+                                                               tag):
+        """Invalid UTF-8 in ANY wt==2 LogSchema field 1-5 — not just
+        log/logID — is a parse failure to upb, so the kernel must treat the
+        payload exactly as Python does (strict: decode error; accept_raw:
+        raw-line shapes), never emit a row from a message Python rejects."""
+        good = self.audit_payloads(2)
+        bad = good[0] + bytes([tag]) + b"\x02\xff\xfe"
+        parser = self._parser(tmp_path, templates=["arch=<*> syscall=<*>"])
+        self._assert_parity(parser, [bad, *good])
+        raw_parser = self._parser(tmp_path, accept_raw_lines=True,
+                                  templates=["arch=<*> syscall=<*>"])
+        self._assert_parity(raw_parser, [bad, *good])
+
+    def test_json_heavy_batch_takes_batched_python_path(self, tmp_path,
+                                                        monkeypatch):
+        """A batch the kernel flags (almost) entirely — every payload of a
+        ``@type json`` edge starts with ``{`` — must fall back to the
+        BATCHED Python path, not serialize through per-row parse_line."""
+        parser = self._parser(tmp_path, accept_raw_lines=True,
+                              templates=["type=<*> msg=audit(<*>): <*>"])
+        payloads = [
+            (b'{"message": "type=LOGIN msg=audit(1700.%d): pid=%d uid=0",'
+             b' "hostname": "h"}\n' % (i, i)) for i in range(32)]
+        ref = parser._process_batch_python(list(payloads))
+        monkeypatch.setattr(
+            parser, "_parse_row_python",
+            lambda data: (_ for _ in ()).throw(
+                AssertionError("per-row fallback used for an all-JSON batch")))
+        out = parser.process_batch(list(payloads))
+        assert ([self._fields(a) for a in out]
+                == [self._fields(b) for b in ref])
+
+    def test_mostly_clean_batch_keeps_per_row_fallback(self, tmp_path,
+                                                       monkeypatch):
+        """A handful of flagged rows in a clean batch stays on the per-row
+        fallback (rerunning the WHOLE batch in Python would throw away the
+        kernel's work for 90%+ of the rows)."""
+        parser = self._parser(tmp_path, accept_raw_lines=True,
+                              templates=["type=<*> msg=audit(<*>): <*>"])
+        payloads = self.audit_payloads(30)
+        payloads.insert(7, b'{"message": "type=J msg=audit(9.9): x=1"}\n')
+        calls = []
+        orig = parser._parse_row_python
+        monkeypatch.setattr(parser, "_parse_row_python",
+                            lambda data: calls.append(1) or orig(data))
+        out = parser.process_batch(list(payloads))
+        assert len(calls) == 1          # only the JSON row re-ran in Python
+        assert all(o is not None for o in out)
+
+    def test_capacity_retry_policy_distinguishes_oom(self, tmp_path):
+        """-1 (output buffer too small) grows and retries; -2 (C-side malloc
+        failure) raises MemoryError immediately — growing our buffer cannot
+        fix the C side being out of memory."""
+        parser = self._parser(tmp_path)
+        pk = parser._parse_native
+        caps = []
+
+        def short(out, cap):
+            caps.append(cap)
+            return -1
+
+        with pytest.raises(MemoryError, match="overflowing"):
+            pk._run_with_capacity(64, 1, short)
+        assert len(caps) == 4 and caps[1] == caps[0] * 4  # grew between tries
+
+        caps.clear()
+
+        def oom(out, cap):
+            caps.append(cap)
+            return -2
+
+        with pytest.raises(MemoryError, match="OOM"):
+            pk._run_with_capacity(64, 1, oom)
+        assert len(caps) == 1                             # no grow-and-retry
+
+        with pytest.raises(RuntimeError, match="unknown error code"):
+            pk._run_with_capacity(64, 1, lambda out, cap: -7)
 
     def test_wrong_wire_type_fields_are_not_envelopes(self, tmp_path):
         """A payload whose only recognizable field numbers carry the WRONG
