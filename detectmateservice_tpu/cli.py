@@ -25,13 +25,20 @@ class _MaxLevelFilter(logging.Filter):
         return record.levelno < self.max_level
 
 
-def setup_logging(level: str = "INFO") -> None:
-    """stdout for < ERROR, stderr for >= ERROR (reference: cli.py:12-32)."""
+def setup_logging(level: str = "INFO", log_format: str = "plain") -> None:
+    """stdout for < ERROR, stderr for >= ERROR (reference: cli.py:12-32).
+    ``log_format="json"`` emits one JSON object per record (settings
+    ``log_format: json`` — the structured-event log, engine/health.py)."""
     root = logging.getLogger()
     root.setLevel(level.upper())
     for handler in list(root.handlers):
         root.removeHandler(handler)
-    fmt = logging.Formatter("[%(asctime)s] %(levelname)s %(name)s: %(message)s")
+    if log_format == "json":
+        from .engine.health import JsonLogFormatter
+
+        fmt: logging.Formatter = JsonLogFormatter()
+    else:
+        fmt = logging.Formatter("[%(asctime)s] %(levelname)s %(name)s: %(message)s")
     out_handler = logging.StreamHandler(sys.stdout)
     out_handler.addFilter(_MaxLevelFilter(logging.ERROR))
     out_handler.setFormatter(fmt)
@@ -53,7 +60,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     settings = ServiceSettings.from_yaml(args.settings)
     if args.config and not settings.config_file:
         settings.config_file = args.config
-    setup_logging(settings.log_level)
+    setup_logging(settings.log_level, settings.log_format)
 
     service = Service(settings)
     try:

@@ -3,9 +3,13 @@
 Parity with the reference client (reference: src/service/client.py:27-120):
 subcommands ``start`` / ``stop`` / ``status`` / ``metrics`` /
 ``reconfigure [--persist]`` against ``--url``, plus the TPU-build additions
-``checkpoint`` (save component state to the service's checkpoint_dir) and
+``checkpoint`` (save component state to the service's checkpoint_dir),
 ``trace [--chrome] [-o FILE]`` (read the pipeline flight recorder; --chrome
-fetches a Perfetto-loadable trace-event document).
+fetches a Perfetto-loadable trace-event document), ``events`` (the
+structured-event ring) and ``health`` — which fans out across every stage of
+a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
+``stages:`` mapping), prints a roll-up table, and exits non-zero when any
+stage is degraded, unhealthy, or unreachable.
 Uses stdlib urllib — no extra dependencies.
 """
 from __future__ import annotations
@@ -15,7 +19,8 @@ import json
 import sys
 import urllib.error
 import urllib.request
-from typing import Any, List, Optional
+from pathlib import Path
+from typing import Any, List, Optional, Tuple
 
 import yaml
 
@@ -70,6 +75,91 @@ class DetectMateClient:
         suffix = "?format=chrome" if chrome else ""
         return self._request("GET", "/admin/trace" + suffix)
 
+    def health(self, deep: bool = False) -> Any:
+        """Read the self-diagnosis state (``GET /admin/health``). A non-200
+        answer IS an answer here — the body still carries the report — so
+        the HTTP error is unwrapped instead of raised."""
+        path = "/admin/health" + ("?deep=1" if deep else "")
+        try:
+            return self._request("GET", path)
+        except urllib.error.HTTPError as exc:
+            body = exc.read()
+            try:
+                return json.loads(body)
+            except json.JSONDecodeError:
+                return {"state": "unknown",
+                        "detail": body.decode("utf-8", errors="replace")}
+
+    def events(self, limit: Optional[int] = None) -> Any:
+        """Read the structured event ring (``GET /admin/events``)."""
+        suffix = f"?limit={int(limit)}" if limit is not None else ""
+        return self._request("GET", "/admin/events" + suffix)
+
+
+def resolve_stages(default_url: str, targets: List[str]) -> List[Tuple[str, str]]:
+    """Targets → ordered ``(stage_name, admin_url)`` pairs. Accepted forms:
+
+    * a stage admin URL (``http://host:port``),
+    * a service settings YAML (the per-stage files a pipeline already has —
+      the URL is derived from its ``http_host``/``http_port``),
+    * a pipeline YAML with a ``stages:`` mapping of name → URL.
+
+    No targets = just ``--url`` (single-stage roll-up)."""
+    if not targets:
+        return [("service", default_url)]
+    stages: List[Tuple[str, str]] = []
+    for target in targets:
+        if target.startswith(("http://", "https://")):
+            stages.append((target, target))
+            continue
+        with open(target, "r", encoding="utf-8") as fh:
+            doc = yaml.safe_load(fh) or {}
+        if not isinstance(doc, dict):
+            raise ValueError(f"{target}: expected a YAML mapping")
+        if isinstance(doc.get("stages"), dict):
+            for name, url in doc["stages"].items():
+                stages.append((str(name), str(url)))
+            continue
+        host = doc.get("http_host", "127.0.0.1")
+        port = doc.get("http_port", 8000)
+        name = (doc.get("component_name") or doc.get("component_type")
+                or Path(target).stem)
+        stages.append((str(name), f"http://{host}:{port}"))
+    return stages
+
+
+def health_rollup(default_url: str, targets: List[str],
+                  deep: bool = False) -> int:
+    """Fan ``/admin/health?deep=1`` out over every stage, print the roll-up
+    table, and return the exit code: 0 only when every stage is healthy."""
+    stages = resolve_stages(default_url, targets)
+    rows = []
+    exit_code = 0
+    for name, url in stages:
+        try:
+            report = DetectMateClient(url).health(deep=True)
+            state = report.get("state", "unknown")
+            failing = [c for c in report.get("checks", [])
+                       if c.get("status") != "pass"]
+        except (urllib.error.URLError, OSError) as exc:
+            state, failing = "unreachable", [{"name": "admin_endpoint",
+                                             "detail": str(exc)}]
+        if state != "healthy":
+            exit_code = 1
+        rows.append((name, state, url, failing))
+    name_w = max(5, *(len(r[0]) for r in rows))
+    state_w = max(5, *(len(r[1]) for r in rows))
+    print(f"{'STAGE':<{name_w}}  {'STATE':<{state_w}}  URL / failing checks")
+    for name, state, url, failing in rows:
+        summary = ", ".join(c.get("name", "?") for c in failing)
+        print(f"{name:<{name_w}}  {state:<{state_w}}  {url}"
+              + (f"  [{summary}]" if summary else ""))
+        if deep:
+            for check in failing:
+                print(f"{'':<{name_w}}  {'':<{state_w}}    "
+                      f"{check.get('name', '?')}: {check.get('detail', '')}")
+    return exit_code
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
@@ -83,6 +173,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("status")
     sub.add_parser("metrics")
     sub.add_parser("checkpoint")
+    health = sub.add_parser(
+        "health",
+        help="pipeline health roll-up across stages (/admin/health)")
+    health.add_argument(
+        "targets", nargs="*",
+        help="stage admin URLs, per-stage settings YAMLs, or a pipeline "
+             "YAML with a 'stages: {name: url}' mapping; none = --url only")
+    health.add_argument("--deep", action="store_true",
+                        help="print per-check detail for failing stages")
+    events_p = sub.add_parser(
+        "events", help="read the structured event ring (/admin/events)")
+    events_p.add_argument("--limit", type=int, default=None,
+                          help="only the newest N events")
     trace = sub.add_parser(
         "trace", help="read the pipeline flight recorder (/admin/trace)")
     trace.add_argument("--chrome", action="store_true",
@@ -96,7 +199,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     client = DetectMateClient(args.url)
     try:
-        if args.command == "reconfigure":
+        if args.command == "health":
+            return health_rollup(args.url, args.targets, deep=args.deep)
+        if args.command == "events":
+            result = client.events(limit=args.limit)
+        elif args.command == "reconfigure":
             with open(args.config_file, "r", encoding="utf-8") as fh:
                 config = yaml.safe_load(fh) or {}
             result = client.reconfigure(config, persist=args.persist)

@@ -34,6 +34,15 @@ from .config import ComponentLoader, ComponentResolver, ConfigClassLoader, Confi
 from .config.manager import ConfigError
 from .engine import Engine, EngineSocketFactory
 from .engine import metrics as m
+from .engine.health import (
+    EventLog,
+    EventLogHandler,
+    HealthMonitor,
+    JsonLogFormatter,
+    install_thread_excepthook,
+    remove_excepthook_sink,
+    set_build_info,
+)
 from .library.common.core import CoreComponent, CoreConfig
 from .settings import ServiceSettings
 from .web.server import WebServer
@@ -161,6 +170,34 @@ class Service:
         )
         self._service_exit_event = threading.Event()
 
+        # self-diagnosis plane (engine/health.py): the structured event ring
+        # behind GET /admin/events, the watchdog behind GET /admin/health,
+        # the process-wide thread excepthook (no daemon worker dies silently
+        # to stderr), and the dm_build_info gauge. All wired before the
+        # component loads so its workers can register heartbeats.
+        self.events = EventLog(maxlen=settings.event_ring_size)
+        self.health = HealthMonitor(
+            dict(self._labels),
+            stage=(settings.trace_stage or settings.component_name
+                   or settings.component_type),
+            stall_seconds=settings.watchdog_stall_seconds,
+            unhealthy_seconds=settings.watchdog_unhealthy_seconds,
+            interval_s=settings.watchdog_interval_s,
+            recovery_intervals=settings.watchdog_recovery_intervals,
+            ingest_stall_seconds=settings.watchdog_ingest_stall_seconds,
+            events=self.events,
+            logger=self.logger,
+        )
+        # the logger mirrors WARNING+ records into the ring; a re-created
+        # Service with the same identity reuses the logger, so stale handlers
+        # pointing at a dead ring are replaced, not accumulated
+        for handler in list(self.logger.handlers):
+            if isinstance(handler, EventLogHandler):
+                self.logger.removeHandler(handler)
+        self.logger.addHandler(EventLogHandler(self.events))
+        self._excepthook_sink = install_thread_excepthook(self.logger, self.events)
+        set_build_info()
+
         # admin server constructed here, started in run() (reference: core.py:81)
         self.web_server = WebServer(self)
 
@@ -194,9 +231,22 @@ class Service:
             # processing_errors_total series (same labels the engine uses),
             # not a parallel series keyed by class name
             self.library_component.metrics_labels = dict(self._labels)
+            # component-side heartbeats (e.g. the scorer's dispatch workers)
+            # register through the same monitor; a pipelined component with a
+            # drain-progress counter also gets the stuck-inflight check
+            self.library_component.health_monitor = self.health
+            pending_fn = getattr(self.library_component, "pending_count", None)
+            drained_fn = getattr(self.library_component, "drained_total", None)
+            if callable(pending_fn) and callable(drained_fn):
+                self.health.register_progress(
+                    "device_inflight", pending_fn, drained_fn)
 
         self.processor = LibraryComponentProcessor(self.library_component, self._labels)
-        self.engine = Engine(settings, self.processor, socket_factory, self.logger)
+        self.engine = Engine(settings, self.processor, socket_factory,
+                             self.logger, health=self.health)
+        self.health.trace_recorder = self.engine.trace_recorder
+        if settings.watchdog_enabled:
+            self.health.start()
 
         self._running_metric = m.ENGINE_RUNNING().labels(**self._labels)
         self._starts_metric = m.ENGINE_STARTS().labels(**self._labels)
@@ -314,6 +364,8 @@ class Service:
                 self.library_component.teardown()
             except Exception as exc:
                 self.logger.error("component teardown failed: %s", exc)
+        self.health.stop()
+        remove_excepthook_sink(self._excepthook_sink)
         self.web_server.stop()
         self.logger.info("service shut down")
 
@@ -333,6 +385,7 @@ class Service:
                 "component_type": self.settings.component_type,
                 "component_id": self.settings.component_id,
                 "running": self.engine.running,
+                "health": self.health.state,
             },
             "distributed": process_info(),
             "settings": self.settings.model_dump(mode="json"),
@@ -382,14 +435,25 @@ class Service:
         logger.setLevel(self.settings.log_level.upper())
         logger.propagate = False
         have = {type(h).__name__ + getattr(h, "_dm_tag", "") for h in logger.handlers}
-        fmt = logging.Formatter(
-            "[%(asctime)s] %(levelname)s %(name)s: %(message)s"
-        )
+        if self.settings.log_format == "json":
+            fmt: logging.Formatter = JsonLogFormatter(static=dict(
+                component_type=self.settings.component_type,
+                component_id=self.settings.component_id or "unknown"))
+        else:
+            fmt = logging.Formatter(
+                "[%(asctime)s] %(levelname)s %(name)s: %(message)s"
+            )
         if self.settings.log_to_console and "StreamHandlerconsole" not in have:
             console = logging.StreamHandler(sys.__stdout__)
             console.setFormatter(fmt)
             console._dm_tag = "console"  # type: ignore[attr-defined]
             logger.addHandler(console)
+        else:
+            # a reused logger (same component identity) must still honor THIS
+            # settings' log_format — re-point the existing handlers' formatter
+            for handler in logger.handlers:
+                if getattr(handler, "_dm_tag", "") in ("console", "file"):
+                    handler.setFormatter(fmt)
         if self.settings.log_to_file and "FileHandlerfile" not in have:
             log_dir = Path(self.settings.log_dir)
             try:

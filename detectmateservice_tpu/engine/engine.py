@@ -47,6 +47,7 @@ from .framing import (
     unwrap_trace,
     wrap_trace,
 )
+from .health import Heartbeat
 from .tracing import FlightRecorder
 from .socket import (
     EngineSocket,
@@ -101,6 +102,7 @@ class Engine:
         processor: Processor,
         socket_factory: Optional[EngineSocketFactory] = None,
         logger: Optional[logging.Logger] = None,
+        health=None,
     ) -> None:
         if processor is None or not callable(getattr(processor, "process", None)):
             raise EngineException("processor must provide a callable process(bytes)")
@@ -123,6 +125,16 @@ class Engine:
             component_type=settings.component_type,
             component_id=settings.component_id or "unknown",
         )
+
+        # self-diagnosis heartbeats (engine/health.py): one monotonic clock
+        # write per loop iteration — the beats happen unconditionally (they
+        # cost an attribute store); only the watchdog checks need a monitor
+        self._hb_loop = Heartbeat("engine_loop")
+        self._hb_ingest = Heartbeat("ingest")
+        self._hb_output = Heartbeat("output_pump")
+        if health is not None:
+            health.register_engine(self._hb_loop, self._hb_ingest,
+                                   self._hb_output, lambda: self._running)
 
         # pipeline tracing (engine_trace): hop stamping + the flight
         # recorder behind GET /admin/trace. Inbound v2 headers are stripped
@@ -232,6 +244,11 @@ class Engine:
             self._sockets_closed = False
         self._stop_event.clear()
         self._stop_drain_deadline = None
+        # re-stamp the heartbeats so a restart does not instantly trip the
+        # watchdog on ages accumulated while the engine was (healthily) down
+        self._hb_loop.beat()
+        self._hb_ingest.beat()
+        self._hb_output.wait_end()
         self._running = True
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
@@ -460,6 +477,7 @@ class Engine:
         short_timeout = min(5, base_timeout)
         current_timeout = base_timeout
         while self._running and not self._stop_event.is_set():
+            self._hb_loop.beat()
             if callable(pending_fn):
                 want = short_timeout if pending_fn() > 0 else base_timeout
                 if want != current_timeout:
@@ -488,6 +506,7 @@ class Engine:
                 continue
             if not raw:
                 continue
+            self._hb_ingest.beat()
 
             if use_frames:
                 # collect the burst as whole frames (each may pack hundreds
@@ -757,9 +776,13 @@ class Engine:
                         continue
                     mark_sent()
                 if len(still) == len(pending_socks):
-                    # gauge only touched on the already-slow stalled path,
-                    # so an unobstructed send pays nothing for it
+                    # gauge + heartbeat only touched on the already-slow
+                    # stalled path, so an unobstructed send pays nothing
                     backlog_g.set(len(still))
+                    if not waited:
+                        self._hb_output.wait_begin()
+                    else:
+                        self._hb_output.beat()
                     waited = True
                     time.sleep(0.001)
                 pending_socks = still
@@ -768,6 +791,7 @@ class Engine:
                 dropped_l.inc(lines)
             if waited:
                 backlog_g.set(0)
+                self._hb_output.wait_end()
             return any_ok
 
         waited = False
@@ -783,6 +807,11 @@ class Engine:
                         # gauge only touched once a peer actually stalls
                         m.OUTPUT_SEND_BACKLOG().labels(**self._labels).set(1)
                         waited = True
+                    # bounded retries (max retry_count × 10 ms) never trip
+                    # the saturation check — drop mode surfaces through the
+                    # drop-rate alert instead — but the beat keeps the pump
+                    # heartbeat honest while the loop sleeps here
+                    self._hb_output.beat()
                     time.sleep(_RETRY_SLEEP_S)
                 except TransportError as exc:
                     self.logger.warning("output send failed hard: %s", exc)

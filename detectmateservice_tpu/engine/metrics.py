@@ -148,3 +148,30 @@ OUTPUT_SEND_BACKLOG = _series(
     "output_send_backlog",
     "Output sockets currently waiting on a full peer queue",
 )
+
+# self-diagnosis series (engine/health.py): the watchdog rolls the
+# per-subsystem checks into one Enum per process and exports every
+# registered loop's heartbeat age; ops/alerts.yml alerts on both (and the
+# alert rules are pinned to this registry by tests/test_observability.py,
+# the same both-directions discipline as the Grafana panels).
+ENGINE_HEALTH_STATE = _series(
+    Enum,
+    "engine_health_state",
+    "Watchdog roll-up of the per-subsystem health checks",
+    states=["healthy", "degraded", "unhealthy"],
+)
+HEARTBEAT_LABELS = ("component_type", "component_id", "loop")
+HEARTBEAT_AGE = _series(
+    Gauge,
+    "engine_heartbeat_age_seconds",
+    "Seconds since the named loop last stamped its heartbeat",
+    HEARTBEAT_LABELS,
+)
+BUILD_INFO_LABELS = ("version", "dm_feature_version", "dmt_feature_version")
+BUILD_INFO = _series(
+    Gauge,
+    "dm_build_info",
+    "Constant 1; the labels carry the deployed package version and the "
+    "native kernels' feature versions",
+    BUILD_INFO_LABELS,
+)

@@ -22,7 +22,7 @@ import heapq
 import itertools
 import threading
 from collections import deque
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from .framing import TraceContext
 
@@ -51,11 +51,13 @@ class FlightRecorder:
         self._slowest: List[tuple] = []  # min-heap of (e2e_s, n, trace_dict)
         self._sampled: deque = deque(maxlen=max(1, max_sampled))
         self._completed = 0
+        self._last_trace_id: Optional[str] = None
 
     def record(self, ctx: TraceContext, e2e_s: float) -> None:
         entry = trace_to_dict(ctx, e2e_s)
         with self._lock:
             self._completed += 1
+            self._last_trace_id = entry["trace_id"]
             if len(self._slowest) < self._max_slowest:
                 heapq.heappush(self._slowest,
                                (e2e_s, next(self._tiebreak), entry))
@@ -69,6 +71,13 @@ class FlightRecorder:
     def completed(self) -> int:
         with self._lock:
             return self._completed
+
+    @property
+    def last_trace_id(self) -> Optional[str]:
+        """Most recently completed trace id (health events attach it so an
+        operator can jump from a transition straight to a trace)."""
+        with self._lock:
+            return self._last_trace_id
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -117,3 +126,4 @@ class FlightRecorder:
             self._slowest.clear()
             self._sampled.clear()
             self._completed = 0
+            self._last_trace_id = None

@@ -201,6 +201,14 @@ class JaxScorerDetector(CoreDetector):
         self._inflight = deque()
         self._upload_queue = None                      # upload_workers > 0
         self._upload_threads: List = []
+        # self-diagnosis (engine/health.py): the hosting Service sets
+        # health_monitor; drained_total is the progress counter behind the
+        # device_inflight_stuck watchdog check, the dispatch heartbeat is
+        # stamped by the upload workers (age gauge only — an idle worker
+        # parked on queue.get is healthy, so no age-based check applies)
+        self.health_monitor = None
+        self._drained_total = 0
+        self._dispatch_hb = None
 
     def _validate_static_config(self) -> None:
         """Reject bad enum-ish config at CONSTRUCTION (no jax import needed):
@@ -866,6 +874,12 @@ class JaxScorerDetector(CoreDetector):
         happens within milliseconds of readiness, not at the 100 ms lull)."""
         return len(self._inflight)
 
+    def drained_total(self) -> int:
+        """Monotonic count of drained in-flight batches — the progress
+        counter the health watchdog pairs with ``pending_count`` to detect a
+        stuck device queue (pending > 0 and this number frozen)."""
+        return self._drained_total
+
     def drain_ready(self) -> List[Optional[bytes]]:
         """Engine short-poll tick: pop only batches whose readback already
         landed — never blocks the loop on an in-flight device batch. When the
@@ -989,6 +1003,9 @@ class JaxScorerDetector(CoreDetector):
 
         if self._upload_queue is None:
             self._upload_queue = _queue.Queue()
+        if self._dispatch_hb is None and self.health_monitor is not None:
+            self._dispatch_hb = self.health_monitor.register_heartbeat(
+                "scorer_dispatch")
         self._upload_threads = [t for t in self._upload_threads if t.is_alive()]
         for i in range(len(self._upload_threads), self.config.upload_workers):
             t = threading.Thread(target=self._upload_loop, daemon=True,
@@ -1005,6 +1022,8 @@ class JaxScorerDetector(CoreDetector):
             item = self._upload_queue.get()
             if item is None:
                 return
+            if self._dispatch_hb is not None:
+                self._dispatch_hb.beat()
             slot, chunk = item
             try:
                 scores = self._score_dev(chunk)
@@ -1028,6 +1047,7 @@ class JaxScorerDetector(CoreDetector):
     def _drain_one(self) -> List[Optional[bytes]]:
         slot = self._inflight.popleft()
         slot.done.wait()
+        self._drained_total += 1
         if slot.error is not None:
             # worker-path dispatch failure: same containment rule as the
             # engine's per-message processing — count EVERY lost message

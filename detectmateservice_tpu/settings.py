@@ -219,6 +219,30 @@ class ServiceSettings(BaseModel):
     coordinator_address: Optional[str] = None  # "host:port"
     num_processes: int = Field(default=1, ge=1)
     process_id: int = Field(default=0, ge=0)
+    # -- self-diagnosis (engine/health.py) --------------------------------
+    # "json" renders every log record as one JSON object per line (component
+    # identity + message + attached structured event), for fleet log
+    # aggregation; "plain" keeps the reference's human format.
+    log_format: str = Field(default="plain", pattern="^(plain|json)$")
+    # per-process health watchdog: a daemon thread evaluates the subsystem
+    # checks (process_wedged / ingest_stalled / output_saturated /
+    # device_inflight_stuck) every interval and rolls them into the
+    # engine_health_state Enum behind GET /admin/health.
+    watchdog_enabled: bool = True
+    watchdog_interval_s: float = Field(default=2.0, ge=0.05, le=300.0)
+    # heartbeat age (or continuous blocked-send / stuck-inflight time) at
+    # which a check degrades resp. goes unhealthy. stall must exceed
+    # engine_recv_timeout or an idle loop's recv tick would false-alarm.
+    watchdog_stall_seconds: float = Field(default=10.0, gt=0.0)
+    watchdog_unhealthy_seconds: float = Field(default=30.0, gt=0.0)
+    # hysteresis: checks degrade on the FIRST failing evaluation but only
+    # recover after this many consecutive clean ones (no alert flapping)
+    watchdog_recovery_intervals: int = Field(default=2, ge=1)
+    # 0 (default) = an idle ingress is healthy; > 0 = this stage expects
+    # traffic, and that many seconds of ingress silence is a degradation
+    watchdog_ingest_stall_seconds: float = Field(default=0.0, ge=0.0)
+    # bounded ring of structured events behind GET /admin/events
+    event_ring_size: int = Field(default=512, ge=8, le=65536)
 
     # -- derived identity (reference: settings.py:93-114) -----------------
     @model_validator(mode="after")
@@ -231,6 +255,15 @@ class ServiceSettings(BaseModel):
             object.__setattr__(
                 self, "component_id", uuid.uuid5(uuid.NAMESPACE_URL, seed).hex
             )
+        return self
+
+    # -- watchdog cross-validation ----------------------------------------
+    @model_validator(mode="after")
+    def _check_watchdog(self) -> "ServiceSettings":
+        if self.watchdog_unhealthy_seconds < self.watchdog_stall_seconds:
+            raise ValueError(
+                "watchdog_unhealthy_seconds must be >= watchdog_stall_seconds "
+                f"({self.watchdog_unhealthy_seconds} < {self.watchdog_stall_seconds})")
         return self
 
     # -- TLS cross-validation (reference: settings.py:116-132) ------------

@@ -12,10 +12,15 @@ The reference runs FastAPI/uvicorn on a thread with signal handlers disabled
 (reference: server.py:40-42); this environment has neither, so the server is a
 stdlib ``ThreadingHTTPServer`` on a daemon thread — same observable surface,
 zero extra dependencies. The TPU build adds ``POST /admin/profile`` to capture
-a jax.profiler trace and ``GET /admin/trace`` to read the engine's pipeline
+a jax.profiler trace, ``GET /admin/trace`` to read the engine's pipeline
 flight recorder — ``?format=chrome`` returns a Perfetto/chrome://tracing
 loadable trace-event document (closes the tracing gap noted in SURVEY.md
-§5.1 at both the device and the pipeline layer).
+§5.1 at both the device and the pipeline layer) — plus the self-diagnosis
+surface (engine/health.py): ``GET /admin/health`` (cheap liveness; ``?deep=1``
+runs the checks and returns non-200 with per-check detail on degradation,
+the docker-compose/k8s healthcheck target) and ``GET /admin/events`` (the
+bounded structured-event ring: health transitions, thread exceptions,
+WARNING+ log records).
 """
 from __future__ import annotations
 
@@ -107,6 +112,40 @@ def _make_handler(service):
                 self._send(200, generate_latest(), CONTENT_TYPE_LATEST)
             elif parsed.path == "/admin/status":
                 self._send_json(200, service._create_status_report())
+            elif parsed.path == "/admin/health":
+                query = parse_qs(parsed.query)
+                deep = (query.get("deep") or ["0"])[0] not in ("", "0", "false")
+                monitor = getattr(service, "health", None)
+                if monitor is None:
+                    self._send_json(200, {"state": "unknown",
+                                          "detail": "no health monitor"})
+                elif deep:
+                    # fresh evaluation with per-check detail; non-200 on
+                    # anything short of healthy so orchestration healthchecks
+                    # (docker-compose/k8s) can gate on it directly
+                    report = monitor.evaluate()
+                    code = 200 if report["state"] == "healthy" else 503
+                    self._send_json(code, report)
+                else:
+                    # cheap liveness: the watchdog's last roll-up, no
+                    # evaluation on the request path; degraded stays 200
+                    # (restarting a merely-degraded container makes it worse)
+                    state = monitor.state
+                    self._send_json(503 if state == "unhealthy" else 200,
+                                    {"state": state})
+            elif parsed.path == "/admin/events":
+                query = parse_qs(parsed.query)
+                events = getattr(service, "events", None)
+                if events is None:
+                    self._send_json(404, {"detail": "service has no event log"})
+                    return
+                try:
+                    limit = int((query.get("limit") or ["-1"])[0])
+                except ValueError:
+                    self._send_json(400, {"detail": "limit must be an integer"})
+                    return
+                self._send_json(
+                    200, events.snapshot(limit if limit >= 0 else None))
             elif parsed.path == "/admin/trace":
                 query = parse_qs(parsed.query)
                 fmt = (query.get("format") or ["json"])[0]

@@ -1,0 +1,569 @@
+"""Self-diagnosis subsystem (engine/health.py): heartbeats, watchdog checks,
+structured events, the /admin/health + /admin/events surface, and the
+client-side pipeline roll-up.
+
+Tier-1 coverage for the PR's acceptance criterion: an injected engine-loop
+stall flips ``engine_health_state`` to degraded/unhealthy within one
+watchdog interval, ``GET /admin/health?deep=1`` returns non-200 naming the
+failed check, and ``GET /admin/events`` carries the matching JSON
+transition event — plus admin-endpoint edge cases (empty flight recorder,
+unknown paths, injected check failures) and the ``threading.excepthook``
+safety net.
+"""
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from detectmateservice_tpu.core import Service
+from detectmateservice_tpu.engine.health import (
+    EventLog,
+    Heartbeat,
+    HealthMonitor,
+    JsonLogFormatter,
+    install_thread_excepthook,
+    remove_excepthook_sink,
+)
+from detectmateservice_tpu.settings import ServiceSettings
+
+from conftest import wait_until
+
+LABELS = dict(component_type="core", component_id="health-unit")
+
+
+def make_monitor(**kw):
+    kw.setdefault("stall_seconds", 0.05)
+    kw.setdefault("unhealthy_seconds", 0.2)
+    kw.setdefault("recovery_intervals", 2)
+    return HealthMonitor(LABELS, **kw)
+
+
+def engine_monitor(**kw):
+    monitor = make_monitor(**kw)
+    hb_loop, hb_ingest, hb_out = (Heartbeat("engine_loop"),
+                                  Heartbeat("ingest"),
+                                  Heartbeat("output_pump"))
+    monitor.register_engine(hb_loop, hb_ingest, hb_out, lambda: True)
+    return monitor, hb_loop, hb_ingest, hb_out
+
+
+def check_status(report, name):
+    return next(c for c in report["checks"] if c["name"] == name)["status"]
+
+
+class TestWatchdogChecks:
+    def test_fresh_heartbeats_are_healthy(self):
+        monitor, *_ = engine_monitor()
+        assert monitor.evaluate()["state"] == "healthy"
+
+    def test_loop_stall_degrades_on_first_evaluation(self):
+        """Fail-fast half of the hysteresis: a stalled loop flips the state
+        on the very next evaluation — within one watchdog interval."""
+        monitor, hb_loop, hb_ingest, hb_out = engine_monitor()
+        hb_ingest.beat()
+        time.sleep(0.08)  # > stall_seconds, < unhealthy_seconds
+        hb_ingest.beat()  # only the loop heartbeat is stale
+        report = monitor.evaluate()
+        assert report["state"] == "degraded"
+        assert check_status(report, "process_wedged") == "degraded"
+
+    def test_loop_stall_escalates_to_unhealthy(self):
+        monitor, *_ = engine_monitor()
+        time.sleep(0.25)  # > unhealthy_seconds
+        report = monitor.evaluate()
+        assert report["state"] == "unhealthy"
+        assert check_status(report, "process_wedged") == "unhealthy"
+
+    def test_recovery_needs_consecutive_clean_intervals(self):
+        """Recover-slow half: one clean evaluation is not enough."""
+        monitor, hb_loop, *_ = engine_monitor()
+        time.sleep(0.08)
+        assert monitor.evaluate()["state"] == "degraded"
+        hb_loop.beat()
+        assert monitor.evaluate()["state"] == "degraded"  # 1/2 clean
+        hb_loop.beat()
+        assert monitor.evaluate()["state"] == "healthy"   # 2/2 clean
+
+    def test_output_wait_attributed_to_output_saturated(self):
+        """A loop blocked in output flow control is 'saturated', never
+        'wedged' — the pump heartbeat stays fresh and takes the blame."""
+        monitor, hb_loop, hb_ingest, hb_out = engine_monitor()
+        time.sleep(0.08)          # loop heartbeat goes stale...
+        hb_out.wait_begin()
+        hb_out.waiting_since -= 0.1   # ...because it has been waiting
+        report = monitor.evaluate()
+        assert check_status(report, "process_wedged") == "pass"
+        assert check_status(report, "output_saturated") == "degraded"
+        hb_out.wait_end()
+
+    def test_engine_not_running_never_alarms(self):
+        monitor = make_monitor()
+        hbs = Heartbeat("engine_loop"), Heartbeat("ingest"), Heartbeat("output_pump")
+        monitor.register_engine(*hbs, lambda: False)
+        time.sleep(0.25)
+        assert monitor.evaluate()["state"] == "healthy"
+
+    def test_idle_ingest_is_healthy_by_default(self):
+        monitor, hb_loop, hb_ingest, _ = engine_monitor()
+        hb_ingest.last -= 100.0  # very stale ingress
+        hb_loop.beat()
+        report = monitor.evaluate()
+        assert check_status(report, "ingest_stalled") == "pass"
+
+    def test_ingest_stall_degrades_when_traffic_expected(self):
+        monitor, hb_loop, hb_ingest, _ = engine_monitor(
+            ingest_stall_seconds=0.05)
+        hb_ingest.last -= 1.0
+        hb_loop.beat()
+        report = monitor.evaluate()
+        assert check_status(report, "ingest_stalled") == "degraded"
+
+    def test_inflight_stuck_detects_frozen_progress(self):
+        monitor = make_monitor()
+        probe = {"pending": 2, "progress": 7}
+        monitor.register_progress("device_inflight",
+                                  lambda: probe["pending"],
+                                  lambda: probe["progress"])
+        assert monitor.evaluate()["state"] == "healthy"  # baseline
+        time.sleep(0.08)
+        report = monitor.evaluate()
+        assert check_status(report, "device_inflight") == "degraded"
+        probe["progress"] += 1  # a drain happened: progress resets the clock
+        monitor.evaluate()
+        report = monitor.evaluate()
+        assert check_status(report, "device_inflight") == "pass"
+        probe["pending"] = 0
+        assert monitor.evaluate()["state"] == "healthy"
+
+    def test_crashing_check_degrades_instead_of_killing_watchdog(self):
+        monitor = make_monitor()
+
+        class Bomb:
+            name = "bomb"
+
+            def evaluate(self, now):
+                raise RuntimeError("boom")
+
+        monitor.add_check(Bomb())
+        report = monitor.evaluate()
+        assert check_status(report, "bomb") == "degraded"
+        assert "boom" in next(c for c in report["checks"]
+                              if c["name"] == "bomb")["detail"]
+
+    def test_transition_events_carry_trace_id(self):
+        from detectmateservice_tpu.engine.framing import Hop, TraceContext
+        from detectmateservice_tpu.engine.tracing import FlightRecorder
+
+        events = EventLog()
+        monitor, *_ = engine_monitor(events=events)
+        recorder = FlightRecorder(sample_every=1)
+        ctx = TraceContext.new(1_000)
+        ctx.hops.append(Hop("parser", 2_000, 3_000))
+        recorder.record(ctx, 1e-6)
+        monitor.trace_recorder = recorder
+        time.sleep(0.08)
+        monitor.evaluate()
+        transitions = [e for e in events.snapshot()["events"]
+                       if e["kind"] == "health_transition"]
+        assert transitions, "no transition events emitted"
+        wedged = next(e for e in transitions if e["check"] == "process_wedged")
+        assert wedged["from"] == "pass" and wedged["to"] in ("degraded",
+                                                             "unhealthy")
+        assert wedged["trace_id"] == recorder.last_trace_id
+        assert wedged["component_id"] == LABELS["component_id"]
+        # every event is JSON-serializable as-is (the /admin/events contract)
+        json.dumps(events.snapshot())
+
+    def test_watchdog_thread_runs_and_stops(self):
+        monitor, hb_loop, *_ = engine_monitor()
+        monitor.start(interval_s=0.02)
+        time.sleep(0.12)  # several intervals with a stale loop heartbeat
+        assert monitor.state != "healthy"
+        monitor.stop()
+        assert monitor._thread is None
+
+
+class TestEventLog:
+    def test_ring_is_bounded_and_sequenced(self):
+        events = EventLog(maxlen=4)
+        for i in range(10):
+            events.emit({"kind": "log", "i": i})
+        snap = events.snapshot()
+        assert snap["total"] == 10
+        assert len(snap["events"]) == 4
+        assert [e["i"] for e in snap["events"]] == [6, 7, 8, 9]
+        assert [e["seq"] for e in snap["events"]] == [7, 8, 9, 10]
+
+    def test_snapshot_limit(self):
+        events = EventLog()
+        for i in range(5):
+            events.emit({"i": i})
+        assert [e["i"] for e in events.snapshot(limit=2)["events"]] == [3, 4]
+
+
+class TestJsonLogging:
+    def test_formatter_emits_parseable_json_with_identity(self):
+        fmt = JsonLogFormatter(static={"component_type": "core",
+                                       "component_id": "abc"})
+        record = logging.LogRecord("engine", logging.WARNING, __file__, 1,
+                                   "dropped %d frames", (3,), None)
+        record.dm_event = {"kind": "health_transition", "check": "x"}
+        doc = json.loads(fmt.format(record))
+        assert doc["level"] == "WARNING"
+        assert doc["message"] == "dropped 3 frames"
+        assert doc["component_id"] == "abc"
+        assert doc["event"]["check"] == "x"
+
+    def test_service_log_format_json_swaps_the_formatter(self, inproc_factory):
+        settings = ServiceSettings(
+            component_type="core", component_name="json-logger",
+            engine_addr="inproc://jsonlog", http_port=0, log_to_file=False,
+            log_format="json", watchdog_enabled=False)
+        svc = Service(settings, socket_factory=inproc_factory)
+        console = [h for h in svc.logger.handlers
+                   if getattr(h, "_dm_tag", "") == "console"]
+        assert console and isinstance(console[0].formatter, JsonLogFormatter)
+
+    def test_warning_records_mirror_into_event_ring(self, inproc_factory):
+        settings = ServiceSettings(
+            component_type="core", component_name="ring-logger",
+            engine_addr="inproc://ringlog", http_port=0, log_to_file=False,
+            log_to_console=False, watchdog_enabled=False)
+        svc = Service(settings, socket_factory=inproc_factory)
+        svc.logger.warning("socket %s misbehaving", "out-1")
+        svc.logger.debug("not mirrored")
+        kinds = [(e["kind"], e.get("message"))
+                 for e in svc.events.snapshot()["events"]]
+        assert ("log", "socket out-1 misbehaving") in kinds
+        assert all(msg != "not mirrored" for _, msg in kinds)
+
+
+class TestThreadExcepthook:
+    def test_uncaught_thread_exception_becomes_structured_event(self):
+        events = EventLog()
+        logger = logging.getLogger("test-excepthook")
+        logger.propagate = False
+        sink = install_thread_excepthook(logger, events)
+        try:
+            t = threading.Thread(target=lambda: 1 / 0, name="Doomed")
+            t.start()
+            t.join()
+            assert wait_until(
+                lambda: any(e["kind"] == "thread_exception"
+                            for e in events.snapshot()["events"]), 2.0)
+            event = next(e for e in events.snapshot()["events"]
+                         if e["kind"] == "thread_exception")
+            assert event["thread"] == "Doomed"
+            assert "ZeroDivisionError" in event["error"]
+            assert "ZeroDivisionError" in event["traceback"]
+        finally:
+            remove_excepthook_sink(sink)
+
+    def test_service_installs_and_removes_its_sink(self, inproc_factory):
+        settings = ServiceSettings(
+            component_type="core", component_name="hooked",
+            engine_addr="inproc://hooked", http_port=0, log_to_file=False,
+            log_to_console=False, watchdog_enabled=False)
+        svc = Service(settings, socket_factory=inproc_factory)
+        t = threading.Thread(target=lambda: [][1], name="OutOfRange")
+        t.start()
+        t.join()
+        assert wait_until(
+            lambda: any(e["kind"] == "thread_exception"
+                        for e in svc.events.snapshot()["events"]), 2.0)
+        event = next(e for e in svc.events.snapshot()["events"]
+                     if e["kind"] == "thread_exception")
+        assert event["thread"] == "OutOfRange"
+
+
+# ---------------------------------------------------------------------------
+# admin plane, end to end
+# ---------------------------------------------------------------------------
+def http_json(port, path, method="GET"):
+    """(status_code, body) — non-2xx responses are answers, not errors."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=b"" if method == "POST" else None)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def http_text(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.read().decode()
+
+
+class BlockingProcessor:
+    """Injected stall: process() parks on an Event — the engine loop stops
+    beating exactly as if the component wedged."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def process(self, data):
+        self.release.wait(timeout=30)
+        return data
+
+
+def fast_watchdog_settings(addr, **kw):
+    return ServiceSettings(
+        component_type="core", engine_addr=addr, http_port=0,
+        log_to_file=False, log_to_console=False,
+        watchdog_interval_s=0.05, watchdog_stall_seconds=0.25,
+        watchdog_unhealthy_seconds=1.5, **kw)
+
+
+class TestAdminHealthEndToEnd:
+    """The PR's acceptance criterion, through public surfaces only."""
+
+    def test_injected_stall_flips_state_within_one_interval(
+            self, run_service, inproc_factory):
+        svc = Service(fast_watchdog_settings("inproc://stall1",
+                                             component_name="stall-victim"),
+                      socket_factory=inproc_factory)
+        run_service(svc)
+        port = svc.web_server.port
+        assert wait_until(lambda: svc.engine.running, 5.0)
+        code, body = http_json(port, "/admin/health")
+        assert (code, body["state"]) == (200, "healthy")
+
+        blocker = BlockingProcessor()
+        svc.engine.processor = blocker
+        client = inproc_factory.create_output("inproc://stall1")
+        client.send(b"wedge me")
+        try:
+            # watchdog_interval_s + watchdog_stall_seconds = 0.3 s; allow
+            # generous slack for CI scheduling, then confirm the flip was
+            # detected by the watchdog thread (not an on-demand evaluation)
+            assert wait_until(lambda: svc.health.state != "healthy", 5.0)
+
+            # deep health: non-200 naming the failed check
+            code, body = http_json(port, "/admin/health?deep=1")
+            assert code == 503
+            assert body["state"] in ("degraded", "unhealthy")
+            failing = [c["name"] for c in body["checks"]
+                       if c["status"] != "pass"]
+            assert failing == ["process_wedged"]
+
+            # the matching structured transition event is on /admin/events
+            code, events = http_json(port, "/admin/events")
+            assert code == 200
+            transitions = [e for e in events["events"]
+                           if e["kind"] == "health_transition"
+                           and e["check"] == "process_wedged"]
+            assert transitions and transitions[0]["from"] == "pass"
+            assert transitions[0]["to"] in ("degraded", "unhealthy")
+            assert transitions[0]["component_id"] == svc.settings.component_id
+
+            # /metrics: the Enum flipped and the heartbeat gauge is exported
+            metrics = http_text(port, "/metrics")
+            healthy_line = next(
+                line for line in metrics.splitlines()
+                if line.startswith("engine_health_state")
+                and 'engine_health_state="healthy"' in line
+                and svc.settings.component_id in line)
+            assert healthy_line.rstrip().endswith(" 0.0")
+            assert 'engine_heartbeat_age_seconds{' in metrics
+            assert 'loop="engine_loop"' in metrics
+        finally:
+            blocker.release.set()
+
+        # recovery: hysteresis holds the state briefly, then it clears
+        assert wait_until(lambda: svc.health.state == "healthy", 5.0)
+        code, body = http_json(port, "/admin/health?deep=1")
+        assert (code, body["state"]) == (200, "healthy")
+
+    def test_shallow_health_stays_200_while_degraded(self, run_service,
+                                                     inproc_factory):
+        """Liveness semantics: an orchestrator must not restart a stage
+        that is merely degraded — only unhealthy returns non-200 shallow."""
+        svc = Service(fast_watchdog_settings("inproc://stall2",
+                                             component_name="stall-shallow"),
+                      socket_factory=inproc_factory)
+        run_service(svc)
+        port = svc.web_server.port
+        assert wait_until(lambda: svc.engine.running, 5.0)
+        blocker = BlockingProcessor()
+        svc.engine.processor = blocker
+        inproc_factory.create_output("inproc://stall2").send(b"x")
+        try:
+            assert wait_until(lambda: svc.health.state == "degraded", 5.0)
+            code, body = http_json(port, "/admin/health")
+            assert (code, body["state"]) == (200, "degraded")
+            assert wait_until(lambda: svc.health.state == "unhealthy", 5.0)
+            code, body = http_json(port, "/admin/health")
+            assert (code, body["state"]) == (503, "unhealthy")
+        finally:
+            blocker.release.set()
+
+
+class _StaticCheck:
+    def __init__(self, name, status, detail="injected"):
+        self.name = name
+        self._status = status
+        self._detail = detail
+
+    def evaluate(self, now):
+        return self._status, self._detail
+
+
+class TestAdminEdgeCases:
+    """Satellite: admin endpoint edge cases."""
+
+    @pytest.fixture()
+    def service(self, run_service, inproc_factory):
+        svc = Service(
+            ServiceSettings(component_type="core", component_name="edges",
+                            engine_addr="inproc://edges", http_port=0,
+                            log_to_file=False, log_to_console=False,
+                            engine_trace=True, watchdog_enabled=False),
+            socket_factory=inproc_factory)
+        return run_service(svc)
+
+    def test_trace_with_empty_flight_recorder(self, service):
+        code, body = http_json(service.web_server.port, "/admin/trace")
+        assert code == 200
+        assert body["completed"] == 0
+        assert body["slowest"] == [] and body["sampled"] == []
+        assert body["tracing_enabled"] is True
+        code, doc = http_json(service.web_server.port,
+                              "/admin/trace?format=chrome")
+        assert code == 200 and doc["traceEvents"] == []
+
+    def test_unknown_admin_paths_404(self, service):
+        port = service.web_server.port
+        assert http_json(port, "/admin/nonsense")[0] == 404
+        assert http_json(port, "/admin/nonsense", method="POST")[0] == 404
+        assert http_json(port, "/admin/health/extra")[0] == 404
+
+    def test_events_limit_validation(self, service):
+        port = service.web_server.port
+        service.events.emit({"kind": "log", "message": "a"})
+        service.events.emit({"kind": "log", "message": "b"})
+        code, body = http_json(port, "/admin/events?limit=1")
+        assert code == 200 and len(body["events"]) == 1
+        assert http_json(port, "/admin/events?limit=bogus")[0] == 400
+
+    def test_deep_health_codes_across_injected_failures(self, service):
+        port = service.web_server.port
+        code, body = http_json(port, "/admin/health?deep=1")
+        assert (code, body["state"]) == (200, "healthy")
+
+        service.health.add_check(_StaticCheck("injected_soft", "degraded"))
+        code, body = http_json(port, "/admin/health?deep=1")
+        assert (code, body["state"]) == (503, "degraded")
+        assert ["injected_soft"] == [c["name"] for c in body["checks"]
+                                     if c["status"] != "pass"]
+
+        service.health.add_check(_StaticCheck("injected_hard", "unhealthy"))
+        code, body = http_json(port, "/admin/health?deep=1")
+        assert (code, body["state"]) == (503, "unhealthy")
+        failing = {c["name"]: c["status"] for c in body["checks"]
+                   if c["status"] != "pass"}
+        assert failing == {"injected_soft": "degraded",
+                           "injected_hard": "unhealthy"}
+
+        service.health.remove_check("injected_hard")
+        service.health.remove_check("injected_soft")
+        code, body = http_json(port, "/admin/health?deep=1")
+        assert (code, body["state"]) == (200, "healthy")
+
+    def test_status_report_carries_health_state(self, service):
+        code, body = http_json(service.web_server.port, "/admin/status")
+        assert code == 200
+        assert body["status"]["health"] == "healthy"
+
+    def test_build_info_exported(self, service):
+        metrics = http_text(service.web_server.port, "/metrics")
+        from detectmateservice_tpu.metadata import VERSION
+
+        line = next(l for l in metrics.splitlines()
+                    if l.startswith("dm_build_info{"))
+        assert f'version="{VERSION}"' in line
+        assert "dm_feature_version=" in line
+        assert "dmt_feature_version=" in line
+
+
+class TestClientHealthRollup:
+    """Satellite: ``client.py health`` fans out across stages, prints the
+    roll-up table, and exits non-zero on degradation."""
+
+    def _two_stage_pipeline(self, run_service, inproc_factory, tmp_path,
+                            prefix):
+        healthy = Service(
+            ServiceSettings(component_type="core", component_name=f"{prefix}-ok",
+                            engine_addr=f"inproc://{prefix}ok", http_port=0,
+                            log_to_file=False, log_to_console=False,
+                            watchdog_enabled=False),
+            socket_factory=inproc_factory)
+        other = Service(
+            ServiceSettings(component_type="core", component_name=f"{prefix}-b",
+                            engine_addr=f"inproc://{prefix}b", http_port=0,
+                            log_to_file=False, log_to_console=False,
+                            watchdog_enabled=False),
+            socket_factory=inproc_factory)
+        run_service(healthy)
+        run_service(other)
+        pipeline = tmp_path / "pipeline.yaml"
+        pipeline.write_text(
+            "stages:\n"
+            f"  ok: http://127.0.0.1:{healthy.web_server.port}\n"
+            f"  other: http://127.0.0.1:{other.web_server.port}\n")
+        return healthy, other, pipeline
+
+    def test_all_healthy_exits_zero(self, run_service, inproc_factory,
+                                    tmp_path, capsys):
+        from detectmateservice_tpu.client import main as client_main
+
+        _, _, pipeline = self._two_stage_pipeline(
+            run_service, inproc_factory, tmp_path, "chr0")
+        rc = client_main(["health", str(pipeline)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ok" in out and "other" in out and "healthy" in out
+
+    def test_degraded_stage_exits_nonzero_and_is_named(
+            self, run_service, inproc_factory, tmp_path, capsys):
+        from detectmateservice_tpu.client import main as client_main
+
+        _, other, pipeline = self._two_stage_pipeline(
+            run_service, inproc_factory, tmp_path, "chr1")
+        other.health.add_check(_StaticCheck("injected_fault", "degraded"))
+        rc = client_main(["health", "--deep", str(pipeline)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "degraded" in out
+        assert "injected_fault" in out
+
+    def test_unreachable_stage_exits_nonzero(self, tmp_path, capsys,
+                                             free_port):
+        from detectmateservice_tpu.client import main as client_main
+
+        pipeline = tmp_path / "pipeline.yaml"
+        pipeline.write_text(
+            f"stages:\n  dead: http://127.0.0.1:{free_port}\n")
+        rc = client_main(["health", str(pipeline)])
+        assert rc == 1
+        assert "unreachable" in capsys.readouterr().out
+
+    def test_settings_yaml_target_resolution(self, tmp_path):
+        from detectmateservice_tpu.client import resolve_stages
+
+        settings_yaml = tmp_path / "parser_settings.yaml"
+        settings_yaml.write_text(
+            "component_type: core\ncomponent_name: parser\n"
+            "http_host: 127.0.0.1\nhttp_port: 18111\n")
+        stages = resolve_stages("http://fallback", [str(settings_yaml),
+                                                    "http://127.0.0.1:9"])
+        assert stages == [("parser", "http://127.0.0.1:18111"),
+                          ("http://127.0.0.1:9", "http://127.0.0.1:9")]
+        assert resolve_stages("http://fallback", []) == [
+            ("service", "http://fallback")]

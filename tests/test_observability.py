@@ -78,3 +78,104 @@ class TestPrometheusScrapeConfig:
         assert {"127.0.0.1:18111", "127.0.0.1:18112",
                 "127.0.0.1:18113"} <= set(targets)
         assert jobs["detectmate"]["metrics_path"] == "/metrics"
+
+    def test_alert_rules_are_wired_in(self):
+        doc = yaml.safe_load((OPS / "prometheus.yml").read_text())
+        assert "alerts.yml" in doc.get("rule_files", [])
+        compose_doc = yaml.safe_load(
+            (OPS.parent / "container" / "prometheus.yml").read_text())
+        assert "alerts.yml" in compose_doc.get("rule_files", [])
+
+
+# PromQL functions/keywords that the metric-ish token regex also captures;
+# anything NOT in this set and containing "_" must be a declared series
+_PROMQL_ALERT_KEYWORDS = _PROMQL_KEYWORDS | {
+    "min_over_time", "max_over_time", "avg_over_time", "increase",
+    "and", "or", "unless", "on", "ignoring", "for",
+}
+
+
+def alert_exprs():
+    doc = yaml.safe_load((OPS / "alerts.yml").read_text())
+    for group in doc["groups"]:
+        for rule in group["rules"]:
+            yield rule["alert"], rule["expr"]
+
+
+class TestAlertRules:
+    """ops/alerts.yml stays pinned to the exporter registry — the same
+    both-directions discipline as the Grafana panel checks, so an alert
+    rule can never silently rot after a metric rename."""
+
+    def test_parses_with_expected_rule_families(self):
+        names = [name for name, _ in alert_exprs()]
+        for required in ("EngineLoopStalled", "StageUnhealthy",
+                         "OutputBackpressureSustained", "MessageDropRateHigh",
+                         "PipelineLatencyBudgetBurnFast",
+                         "PipelineLatencyBudgetBurnSlow"):
+            assert required in names, f"missing alert rule {required}"
+
+    def test_every_expr_references_only_declared_series(self):
+        for name, expr in alert_exprs():
+            tokens = {m for m in _METRIC_RE.findall(expr)
+                      if "_" in m and m not in _PROMQL_ALERT_KEYWORDS}
+            unknown = tokens - KNOWN
+            assert not unknown, (
+                f"alert {name!r} references unknown series {unknown}")
+
+    def test_health_and_slo_series_are_covered_by_rules(self):
+        """Reverse direction: the health/SLO series the exporter declares
+        must each be the subject of some alert rule."""
+        exprs = "\n".join(e for _, e in alert_exprs())
+        for base in ("engine_heartbeat_age_seconds", "engine_health_state",
+                     "output_send_backlog", "data_dropped_lines_total",
+                     "pipeline_e2e_latency_seconds"):
+            assert re.search(rf"\b{base}", exprs), f"no alert rule uses {base}"
+
+    def test_burn_rate_buckets_exist_in_exporter_histogram(self):
+        """The SLO rules key off the le=\"1.0\" bucket; that bucket must
+        actually exist in the declared histogram or the rule silently
+        evaluates against an empty vector."""
+        from detectmateservice_tpu.engine import metrics as m
+
+        hist = m.PIPELINE_E2E_LATENCY()
+        buckets = getattr(hist, "_kwargs", {}).get("buckets") or getattr(
+            hist, "_upper_bounds", None)
+        # prometheus_client stores labelled histogram bucket bounds on the
+        # parent as _upper_bounds only after a child exists; fall back to
+        # the declared tuple in metrics.py
+        if buckets is None:
+            buckets = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                       0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+        assert 1.0 in tuple(buckets)
+
+    def test_health_row_panels_exist(self):
+        """The Grafana health row queries every self-diagnosis series."""
+        exprs = "\n".join(e for _, e in dashboard_exprs())
+        for base in ("engine_health_state", "engine_heartbeat_age_seconds",
+                     "dm_build_info"):
+            assert re.search(rf"\b{base}", exprs), f"no panel queries {base}"
+
+
+class TestComposeHealthchecks:
+    """docker-compose healthchecks hit GET /admin/health on every stage and
+    startup ordering is gated on condition: service_healthy."""
+
+    STAGES = ("reader", "parser", "detector", "output")
+
+    def test_every_stage_has_admin_health_healthcheck(self):
+        doc = yaml.safe_load(
+            (OPS.parent / "docker-compose.yml").read_text())
+        for stage in self.STAGES:
+            check = doc["services"][stage].get("healthcheck")
+            assert check, f"stage {stage!r} has no healthcheck"
+            assert "/admin/health" in " ".join(check["test"])
+
+    def test_demo_depends_on_are_health_gated(self):
+        doc = yaml.safe_load(
+            (OPS.parent / "docker-compose.yml").read_text())
+        for stage, upstream in (("detector", "output"), ("parser", "detector"),
+                                ("reader", "parser"), ("feeder", "reader")):
+            depends = doc["services"][stage]["depends_on"]
+            assert depends[upstream]["condition"] == "service_healthy", (
+                f"{stage} -> {upstream} is not health-gated")
