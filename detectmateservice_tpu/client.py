@@ -117,6 +117,9 @@ def resolve_stages(default_url: str, targets: List[str]) -> List[Tuple[str, str]
         if not isinstance(doc, dict):
             raise ValueError(f"{target}: expected a YAML mapping")
         if isinstance(doc.get("stages"), dict):
+            if not doc["stages"]:
+                raise ValueError(f"{target}: 'stages:' mapping is empty — "
+                                 "expected name: url entries")
             for name, url in doc["stages"].items():
                 stages.append((str(name), str(url)))
             continue
@@ -147,8 +150,8 @@ def health_rollup(default_url: str, targets: List[str],
         if state != "healthy":
             exit_code = 1
         rows.append((name, state, url, failing))
-    name_w = max(5, *(len(r[0]) for r in rows))
-    state_w = max(5, *(len(r[1]) for r in rows))
+    name_w = max([5, *(len(r[0]) for r in rows)])
+    state_w = max([5, *(len(r[1]) for r in rows)])
     print(f"{'STAGE':<{name_w}}  {'STATE':<{state_w}}  URL / failing checks")
     for name, state, url, failing in rows:
         summary = ", ".join(c.get("name", "?") for c in failing)
@@ -216,6 +219,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 0
         else:
             result = getattr(client, args.command)()
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
     except (urllib.error.URLError, OSError) as exc:
         print(f"request failed: {exc}", file=sys.stderr)
         return 1

@@ -231,7 +231,13 @@ class InflightStuckCheck:
             self._last_progress = progress
             self._stuck_since = now
             return PASS, f"{pending} in flight, draining"
-        stuck = now - (self._stuck_since if self._stuck_since is not None else now)
+        # pending > 0, progress frozen. Re-arm the stuck clock if the idle
+        # branch cleared it — otherwise a queue that wedges on the first
+        # batch after an idle watchdog tick would never accumulate stuck
+        # time and never be reported.
+        if self._stuck_since is None:
+            self._stuck_since = now
+        stuck = now - self._stuck_since
         if stuck >= self._unhealthy_s:
             return UNHEALTHY, (f"{pending} in flight, no drain progress "
                                f"for {stuck:.1f}s")
@@ -296,6 +302,15 @@ class HealthMonitor:
         self._state_metric.state(HEALTHY)
 
     # -- registration ----------------------------------------------------
+    def _export_heartbeat(self, hb: Heartbeat) -> None:
+        """Export ``engine_heartbeat_age_seconds{loop=...}`` computed AT
+        SCRAPE TIME (``set_function``), not copied on watchdog evaluations —
+        so the gauge stays truthful, and ``EngineLoopStalled`` in
+        ops/alerts.yml keeps firing, even when the watchdog thread itself is
+        dead or wedged. A process too hung to serve the scrape at all is the
+        alert layer's ``up == 0`` rule."""
+        m.HEARTBEAT_AGE().labels(loop=hb.name, **self._labels).set_function(hb.age)
+
     def register_heartbeat(self, name: str) -> Heartbeat:
         """Create (or return) a named heartbeat exported as an
         ``engine_heartbeat_age_seconds{loop=name}`` gauge. No check is
@@ -305,6 +320,7 @@ class HealthMonitor:
             if hb is None:
                 hb = Heartbeat(name)
                 self._heartbeats[name] = hb
+                self._export_heartbeat(hb)
             return hb
 
     def register_engine(self, hb_loop: Heartbeat, hb_ingest: Heartbeat,
@@ -315,6 +331,7 @@ class HealthMonitor:
         with self._lock:
             for hb in (hb_loop, hb_ingest, hb_output):
                 self._heartbeats[hb.name] = hb
+                self._export_heartbeat(hb)
             self._checks.append(ProcessWedgedCheck(
                 hb_loop, hb_output, active_fn, self._stall_s, self._unhealthy_s))
             self._checks.append(IngestStalledCheck(
@@ -379,11 +396,10 @@ class HealthMonitor:
                                          or "all checks passing"))
                 self._state = state
             self._state_metric.state(state)
-            ages = {}
-            for name, hb in self._heartbeats.items():
-                age = hb.age(now)
-                ages[name] = round(age, 3)
-                m.HEARTBEAT_AGE().labels(loop=name, **self._labels).set(age)
+            # ages here are for the report only — the exported gauge is
+            # bound to hb.age via set_function and refreshes at scrape time
+            ages = {name: round(hb.age(now), 3)
+                    for name, hb in self._heartbeats.items()}
             report = {
                 "state": state,
                 "stage": self._stage,

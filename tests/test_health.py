@@ -139,6 +139,23 @@ class TestWatchdogChecks:
         probe["pending"] = 0
         assert monitor.evaluate()["state"] == "healthy"
 
+    def test_inflight_stuck_rearms_after_idle_tick(self):
+        """Regression: the idle branch clears the stuck clock, so a queue
+        that wedges on the FIRST batch after an idle watchdog tick must
+        re-arm it on the next stuck evaluation — previously stuck time
+        stayed pinned at 0 and the wedge was never reported."""
+        monitor = make_monitor()
+        probe = {"pending": 0, "progress": 7}
+        monitor.register_progress("device_inflight",
+                                  lambda: probe["pending"],
+                                  lambda: probe["progress"])
+        assert monitor.evaluate()["state"] == "healthy"  # idle watchdog tick
+        probe["pending"] = 2      # first batch arrives and wedges at once —
+        monitor.evaluate()        # progress never moves again
+        time.sleep(0.08)          # > stall_seconds
+        report = monitor.evaluate()
+        assert check_status(report, "device_inflight") == "degraded"
+
     def test_crashing_check_degrades_instead_of_killing_watchdog(self):
         monitor = make_monitor()
 
@@ -177,6 +194,28 @@ class TestWatchdogChecks:
         assert wedged["component_id"] == LABELS["component_id"]
         # every event is JSON-serializable as-is (the /admin/events contract)
         json.dumps(events.snapshot())
+
+    def test_heartbeat_gauge_is_scrape_fresh_without_watchdog(self):
+        """The exported heartbeat age is computed at scrape time (a Gauge
+        set_function bound to the heartbeat), not copied on watchdog
+        evaluations — a dead or wedged watchdog thread cannot freeze it,
+        which ops/alerts.yml's EngineLoopStalled relies on."""
+        from prometheus_client import generate_latest
+
+        monitor, hb_loop, *_ = engine_monitor()
+
+        def scrape_age():
+            text = generate_latest().decode()
+            line = next(l for l in text.splitlines()
+                        if l.startswith("engine_heartbeat_age_seconds{")
+                        and 'loop="engine_loop"' in l
+                        and LABELS["component_id"] in l)
+            return float(line.rsplit(" ", 1)[1])
+
+        first = scrape_age()
+        time.sleep(0.05)
+        # no evaluate() ran between the scrapes, yet the age advanced
+        assert scrape_age() > first
 
     def test_watchdog_thread_runs_and_stops(self):
         monitor, hb_loop, *_ = engine_monitor()
@@ -553,6 +592,17 @@ class TestClientHealthRollup:
         rc = client_main(["health", str(pipeline)])
         assert rc == 1
         assert "unreachable" in capsys.readouterr().out
+
+    def test_empty_stages_mapping_is_a_clear_error(self, tmp_path, capsys):
+        """A pipeline YAML whose 'stages:' mapping is empty must produce a
+        usable error (exit 2), not a TypeError from the table formatter."""
+        from detectmateservice_tpu.client import main as client_main
+
+        pipeline = tmp_path / "pipeline.yaml"
+        pipeline.write_text("stages: {}\n")
+        rc = client_main(["health", str(pipeline)])
+        assert rc == 2
+        assert "stages" in capsys.readouterr().err
 
     def test_settings_yaml_target_resolution(self, tmp_path):
         from detectmateservice_tpu.client import resolve_stages

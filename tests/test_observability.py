@@ -109,7 +109,7 @@ class TestAlertRules:
 
     def test_parses_with_expected_rule_families(self):
         names = [name for name, _ in alert_exprs()]
-        for required in ("EngineLoopStalled", "StageUnhealthy",
+        for required in ("StageScrapeDown", "EngineLoopStalled", "StageUnhealthy",
                          "OutputBackpressureSustained", "MessageDropRateHigh",
                          "PipelineLatencyBudgetBurnFast",
                          "PipelineLatencyBudgetBurnSlow"):
@@ -158,18 +158,20 @@ class TestAlertRules:
 
 
 class TestComposeHealthchecks:
-    """docker-compose healthchecks hit GET /admin/health on every stage and
-    startup ordering is gated on condition: service_healthy."""
+    """docker-compose healthchecks hit GET /admin/health?deep=1 on every
+    stage (fresh per-check evaluation, non-200 on anything short of healthy
+    — works even with the background watchdog disabled) and startup
+    ordering is gated on condition: service_healthy."""
 
     STAGES = ("reader", "parser", "detector", "output")
 
-    def test_every_stage_has_admin_health_healthcheck(self):
+    def test_every_stage_has_deep_admin_health_healthcheck(self):
         doc = yaml.safe_load(
             (OPS.parent / "docker-compose.yml").read_text())
         for stage in self.STAGES:
             check = doc["services"][stage].get("healthcheck")
             assert check, f"stage {stage!r} has no healthcheck"
-            assert "/admin/health" in " ".join(check["test"])
+            assert "/admin/health?deep=1" in " ".join(check["test"])
 
     def test_demo_depends_on_are_health_gated(self):
         doc = yaml.safe_load(
