@@ -1,0 +1,34 @@
+"""dmlint: the codebase-aware static analysis package behind `detectmate-lint`.
+
+The generic pre-commit suite (mypy/flake8/bandit) cannot see this tree's
+actual failure modes: a 20+-lock multi-threaded data plane whose correctness
+rests on lock discipline, hot loops whose budget is nanoseconds, and
+cross-artifact contracts (metrics registry ↔ alert rules ↔ dashboard ↔ docs)
+that live outside any one Python file. This package carries the analyzers
+that do understand them, stdlib-first so the suite runs in the no-network
+sandbox where the mirrored wheel hooks cannot install:
+
+* :mod:`basic`     — DM-B: the portable AST hygiene rules (the old
+  ``scripts/static_check.py`` gate) plus YAML well-formedness,
+* :mod:`locks`     — DM-L: guarded-by inference from ``with self._lock:``
+  regions, unguarded shared-attribute access, blocking calls under a lock,
+  and the lock-acquisition-order cycle graph,
+* :mod:`hotloop`   — DM-H: purity rules for ``# dmlint: hot-loop``-marked
+  loops (no per-iteration metric construction, INFO logging, regex
+  compilation, or blocking sleeps),
+* :mod:`contracts` — DM-C: REGISTERED_SERIES ↔ ops/alerts.yml ↔
+  ops/grafana_dashboard.json ↔ docs/prometheus.md, and ServiceSettings ↔
+  docs/configuration.md ↔ examples/*settings*.yaml,
+* :mod:`markers`   — DM-T: every ``@pytest.mark.<m>`` used in tests/ must be
+  registered in pyproject.toml,
+* :mod:`cli`       — the ``detectmate-lint`` entry point that runs them all,
+  applies inline pragmas and the checked-in baseline
+  (``dmlint-baseline.json``), and gates CI on the result.
+
+Rule catalog, pragma syntax, and the baseline workflow: docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+from .findings import Finding, PragmaIndex, load_baseline
+
+__all__ = ["Finding", "PragmaIndex", "load_baseline"]

@@ -1,0 +1,94 @@
+"""DM-B: portable AST hygiene rules (the old ``scripts/static_check.py``
+gate, re-homed) plus YAML well-formedness.
+
+Rules:
+  DM-B001  mutable default argument (list/dict/set literal)
+  DM-B002  bare ``except:`` (masks KeyboardInterrupt/SystemExit)
+  DM-B003  ``== None`` / ``!= None`` (use ``is``)
+  DM-B004  tab character in indentation
+  DM-B005  syntax error (the file cannot even parse)
+  DM-B006  committed YAML artifact does not parse (soft-skipped when PyYAML
+           is absent — the only non-stdlib dependency in the suite, and a
+           declared runtime dep of the package itself)
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from .findings import Finding
+
+
+def check_source(rel: str, source: str,
+                 tree: Optional[ast.AST] = None) -> List[Finding]:
+    """Run the DM-B AST rules over one already-read source file."""
+    findings: List[Finding] = []
+    for lineno, line in enumerate(source.splitlines(), 1):
+        indent = line[: len(line) - len(line.lstrip())]
+        if "\t" in indent:
+            findings.append(Finding(
+                "DM-B004", rel, lineno, "tab in indentation",
+                hint="re-indent with spaces", key=f"L{lineno}"))
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                "DM-B005", rel, exc.lineno or 1,
+                f"syntax error: {exc.msg}", key="syntax"))
+            return findings
+    func = "<module>"
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for default in defaults:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(Finding(
+                        "DM-B001", rel, default.lineno,
+                        f"mutable default argument in {node.name}()",
+                        hint="default to None, create inside the function",
+                        key=node.name))
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(
+                "DM-B002", rel, node.lineno, "bare except:",
+                hint="name the exceptions (at least `except Exception:`)",
+                key=f"{func}:L{node.lineno}"))
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(comp, ast.Constant)
+                        and comp.value is None):
+                    findings.append(Finding(
+                        "DM-B003", rel, node.lineno,
+                        "comparison to None with ==/!=",
+                        hint="use `is None` / `is not None`",
+                        key=f"L{node.lineno}"))
+    return findings
+
+
+def check_yaml_artifacts(repo: Path) -> List[Finding]:
+    """DM-B006 over the committed YAML config artifacts."""
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover - yaml is a declared runtime dep
+        return []
+    findings: List[Finding] = []
+    patterns = ("examples/*.yaml", "ops/*.yml", "ops/*.yaml",
+                "container/*.yml", ".pre-commit-config.yaml",
+                ".github/workflows/*.yml", "docker-compose.yml")
+    for pattern in patterns:
+        for path in sorted(repo.glob(pattern)):
+            rel = path.relative_to(repo).as_posix()
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    yaml.safe_load(fh)
+            except yaml.YAMLError as exc:
+                mark = getattr(exc, "problem_mark", None)
+                line = (mark.line + 1) if mark is not None else 1
+                findings.append(Finding(
+                    "DM-B006", rel, line, f"invalid YAML: {exc}",
+                    key="yaml"))
+    return findings

@@ -1,0 +1,240 @@
+"""DM-C: cross-artifact contract checks.
+
+The pipeline's observable contract lives in five places that no single-file
+linter can hold together: the declared series registry
+(``engine/metrics.py REGISTERED_SERIES``), the alert rules
+(``ops/alerts.yml``), the Grafana dashboard (``ops/grafana_dashboard.json``),
+the metrics reference (``docs/prometheus.md``), and — for configuration —
+``settings.py ServiceSettings`` vs ``docs/configuration.md`` vs the example
+YAMLs. These rules hold them in sync, in both directions:
+
+  DM-C001  an alert rule or dashboard panel references a series the exporter
+           never declares (the rule/panel silently evaluates empty)
+  DM-C002  a declared series has no Grafana panel (it can rot invisibly)
+  DM-C003  a declared series is not documented in docs/prometheus.md
+  DM-C004  a health/SLO series has no alert rule covering it
+  DM-C005  a ServiceSettings field is not documented in docs/configuration.md
+  DM-C006  an example settings YAML uses a key ServiceSettings would reject
+           (``extra="forbid"`` makes this a startup crash for whoever copies
+           the example)
+
+Everything is parsed statically — the series registry and the settings
+fields are read from the AST, not by importing the package — so the checker
+runs in environments where jax/pydantic/prometheus_client are absent. YAML
+files are read with PyYAML when available (a declared runtime dep); without
+it the YAML-parsing subset (DM-C006 and rule traversal) degrades to the
+text-level checks.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+from .findings import Finding
+
+# Series that must each be the subject of an alert rule: the watchdog /
+# saturation / loss / SLO signals (the same set tests/test_observability.py
+# pins — kept in lockstep by that test importing THIS constant).
+ALERT_COVERED_SERIES = (
+    "engine_heartbeat_age_seconds",
+    "engine_health_state",
+    "output_send_backlog",
+    "data_dropped_lines_total",
+    "pipeline_e2e_latency_seconds",
+)
+
+_METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")
+_PROMQL_KEYWORDS = {
+    "rate", "irate", "sum", "by", "le", "histogram_quantile", "label_values",
+    "component_type", "component_id", "device", "max", "min", "avg",
+    "min_over_time", "max_over_time", "avg_over_time", "increase",
+    "and", "or", "unless", "on", "ignoring", "for", "job", "instance",
+    "engine_health_state",  # appears as a label of its own Enum series too
+}
+# Prometheus's own synthetic per-target series — never declared by exporters
+_SYNTHETIC_SERIES = {"up"}
+
+
+def declared_series(metrics_path: Path) -> Dict[str, int]:
+    """Parse ``engine/metrics.py`` for ``_series(<cls>, "<name>", ...)``
+    declarations → {series name: line}. AST-only: no package import."""
+    tree = ast.parse(metrics_path.read_text(encoding="utf-8"))
+    series: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else getattr(func, "attr", "")
+        if name != "_series" or len(node.args) < 2:
+            continue
+        arg = node.args[1]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            series[arg.value] = node.lineno
+    return series
+
+
+def settings_fields(settings_path: Path) -> Dict[str, int]:
+    """Parse ``settings.py`` for ``ServiceSettings`` annotated fields →
+    {field: line}. Private names and ``model_config`` are skipped."""
+    tree = ast.parse(settings_path.read_text(encoding="utf-8"))
+    fields: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == "ServiceSettings"):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                if not name.startswith("_") and name != "model_config":
+                    fields[name] = stmt.lineno
+    return fields
+
+
+def _known_tokens(series: Set[str]) -> Set[str]:
+    derived = set()
+    for name in series:
+        derived.update({f"{name}_bucket", f"{name}_count", f"{name}_sum"})
+    return series | derived | _SYNTHETIC_SERIES
+
+
+def _metric_tokens(expr: str) -> Set[str]:
+    return {token for token in _METRIC_TOKEN_RE.findall(expr)
+            if "_" in token and token not in _PROMQL_KEYWORDS}
+
+
+def _grafana_exprs(dashboard_path: Path) -> List[tuple]:
+    doc = json.loads(dashboard_path.read_text(encoding="utf-8"))
+    exprs = []
+    for panel in doc.get("panels", []):
+        for target in panel.get("targets", []):
+            if "expr" in target:
+                exprs.append((panel.get("title", "?"), target["expr"]))
+    return exprs
+
+
+def _alert_exprs(alerts_path: Path) -> List[tuple]:
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover - declared runtime dep
+        return []
+    doc = yaml.safe_load(alerts_path.read_text(encoding="utf-8"))
+    exprs = []
+    for group in (doc or {}).get("groups", []):
+        for rule in group.get("rules", []):
+            if "expr" in rule:
+                exprs.append((rule.get("alert", "?"), str(rule["expr"])))
+    return exprs
+
+
+def check_metrics_contract(repo: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    metrics_py = repo / "detectmateservice_tpu" / "engine" / "metrics.py"
+    dashboard = repo / "ops" / "grafana_dashboard.json"
+    alerts = repo / "ops" / "alerts.yml"
+    prom_doc = repo / "docs" / "prometheus.md"
+    if not metrics_py.exists():
+        return findings
+    series = declared_series(metrics_py)
+    known = _known_tokens(set(series))
+
+    # DM-C001: panels/rules may only reference declared series
+    if dashboard.exists():
+        for title, expr in _grafana_exprs(dashboard):
+            for token in sorted(_metric_tokens(expr) - known):
+                findings.append(Finding(
+                    "DM-C001", "ops/grafana_dashboard.json", 1,
+                    f"panel {title!r} queries undeclared series {token!r}",
+                    hint="declare it in engine/metrics.py or fix the panel",
+                    key=f"grafana:{title}:{token}"))
+    if alerts.exists():
+        for name, expr in _alert_exprs(alerts):
+            for token in sorted(_metric_tokens(expr) - known):
+                findings.append(Finding(
+                    "DM-C001", "ops/alerts.yml", 1,
+                    f"alert {name!r} references undeclared series {token!r}",
+                    hint="declare it in engine/metrics.py or fix the rule",
+                    key=f"alerts:{name}:{token}"))
+
+    # DM-C002 / DM-C003: every declared series is visible on the dashboard
+    # and documented in the metrics reference
+    dashboard_text = dashboard.read_text(encoding="utf-8") if dashboard.exists() else ""
+    doc_text = prom_doc.read_text(encoding="utf-8") if prom_doc.exists() else ""
+    for name, line in sorted(series.items()):
+        if dashboard_text and not re.search(rf"\b{re.escape(name)}", dashboard_text):
+            findings.append(Finding(
+                "DM-C002", "detectmateservice_tpu/engine/metrics.py", line,
+                f"declared series {name!r} has no Grafana panel",
+                hint="add a panel target to ops/grafana_dashboard.json "
+                     "(or baseline with the reason it stays dashboard-less)",
+                key=f"panel:{name}"))
+        if doc_text and not re.search(rf"\b{re.escape(name)}", doc_text):
+            findings.append(Finding(
+                "DM-C003", "detectmateservice_tpu/engine/metrics.py", line,
+                f"declared series {name!r} is not documented in docs/prometheus.md",
+                hint="add it to the metrics reference table",
+                key=f"doc:{name}"))
+
+    # DM-C004: the health/SLO series must each have an alert rule
+    if alerts.exists():
+        alert_text = "\n".join(expr for _, expr in _alert_exprs(alerts))
+        if not alert_text:  # PyYAML missing: fall back to raw text
+            alert_text = alerts.read_text(encoding="utf-8")
+        for name in ALERT_COVERED_SERIES:
+            if name not in series:
+                continue  # a renamed series surfaces via the registry diff
+            if not re.search(rf"\b{re.escape(name)}", alert_text):
+                findings.append(Finding(
+                    "DM-C004", "ops/alerts.yml", 1,
+                    f"health/SLO series {name!r} is not covered by any alert rule",
+                    hint="add a rule (see docs/prometheus.md alert families)",
+                    key=f"coverage:{name}"))
+    return findings
+
+
+def check_settings_contract(repo: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    settings_py = repo / "detectmateservice_tpu" / "settings.py"
+    config_doc = repo / "docs" / "configuration.md"
+    if not settings_py.exists():
+        return findings
+    fields = settings_fields(settings_py)
+
+    # DM-C005: every field is documented
+    doc_text = config_doc.read_text(encoding="utf-8") if config_doc.exists() else ""
+    for name, line in sorted(fields.items()):
+        if doc_text and not re.search(rf"\b{re.escape(name)}\b", doc_text):
+            findings.append(Finding(
+                "DM-C005", "detectmateservice_tpu/settings.py", line,
+                f"settings field {name!r} is not documented in "
+                "docs/configuration.md",
+                hint="add a row to the settings table",
+                key=f"setting-doc:{name}"))
+
+    # DM-C006: example settings YAMLs only use accepted keys
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover - declared runtime dep
+        return findings
+    for path in sorted((repo / "examples").glob("*settings*.yaml")):
+        rel = path.relative_to(repo).as_posix()
+        try:
+            doc = yaml.safe_load(path.read_text(encoding="utf-8"))
+        except yaml.YAMLError:
+            continue  # DM-B006 owns malformed YAML
+        if not isinstance(doc, dict):
+            continue
+        for key in doc:
+            if key not in fields:
+                findings.append(Finding(
+                    "DM-C006", rel, 1,
+                    f"settings key {key!r} is not a ServiceSettings field "
+                    "(extra='forbid' rejects it at startup)",
+                    hint="fix the example (or add the field to settings.py)",
+                    key=f"example:{key}"))
+    return findings
+
+
+def check_all(repo: Path) -> List[Finding]:
+    return check_metrics_contract(repo) + check_settings_contract(repo)

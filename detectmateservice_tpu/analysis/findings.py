@@ -1,0 +1,179 @@
+"""Finding model, dmlint pragmas, and the suppression baseline.
+
+A finding is stable across unrelated edits: its identity (``fingerprint``)
+is built from the rule id, the repo-relative file, and a semantic context
+key chosen by the analyzer (``Class.attr``, a function name, a series
+name) — never a line number, so inserting a docstring two hundred lines up
+does not invalidate the whole baseline.
+
+Pragmas (one comment grammar for all analyzers):
+
+* ``# dmlint: ignore[rule-a,rule-b] <justification>`` — suppress those
+  rules on the statement that starts on this line (or the line above, for
+  statements too long to share a line with the pragma). The justification
+  text is required: a bare ignore is itself reported (DM-X001).
+* ``# dmlint: guarded-by(<lock_attr>)`` — declare, on an attribute
+  assignment, which lock the attribute is guarded by; the lock analyzer
+  treats the declaration exactly like an inferred guard.
+* ``# dmlint: hot-loop`` — mark the loop starting on this (or the next)
+  line for the hot-loop purity rules.
+
+Baseline (``dmlint-baseline.json`` at the repo root): a checked-in list of
+``{"fingerprint", "rule", "justification"}`` entries. Every entry MUST carry
+a non-empty justification (DM-X001) and must still match a live finding
+(DM-X002, so the baseline can only shrink as debt is paid down). The CLI's
+``--write-baseline`` emits entries for current findings with a ``TODO``
+justification that fails the gate until a human writes the reason.
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+BASELINE_NAME = "dmlint-baseline.json"
+
+_PRAGMA_RE = re.compile(r"#\s*dmlint:\s*(?P<body>.+?)\s*$")
+_IGNORE_RE = re.compile(r"ignore\[(?P<rules>[A-Za-z0-9_,\-\s]+)\]\s*(?P<why>.*)")
+_GUARDED_RE = re.compile(r"guarded-by\((?P<lock>[A-Za-z_][A-Za-z0-9_.]*)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit: ``file:line: rule message (hint)``."""
+
+    rule: str            # e.g. "DM-L001"
+    file: str            # repo-relative posix path
+    line: int
+    message: str
+    hint: str = ""       # one-line fix suggestion
+    key: str = ""        # semantic context key (fingerprint stability)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.file}:{self.key or self.line}"
+
+    def render(self) -> str:
+        text = f"{self.file}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            text += f" (fix: {self.hint})"
+        return text
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule, "file": self.file, "line": self.line,
+            "message": self.message, "hint": self.hint,
+            "fingerprint": self.fingerprint,
+        }
+
+
+@dataclass
+class PragmaIndex:
+    """Per-file index of dmlint pragmas, built from raw source lines."""
+
+    # line -> (rules-or-{"*"}, justification)
+    ignores: Dict[int, Tuple[Set[str], str]] = field(default_factory=dict)
+    guarded_by: Dict[int, str] = field(default_factory=dict)   # line -> lock name
+    hot_loops: Set[int] = field(default_factory=set)           # marker lines
+    bare_ignores: List[int] = field(default_factory=list)      # no justification
+
+    # an `ignore` pragma covers the line it sits on and the line below it
+    # (pragma-above style for statements that fill their own line)
+    def is_ignored(self, rule: str, line: int) -> bool:
+        for probe in (line, line - 1):
+            entry = self.ignores.get(probe)
+            if entry is not None and (rule in entry[0] or "*" in entry[0]):
+                return True
+        return False
+
+    def marks_hot_loop(self, line: int) -> bool:
+        return line in self.hot_loops or (line - 1) in self.hot_loops
+
+
+def scan_pragmas(source: str) -> PragmaIndex:
+    """Module-level convenience wrapper (keeps call sites terse)."""
+    index = PragmaIndex()
+    for lineno, line in enumerate(source.splitlines(), 1):
+        match = _PRAGMA_RE.search(line)
+        if match is None:
+            continue
+        body = match.group("body")
+        ignore = _IGNORE_RE.match(body)
+        if ignore is not None:
+            rules = {r.strip() for r in ignore.group("rules").split(",") if r.strip()}
+            why = ignore.group("why").strip().lstrip("-— ").strip()
+            if not why:
+                index.bare_ignores.append(lineno)
+            index.ignores[lineno] = (rules, why)
+            continue
+        guarded = _GUARDED_RE.match(body)
+        if guarded is not None:
+            index.guarded_by[lineno] = guarded.group("lock")
+            continue
+        if body.strip() == "hot-loop":
+            index.hot_loops.add(lineno)
+    return index
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+def load_baseline(path: Path) -> Tuple[Dict[str, str], List[Finding]]:
+    """Load the suppression baseline → ({fingerprint: justification}, meta
+    findings about the baseline itself: unparseable file, entries without a
+    justification)."""
+    meta: List[Finding] = []
+    if not path.exists():
+        return {}, meta
+    rel = path.name
+    try:
+        doc = json.loads(path.read_text(encoding="utf-8"))
+        entries = doc["suppressions"]
+    except (json.JSONDecodeError, KeyError, TypeError) as exc:
+        meta.append(Finding(
+            "DM-X000", rel, 1,
+            f"baseline file is unreadable: {exc}",
+            hint="restore valid JSON: {\"suppressions\": [...]}",
+            key="unreadable"))
+        return {}, meta
+    baseline: Dict[str, str] = {}
+    for i, entry in enumerate(entries):
+        fingerprint = str(entry.get("fingerprint", "")).strip()
+        why = str(entry.get("justification", "")).strip()
+        if not fingerprint:
+            meta.append(Finding(
+                "DM-X000", rel, 1,
+                f"suppression #{i} has no fingerprint", key=f"entry-{i}"))
+            continue
+        if not why or why.upper().startswith("TODO"):
+            meta.append(Finding(
+                "DM-X001", rel, 1,
+                f"suppression {fingerprint!r} has no justification",
+                hint="write one line explaining why the finding is acceptable",
+                key=fingerprint))
+            continue
+        baseline[fingerprint] = why
+    return baseline, meta
+
+
+def write_baseline(path: Path, findings: Iterable[Finding],
+                   keep: Optional[Dict[str, str]] = None) -> None:
+    """Write a baseline for ``findings``, preserving justifications from
+    ``keep`` (the previously loaded baseline); new entries get ``TODO``."""
+    keep = keep or {}
+    entries = []
+    seen: Set[str] = set()
+    for finding in sorted(findings, key=lambda f: (f.file, f.rule, f.key, f.line)):
+        fp = finding.fingerprint
+        if fp in seen:
+            continue
+        seen.add(fp)
+        entries.append({
+            "rule": finding.rule,
+            "fingerprint": fp,
+            "justification": keep.get(fp, "TODO: justify or fix"),
+        })
+    path.write_text(
+        json.dumps({"suppressions": entries}, indent=2) + "\n", encoding="utf-8")

@@ -1,0 +1,161 @@
+"""DM-H: purity rules for pragma-marked hot loops.
+
+The engine recv→process→send loop, the output pump, the watchdog tick, and
+the scorer dispatch workers run millions of iterations per hour; work that
+is invisible in review (a ``.labels()`` dict-hash, an f-string INFO line, a
+``re.compile``) becomes a steady-state tax there. Loops marked with
+``# dmlint: hot-loop`` (the comment on the loop's line or the line above)
+are held to:
+
+  DM-H001  no per-iteration metric-object construction — ``.labels(...)``
+           calls, registry-getter calls (``m.SERIES_NAME()``), or
+           Counter/Gauge/Histogram/Enum/Summary constructors. Hoist the
+           labeled child out of the loop.
+  DM-H002  no INFO-level (or lower) logging per iteration — ``.info(`` /
+           ``.debug(``; WARNING+ is allowed because it marks abnormal
+           iterations, not steady state.
+  DM-H003  no ``re.compile`` per iteration — compile at import time.
+  DM-H004  no unconditional blocking on the steady-state path —
+           ``time.sleep``, ``open()``, ``subprocess.*``, thread ``.join``.
+           Socket recv/send are NOT flagged: a bounded-timeout recv *is* the
+           loop's scheduler.
+
+``except`` handler bodies are skipped (error paths are cold by contract),
+and nested function definitions are skipped (they execute elsewhere).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .findings import Finding, PragmaIndex
+from .locks import _call_name, _looks_like_thread_join
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Enum", "Summary", "Info"}
+
+
+def _iter_hot_loops(tree: ast.AST, pragmas: PragmaIndex):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            if pragmas.marks_hot_loop(node.lineno):
+                yield node
+
+
+class _LoopWalker(ast.NodeVisitor):
+    def __init__(self, rel: str, loop_line: int, scope: str,
+                 pragmas: PragmaIndex) -> None:
+        self.rel = rel
+        self.loop_line = loop_line
+        self.scope = scope
+        self.pragmas = pragmas
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule: str, line: int, message: str, hint: str,
+              key: str) -> None:
+        if self.pragmas.is_ignored(rule, line):
+            return
+        self.findings.append(Finding(
+            rule, self.rel, line, message, hint=hint,
+            key=f"{self.scope}:{key}"))
+
+    # cold paths: error handlers and deferred (nested-function) bodies
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        return
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node.func)
+        parts = name.split(".")
+        tail = parts[-1]
+        if tail == "labels":
+            self._emit(
+                "DM-H001", node.lineno,
+                f"per-iteration metric child lookup {name}(...) in hot loop",
+                "hoist the labeled child out of the loop (cache it on self)",
+                f"labels:{name}")
+        elif tail in _METRIC_CTORS and len(parts) <= 2:
+            self._emit(
+                "DM-H001", node.lineno,
+                f"metric constructor {name}(...) in hot loop",
+                "create metrics once at import/setup time",
+                f"ctor:{name}")
+        elif (tail.isupper() and isinstance(node.func, ast.Attribute)
+                and not node.args and not node.keywords):
+            # registry-getter idiom: m.SERIES_NAME() — cheap-ish (a lock +
+            # dict hit) but still per-iteration work that belongs outside
+            self._emit(
+                "DM-H001", node.lineno,
+                f"per-iteration metric registry call {name}() in hot loop",
+                "resolve the series once before entering the loop",
+                f"registry:{name}")
+        elif tail in {"info", "debug"} and (
+                "log" in name.lower() or parts[0] in {"logging", "logger"}):
+            self._emit(
+                "DM-H002", node.lineno,
+                f"{tail.upper()}-level log call {name}(...) in hot loop",
+                "log WARNING+ only on the hot path (or move outside the loop)",
+                f"log:{name}")
+        elif name == "re.compile":
+            self._emit(
+                "DM-H003", node.lineno,
+                "re.compile in hot loop",
+                "compile the pattern at import time",
+                "re.compile")
+        elif tail == "sleep":
+            self._emit(
+                "DM-H004", node.lineno,
+                f"blocking {name}() on the hot-loop steady-state path",
+                "sleep only on cold/error paths, or pragma with the reason",
+                f"sleep:{name}")
+        elif parts[0] == "subprocess" or tail in {"Popen", "check_call",
+                                                  "check_output"}:
+            self._emit(
+                "DM-H004", node.lineno,
+                f"subprocess call {name}(...) in hot loop",
+                "never spawn processes per iteration",
+                f"subprocess:{name}")
+        elif name == "open" or (tail == "join" and isinstance(node.func, ast.Attribute)
+                                and _looks_like_thread_join(node)):
+            self._emit(
+                "DM-H004", node.lineno,
+                f"blocking {name}(...) in hot loop",
+                "move file/thread waits off the steady-state path",
+                f"block:{name}")
+        self.generic_visit(node)
+
+
+def check_module(rel: str, source: str,
+                 tree: Optional[ast.Module] = None,
+                 pragmas: Optional[PragmaIndex] = None) -> List[Finding]:
+    from .findings import scan_pragmas
+
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # DM-B005 owns unparseable files
+    if pragmas is None:
+        pragmas = scan_pragmas(source)
+    if not pragmas.hot_loops:
+        return []
+
+    # map loops to their enclosing function name for stable keys
+    scopes = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.For, ast.While, ast.AsyncFor)):
+                    scopes.setdefault(id(sub), node.name)
+
+    findings: List[Finding] = []
+    for loop in _iter_hot_loops(tree, pragmas):
+        scope = scopes.get(id(loop), "<module>")
+        walker = _LoopWalker(rel, loop.lineno, scope, pragmas)
+        for stmt in loop.body:
+            walker.visit(stmt)
+        findings.extend(walker.findings)
+    return findings

@@ -1,0 +1,606 @@
+"""DM-L: lock-discipline analysis for the multi-threaded data plane.
+
+What generic linters cannot see, this module infers from the AST:
+
+* **Guarded-by inference** — an attribute written inside ``with self._lock:``
+  (outside ``__init__``), accessed under the same lock from two or more
+  methods, or explicitly declared with ``# dmlint: guarded-by(_lock)`` on its
+  ``__init__`` assignment, is *guarded by* that lock.
+* **DM-L001 unguarded shared access** — any other read/write of a guarded
+  attribute outside the lock (and outside ``__init__``) is flagged: on this
+  codebase's thread topology (engine loop + output pump + watchdog + admin
+  HTTP threads + scorer workers) every public or thread-reachable method can
+  run concurrently with the guarded regions. Deliberate benign races carry
+  an ``ignore`` pragma with the justification inline.
+* **DM-L002 blocking call under a lock** — ``time.sleep``, socket
+  send/recv/accept/connect, ``Thread.join`` (heuristically separated from
+  ``str.join`` by its argument shape), ``Event/Condition.wait``,
+  ``subprocess.*``, and ``open`` while holding any lock stall every thread
+  that contends on it. Exemption: a ``with`` block whose entire body is the
+  single blocking statement is a *serializer* (the lock exists precisely to
+  serialize that call) and is not flagged.
+* **DM-L003 lock-order cycle** — acquiring lock B while holding lock A adds
+  the edge A→B to the module's acquisition-order graph (with one level of
+  intra-class/intra-module call expansion); a cycle in that graph is a
+  potential deadlock. Cross-module cycles are out of scope (none of this
+  tree's locks escape their module).
+
+Scope notes: classes that create no lock are skipped wholesale (the engine
+hot loop deliberately owns no locks — Events and GIL-atomic stores only).
+Module-level state participates when it is (a) a module lock used in
+``with`` statements or (b) a ``global``-declared name rebound in functions.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, PragmaIndex
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_SOCKETISH = {"recv", "recv_many", "recvfrom", "sendall", "sendto",
+              "accept", "connect", "makefile"}
+# container-mutator method names: `self.attr.append(x)` is a WRITE to the
+# shared state behind `attr` even though the attribute node itself is a Load
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "clear", "pop",
+             "popleft", "remove", "add", "discard", "update", "setdefault",
+             "insert"}
+
+
+def _call_name(func: ast.AST) -> str:
+    """Dotted best-effort name of a call target ('threading.Lock', 'x.join')."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_lock_ctor(call: ast.Call) -> bool:
+    name = _call_name(call.func)
+    tail = name.rsplit(".", 1)[-1]
+    return tail in LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+@dataclass
+class _Access:
+    attr: str
+    func: str           # method (or module function) name
+    line: int
+    is_write: bool
+    held: FrozenSet[str]
+
+
+@dataclass
+class _FuncFacts:
+    name: str
+    node: ast.AST
+    accesses: List[_Access] = field(default_factory=list)
+    # locks this function acquires anywhere (for call-expansion of DM-L003)
+    acquires: Set[str] = field(default_factory=set)
+    # (held-set, callee, line) — self.m()/m() calls made while holding locks
+    calls_held: List[Tuple[FrozenSet[str], str, int]] = field(default_factory=list)
+    # plain callee names (call-graph / init-only reachability)
+    callees: Set[str] = field(default_factory=set)
+    # (held-set, call node, line, serializer?) blocking-call candidates
+    blocking: List[Tuple[FrozenSet[str], str, int]] = field(default_factory=list)
+
+
+def _looks_like_thread_join(call: ast.Call) -> bool:
+    """Separate ``thread.join()`` from ``", ".join(seq)``: str.join takes
+    exactly one positional iterable; thread joins take zero args, a timeout
+    kwarg, or one numeric positional."""
+    if call.keywords:
+        return True
+    if not call.args:
+        return True
+    if len(call.args) == 1:
+        arg = call.args[0]
+        return isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float))
+    return False
+
+
+def _blocking_call(call: ast.Call) -> Optional[str]:
+    """Classify a call as blocking; returns a short label or None."""
+    name = _call_name(call.func)
+    parts = name.split(".")
+    tail = parts[-1]
+    if name == "open" or name.endswith(".open"):
+        return None  # open() is I/O but sub-ms; hot-loop rules own file I/O
+    if tail == "sleep":
+        return name or "sleep"
+    if parts[0] == "subprocess" or tail in {"Popen", "check_call", "check_output"}:
+        return name
+    if tail in _SOCKETISH or tail == "send":
+        return name
+    if tail == "wait":
+        return name
+    if tail == "join" and _looks_like_thread_join(call):
+        return name
+    return None
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Walk one function body tracking the held-lock stack."""
+
+    def __init__(self, facts: _FuncFacts, lock_names: Set[str],
+                 module_locks: Set[str], tracked_globals: Set[str]) -> None:
+        self.facts = facts
+        self.lock_names = lock_names          # self.<attr> lock attributes
+        self.module_locks = module_locks      # module-level lock Names
+        self.tracked_globals = tracked_globals
+        self.held: List[str] = []
+        self._single_body_depth = 0           # serializer-with nesting
+
+    # -- lock identity ---------------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.lock_names:
+            return f"self.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return expr.id
+        return None
+
+    # -- visitors --------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            lock = self._lock_of(item.context_expr)
+            if lock is not None:
+                acquired.append(lock)
+        serializer = bool(acquired) and len(node.body) == 1 and not self.held
+        for lock in acquired:
+            self.facts.acquires.add(lock)
+            self.held.append(lock)
+        if serializer:
+            self._single_body_depth += 1
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        if serializer:
+            self._single_body_depth -= 1
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested function: may run on another thread later, but its attribute
+        # accesses still need the guard — walk it with an EMPTY held stack
+        # (the closure does not inherit the creating frame's locks)
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # blocking candidates are recorded even with no lock held here: the
+        # enclosing method may inherit a lock from its only call sites
+        label = _blocking_call(node)
+        if label is not None and not self._single_body_depth:
+            self.facts.blocking.append(
+                (frozenset(self.held), label, node.lineno))
+        callee = None
+        attr = _self_attr(node.func)
+        if attr is not None:
+            callee = attr
+        elif isinstance(node.func, ast.Name):
+            callee = node.func.id
+        if callee is not None:
+            self.facts.callees.add(callee)
+            self.facts.calls_held.append(
+                (frozenset(self.held), callee, node.lineno))
+        # container mutation through the attribute: self.attr.append(...)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS):
+            target = _self_attr(node.func.value)
+            if target is not None:
+                self._record(target, node.lineno, is_write=True)
+        self.generic_visit(node)
+
+    def _record_subscript_writes(self, target: ast.AST, line: int) -> None:
+        # self.attr[k] = v / self.attr[k] += v: a write to attr's state
+        if isinstance(target, ast.Subscript):
+            attr = _self_attr(target.value)
+            if attr is not None:
+                self._record(attr, line, is_write=True)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for element in target.elts:
+                self._record_subscript_writes(element, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._record_subscript_writes(target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_subscript_writes(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._record_subscript_writes(target, node.lineno)
+        self.generic_visit(node)
+
+    def _record(self, attr: str, line: int, is_write: bool) -> None:
+        if attr in self.lock_names:
+            return
+        self.facts.accesses.append(_Access(
+            attr, self.facts.name, line, is_write, frozenset(self.held)))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(attr, node.lineno, isinstance(node.ctx, ast.Store))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.tracked_globals:
+            self.facts.accesses.append(_Access(
+                node.id, self.facts.name, node.lineno,
+                isinstance(node.ctx, ast.Store), frozenset(self.held)))
+
+
+def _collect_module_locks(tree: ast.Module) -> Set[str]:
+    locks: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_lock_ctor(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        locks.add(target.id)
+    return locks
+
+
+def _collect_global_decls(root: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Global):
+            names.update(node.names)
+    return names
+
+
+def _thread_targets(root: ast.AST) -> Set[str]:
+    """Names of methods/functions handed to ``Thread(target=...)``."""
+    targets: Set[str] = set()
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node.func).rsplit(".", 1)[-1] != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                attr = _self_attr(kw.value)
+                if attr is not None:
+                    targets.add(attr)
+                elif isinstance(kw.value, ast.Name):
+                    targets.add(kw.value.id)
+    return targets
+
+
+def _init_only_methods(funcs: Dict[str, _FuncFacts],
+                       thread_targets: Set[str]) -> Set[str]:
+    """Private helpers called only from ``__init__`` run before any other
+    thread can hold a reference to the object — construction-time methods
+    are exempt from DM-L001."""
+    callers: Dict[str, Set[str]] = {name: set() for name in funcs}
+    for facts in funcs.values():
+        for callee in facts.callees:
+            if callee in callers:
+                callers[callee].add(facts.name)
+    exempt: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, facts in funcs.items():
+            if name in exempt or name == "__init__" or name in thread_targets:
+                continue
+            if not name.startswith("_"):
+                continue
+            calls = callers[name]
+            if calls and all(c == "__init__" or c in exempt for c in calls):
+                exempt.add(name)
+                changed = True
+    exempt.add("__init__")
+    return exempt
+
+
+def _find_cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Simple DFS cycle enumeration over the lock-order graph (graphs here
+    have a handful of nodes; exponential corner cases cannot arise)."""
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str]) -> None:
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start:
+                cycle = path[:]
+                canon = tuple(sorted(cycle))
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(cycle)
+            elif nxt not in path and nxt > start:
+                # only explore nodes ordered after `start` so each cycle is
+                # discovered exactly once (from its smallest node)
+                dfs(start, nxt, path + [nxt])
+
+    for node in sorted(edges):
+        dfs(node, node, [node])
+    return cycles
+
+
+def _analyze_scope(rel: str, scope_name: str, funcs: Dict[str, _FuncFacts],
+                   pragma_guards: Dict[str, str], pragmas: PragmaIndex,
+                   thread_targets: Set[str],
+                   order_edges: Dict[str, Set[str]],
+                   edge_lines: Dict[Tuple[str, str], int]) -> List[Finding]:
+    findings: List[Finding] = []
+    exempt = _init_only_methods(funcs, thread_targets)
+
+    # -- held-lock inheritance ------------------------------------------
+    # A private method invoked ONLY while a lock is held effectively runs
+    # under that lock (evaluate() → _apply_hysteresis() in health.py). Fix
+    # point over the call graph: inherited(c) = ∩ over every call site of
+    # (site-held ∪ inherited(caller)). Public methods and thread targets
+    # never inherit — any thread may enter them bare.
+    inherited: Dict[str, FrozenSet[str]] = {}
+    for _ in range(len(funcs) + 1):
+        changed = False
+        for name, facts in funcs.items():
+            if (not name.startswith("_") or name in thread_targets
+                    or name == "__init__"):
+                continue
+            sites: List[FrozenSet[str]] = []
+            for caller in funcs.values():
+                for held, callee, _line in caller.calls_held:
+                    if callee == name:
+                        sites.append(held | inherited.get(caller.name,
+                                                          frozenset()))
+            if not sites:
+                continue
+            common = frozenset.intersection(*sites)
+            if common and inherited.get(name) != common:
+                inherited[name] = common
+                changed = True
+        if not changed:
+            break
+
+    def effective_held(access: _Access) -> FrozenSet[str]:
+        return access.held | inherited.get(access.func, frozenset())
+
+    # -- guarded-by inference -------------------------------------------
+    accesses: List[_Access] = [a for f in funcs.values() for a in f.accesses]
+    guard: Dict[str, str] = dict(pragma_guards)
+    by_attr: Dict[str, List[_Access]] = {}
+    for access in accesses:
+        by_attr.setdefault(access.attr, []).append(access)
+    for attr, acc in by_attr.items():
+        if attr in guard:
+            continue
+        # a guard is inferred from MUTATING accesses only: an attribute that
+        # is never written outside __init__ is an immutable binding, and a
+        # lock around reads of it serializes the underlying operation (a
+        # socket, a file) — not the attribute — so no guard relation exists
+        write_locks: Set[str] = set()
+        for a in acc:
+            if a.func == "__init__" or not a.is_write:
+                continue
+            write_locks.update(effective_held(a))
+        for lock in sorted(write_locks):
+            guard[attr] = lock
+            break
+
+    # -- DM-L001 ---------------------------------------------------------
+    # group unguarded accesses by (attr, method): one finding per pair, and
+    # a pragma on ANY of the pair's access lines suppresses the group (the
+    # documented access speaks for the method's other touches of the attr)
+    groups: Dict[Tuple[str, str], List[_Access]] = {}
+    for access in accesses:
+        lock = guard.get(access.attr)
+        if lock is None or lock in effective_held(access):
+            continue
+        if access.func in exempt:
+            continue
+        groups.setdefault((access.attr, access.func), []).append(access)
+    for (attr, func), group in sorted(groups.items()):
+        if any(pragmas.is_ignored("DM-L001", a.line) for a in group):
+            continue
+        first = min(group, key=lambda a: a.line)
+        lock = guard[attr]
+        what = "written" if first.is_write else "read"
+        findings.append(Finding(
+            "DM-L001", rel, first.line,
+            f"{scope_name}.{attr} is guarded by {lock} elsewhere but "
+            f"{what} without it in {func}()",
+            hint=f"acquire {lock}, or pragma the benign race with a reason",
+            key=f"{scope_name}.{attr}:{func}"))
+
+    # -- DM-L002 ---------------------------------------------------------
+    seen_blocking: Set[Tuple[str, str]] = set()
+    for facts in funcs.values():
+        inh = inherited.get(facts.name, frozenset())
+        for held, label, line in facts.blocking:
+            held = held | inh
+            if not held:
+                continue
+            if pragmas.is_ignored("DM-L002", line):
+                continue
+            dedupe = (facts.name, label)
+            if dedupe in seen_blocking:
+                continue
+            seen_blocking.add(dedupe)
+            locks = ", ".join(sorted(held))
+            findings.append(Finding(
+                "DM-L002", rel, line,
+                f"blocking call {label}() while holding {locks} in "
+                f"{facts.name}()",
+                hint="release the lock first (swap state under the lock, "
+                     "block outside it)",
+                key=f"{scope_name}.{facts.name}:{label}"))
+
+    # -- lock-order edges (direct + one call-expansion level) ------------
+    for facts in funcs.values():
+        walker_edges: List[Tuple[str, str, int]] = []
+        for held, callee, line in facts.calls_held:
+            target = funcs.get(callee)
+            if target is None:
+                continue
+            for acquired in target.acquires:
+                for holder in held:
+                    if holder != acquired:
+                        walker_edges.append((holder, acquired, line))
+        for holder, acquired, line in walker_edges:
+            order_edges.setdefault(holder, set()).add(acquired)
+            edge_lines.setdefault((holder, acquired), line)
+    return findings
+
+
+def check_module(rel: str, source: str,
+                 tree: Optional[ast.Module] = None,
+                 pragmas: Optional[PragmaIndex] = None) -> List[Finding]:
+    """Run the DM-L rules over one module; returns its findings."""
+    from .findings import scan_pragmas
+
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # DM-B005 owns unparseable files
+    if pragmas is None:
+        pragmas = scan_pragmas(source)
+
+    findings: List[Finding] = []
+    module_locks = _collect_module_locks(tree)
+    order_edges: Dict[str, Set[str]] = {}
+    edge_lines: Dict[Tuple[str, str], int] = {}
+
+    # -- module-level functions -----------------------------------------
+    tracked_globals = set()
+    module_funcs: Dict[str, _FuncFacts] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            tracked_globals |= _collect_global_decls(node)
+    if module_locks or tracked_globals:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                facts = _FuncFacts(node.name, node)
+                walker = _FuncWalker(facts, set(), module_locks, tracked_globals)
+                for stmt in node.body:
+                    walker.visit(stmt)
+                _record_direct_edges(stmt_root=node, lock_names=set(),
+                                     module_locks=module_locks,
+                                     order_edges=order_edges,
+                                     edge_lines=edge_lines)
+                module_funcs[node.name] = facts
+        findings.extend(_analyze_scope(
+            rel, "<module>", module_funcs, {}, pragmas,
+            _thread_targets(tree), order_edges, edge_lines))
+
+    # -- classes ---------------------------------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        lock_names: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                if _is_lock_ctor(sub.value):
+                    for target in sub.targets:
+                        attr = _self_attr(target)
+                        if attr is not None:
+                            lock_names.add(attr)
+        if not lock_names and not module_locks:
+            continue
+        if not lock_names:
+            # classes without their own locks may still use module locks for
+            # DM-L002/L003; attribute guard inference needs a class lock
+            pass
+        funcs: Dict[str, _FuncFacts] = {}
+        pragma_guards: Dict[str, str] = {}
+        for method in node.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            facts = _FuncFacts(method.name, method)
+            walker = _FuncWalker(facts, lock_names, module_locks, set())
+            for stmt in method.body:
+                walker.visit(stmt)
+            _record_direct_edges(method, lock_names, module_locks,
+                                 order_edges, edge_lines)
+            funcs[method.name] = facts
+            # guarded-by pragmas sit on __init__ attribute assignments
+            for stmt in ast.walk(method):
+                if isinstance(stmt, ast.Assign):
+                    for target in stmt.targets:
+                        attr = _self_attr(target)
+                        if attr is None:
+                            continue
+                        # the pragma sits on the assignment line or its own
+                        # line just above (same convention as `ignore`)
+                        lock = (pragmas.guarded_by.get(stmt.lineno)
+                                or pragmas.guarded_by.get(stmt.lineno - 1))
+                        if lock is not None:
+                            lock = lock.removeprefix("self.")
+                            pragma_guards[attr] = (
+                                f"self.{lock}" if lock in lock_names else lock)
+        findings.extend(_analyze_scope(
+            rel, node.name, funcs, pragma_guards, pragmas,
+            _thread_targets(node), order_edges, edge_lines))
+
+    # -- DM-L003 over the whole module's acquisition graph ---------------
+    for cycle in _find_cycles(order_edges):
+        first_edge = (cycle[0], cycle[1 % len(cycle)] if len(cycle) > 1
+                      else cycle[0])
+        line = edge_lines.get(first_edge, 1)
+        chain = " -> ".join(cycle + [cycle[0]])
+        if pragmas.is_ignored("DM-L003", line):
+            continue
+        findings.append(Finding(
+            "DM-L003", rel, line,
+            f"potential deadlock: lock acquisition cycle {chain}",
+            hint="impose a global acquisition order (or merge the locks)",
+            key="cycle:" + "|".join(sorted(set(cycle)))))
+    return findings
+
+
+def _record_direct_edges(stmt_root: ast.AST, lock_names: Set[str],
+                         module_locks: Set[str],
+                         order_edges: Dict[str, Set[str]],
+                         edge_lines: Dict[Tuple[str, str], int]) -> None:
+    """with A: ... with B: ... → edge A→B (direct nesting, any depth)."""
+
+    def lock_of(expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in lock_names:
+            return f"self.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in module_locks:
+            return expr.id
+        return None
+
+    def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                acquired = [lk for item in child.items
+                            if (lk := lock_of(item.context_expr)) is not None]
+                for lock in acquired:
+                    for holder in held:
+                        if holder != lock:
+                            order_edges.setdefault(holder, set()).add(lock)
+                            edge_lines.setdefault((holder, lock), child.lineno)
+                walk(child, held + tuple(acquired))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, ())  # closures do not inherit held locks
+            else:
+                walk(child, held)
+
+    walk(stmt_root, ())

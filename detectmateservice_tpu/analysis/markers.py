@@ -1,0 +1,91 @@
+"""DM-T: pytest-marker registration lint.
+
+A typo'd marker (``@pytest.mark.slwo``) is silent: pytest warns once in a
+wall of output and the test simply never matches ``-m`` selections — the
+"slow tier" test that nobody has run for three months. Rule:
+
+  DM-T001  every ``pytest.mark.<m>`` used under ``tests/`` must be either a
+           pytest builtin or registered in ``pyproject.toml``
+           ``[tool.pytest.ini_options] markers``.
+
+``pyproject.toml`` is parsed with ``tomllib`` on 3.11+, falling back to a
+narrow regex on this floor (3.10) — the markers list is a plain literal.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from .findings import Finding
+
+BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "no_type_check",
+}
+
+_MARKERS_BLOCK_RE = re.compile(
+    r"^markers\s*=\s*\[(?P<body>.*?)\]", re.MULTILINE | re.DOTALL)
+
+
+def registered_markers(pyproject: Path) -> Set[str]:
+    text = pyproject.read_text(encoding="utf-8")
+    try:
+        import tomllib  # Python 3.11+
+        doc = tomllib.loads(text)
+        entries = (doc.get("tool", {}).get("pytest", {})
+                   .get("ini_options", {}).get("markers", []))
+    except ModuleNotFoundError:
+        match = _MARKERS_BLOCK_RE.search(text)
+        entries = ([] if match is None
+                   else re.findall(r"[\"'](.+?)[\"']", match.group("body")))
+    names: Set[str] = set()
+    for entry in entries:
+        name = str(entry).split(":")[0].split("(")[0].strip()
+        if name.isidentifier():
+            names.add(name)
+    return names
+
+
+def _used_markers(test_file: Path) -> Dict[str, Tuple[int, str]]:
+    """{marker: (line, context)} for every ``pytest.mark.<m>`` in the file —
+    decorators, ``pytest.param(..., marks=...)``, ``pytestmark`` lists."""
+    try:
+        tree = ast.parse(test_file.read_text(encoding="utf-8"))
+    except SyntaxError:
+        return {}
+    used: Dict[str, Tuple[int, str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        value = node.value
+        # pytest.mark.<m>  (node.attr == m when value is pytest.mark)
+        if (isinstance(value, ast.Attribute) and value.attr == "mark"
+                and isinstance(value.value, ast.Name)
+                and value.value.id == "pytest"):
+            used.setdefault(node.attr, (node.lineno, node.attr))
+    return used
+
+
+def check_markers(repo: Path) -> List[Finding]:
+    pyproject = repo / "pyproject.toml"
+    tests_dir = repo / "tests"
+    if not tests_dir.is_dir():
+        return []
+    registered = registered_markers(pyproject) if pyproject.exists() else set()
+    allowed = registered | BUILTIN_MARKERS
+    findings: List[Finding] = []
+    for test_file in sorted(tests_dir.glob("**/*.py")):
+        rel = test_file.relative_to(repo).as_posix()
+        for marker, (line, _) in sorted(_used_markers(test_file).items()):
+            if marker in allowed:
+                continue
+            findings.append(Finding(
+                "DM-T001", rel, line,
+                f"pytest marker {marker!r} is not registered in "
+                "pyproject.toml [tool.pytest.ini_options] markers",
+                hint="register it (or fix the typo) — unregistered markers "
+                     "silently never match -m selections",
+                key=f"marker:{marker}"))
+    return findings

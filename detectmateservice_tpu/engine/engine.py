@@ -125,6 +125,15 @@ class Engine:
             component_type=settings.component_type,
             component_id=settings.component_id or "unknown",
         )
+        # labeled metric children resolved ONCE: _send_to_outputs runs per
+        # message, and a .labels() call is a dict-build + hash per metric —
+        # four of them per message was a measurable slice of the send floor
+        # (dmlint DM-H001 is the rule that keeps it this way)
+        self._m_written_b = m.DATA_WRITTEN_BYTES().labels(**self._labels)
+        self._m_written_l = m.DATA_WRITTEN_LINES().labels(**self._labels)
+        self._m_dropped_b = m.DATA_DROPPED_BYTES().labels(**self._labels)
+        self._m_dropped_l = m.DATA_DROPPED_LINES().labels(**self._labels)
+        self._m_send_backlog = m.OUTPUT_SEND_BACKLOG().labels(**self._labels)
 
         # self-diagnosis heartbeats (engine/health.py): one monotonic clock
         # write per loop iteration — the beats happen unconditionally (they
@@ -476,6 +485,7 @@ class Engine:
         base_timeout = self.settings.engine_recv_timeout
         short_timeout = min(5, base_timeout)
         current_timeout = base_timeout
+        # dmlint: hot-loop
         while self._running and not self._stop_event.is_set():
             self._hb_loop.beat()
             if callable(pending_fn):
@@ -688,10 +698,10 @@ class Engine:
 
     def _send_to_outputs(self, data: bytes, lines: Optional[int] = None,
                          origin=None) -> bool:
-        written_b = m.DATA_WRITTEN_BYTES().labels(**self._labels)
-        written_l = m.DATA_WRITTEN_LINES().labels(**self._labels)
-        dropped_b = m.DATA_DROPPED_BYTES().labels(**self._labels)
-        dropped_l = m.DATA_DROPPED_LINES().labels(**self._labels)
+        written_b = self._m_written_b
+        written_l = self._m_written_l
+        dropped_b = self._m_dropped_b
+        dropped_l = self._m_dropped_l
         if lines is None:
             lines = _count_lines(data)
 
@@ -751,9 +761,10 @@ class Engine:
             # ``out_stop_drain_ms`` window starting when the stop flag is
             # first observed — aggregate, so a multi-message final flush
             # stays inside the 2 s stop-join deadline.
-            backlog_g = m.OUTPUT_SEND_BACKLOG().labels(**self._labels)
+            backlog_g = self._m_send_backlog
             pending_socks = list(self._out_socks)
             waited = False
+            # dmlint: hot-loop
             while pending_socks:
                 if not self._running or self._stop_event.is_set():
                     if self._stop_drain_deadline is None:
@@ -784,6 +795,8 @@ class Engine:
                     else:
                         self._hb_output.beat()
                     waited = True
+                    # a raw blocking send would make the engine unstoppable:
+                    # dmlint: ignore[DM-H004] the 1 ms poll IS flow control
                     time.sleep(0.001)
                 pending_socks = still
             for _ in pending_socks:  # stop-drain deadline expired
@@ -797,6 +810,7 @@ class Engine:
         waited = False
         for sock in self._out_socks:
             sent = False
+            # dmlint: hot-loop
             for _ in range(self.settings.engine_retry_count):
                 try:
                     sock.send(data, block=False)
@@ -805,13 +819,16 @@ class Engine:
                 except TransportAgain:
                     if not waited:
                         # gauge only touched once a peer actually stalls
-                        m.OUTPUT_SEND_BACKLOG().labels(**self._labels).set(1)
+                        self._m_send_backlog.set(1)
                         waited = True
                     # bounded retries (max retry_count × 10 ms) never trip
                     # the saturation check — drop mode surfaces through the
                     # drop-rate alert instead — but the beat keeps the pump
                     # heartbeat honest while the loop sleeps here
                     self._hb_output.beat()
+                    # the reference-mandated 10 ms retry backoff; lives on
+                    # the except (cold) path, which the DM-H004 hot-loop
+                    # rule skips by contract
                     time.sleep(_RETRY_SLEEP_S)
                 except TransportError as exc:
                     self.logger.warning("output send failed hard: %s", exc)
@@ -822,5 +839,5 @@ class Engine:
                 dropped_b.inc(len(data))
                 dropped_l.inc(lines)
         if waited:
-            m.OUTPUT_SEND_BACKLOG().labels(**self._labels).set(0)
+            self._m_send_backlog.set(0)
         return any_ok

@@ -363,11 +363,17 @@ class HealthMonitor:
     # -- evaluation ------------------------------------------------------
     @property
     def state(self) -> str:
-        return self._state
+        # the lock (not a bare read) so a concurrent evaluate()'s roll-up
+        # transition is never observed half-applied; uncontended acquire is
+        # ~100 ns and this is the cheap-liveness path, not the hot loop
+        with self._lock:
+            return self._state
 
     def report(self) -> Dict[str, Any]:
         """The most recent evaluation (evaluating now if none ran yet)."""
-        return self._last_report or self.evaluate()
+        with self._lock:
+            report = self._last_report
+        return report or self.evaluate()
 
     def evaluate(self) -> Dict[str, Any]:
         """Run every check once, apply hysteresis, update the metrics, emit
@@ -478,6 +484,7 @@ class HealthMonitor:
         self._thread = None
 
     def _run(self) -> None:
+        # dmlint: hot-loop
         while not self._stop.wait(self._interval_s):
             try:
                 self.evaluate()
@@ -586,6 +593,7 @@ def _thread_excepthook(args) -> None:
     }
     with _HOOK_LOCK:
         sinks = list(_HOOK_SINKS)
+        prev_hook = _PREV_HOOK
     delivered = False
     for logger, events in sinks:
         try:
@@ -599,8 +607,8 @@ def _thread_excepthook(args) -> None:
             delivered = True
         except Exception:  # noqa: BLE001 — the hook of last resort cannot raise
             pass
-    if not delivered and _PREV_HOOK is not None:
-        _PREV_HOOK(args)
+    if not delivered and prev_hook is not None:
+        prev_hook(args)
 
 
 # ---------------------------------------------------------------------------

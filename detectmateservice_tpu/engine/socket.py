@@ -493,8 +493,11 @@ class FramedTcpListener:
     @property
     def peer_count(self) -> int:
         """Live fan-in connections. The engine uses this to skip per-frame
-        origin bookkeeping when only one peer exists (misrouting needs two)."""
-        return len(self._conns)
+        origin bookkeeping when only one peer exists (misrouting needs two).
+        Taken under the conns lock: the probe runs once per burst, and a
+        torn read during an accept would misclassify the whole burst."""
+        with self._conns_lock:
+            return len(self._conns)
 
     @property
     def last_origin(self):

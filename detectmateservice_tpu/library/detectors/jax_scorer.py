@@ -703,6 +703,7 @@ class JaxScorerDetector(CoreDetector):
         that arrive mid-fit buffer in-process (ordered) instead of piling
         into socket buffers and dropping — and the pending backlog dispatches
         on the first call after the fit completes."""
+        # dmlint: ignore[DM-L001] racy pre-check; _finish_fit re-checks under lock
         fit_thread = self._fit_thread  # local read: another thread may None it
         if fit_thread is not None and not fit_thread.is_alive():
             self._finish_fit()
@@ -791,6 +792,7 @@ class JaxScorerDetector(CoreDetector):
                 for d in msgs)
             return self.process_batch(msgs), len(msgs), n_lines
 
+        # dmlint: ignore[DM-L001] racy pre-check; _finish_fit re-checks under lock
         fit_thread = self._fit_thread  # local read: another thread may None it
         if fit_thread is not None and not fit_thread.is_alive():
             self._finish_fit()
@@ -911,9 +913,16 @@ class JaxScorerDetector(CoreDetector):
                 if self._threshold is None:
                     self._threshold = float("inf")
 
-        self._fit_thread = threading.Thread(target=_fit_safe, daemon=True,
-                                            name="ScorerFit")
-        self._fit_thread.start()
+        # publish AND start under the lock: _finish_fit's join-and-dispatch
+        # handoff clears the handle under _fit_lock, so an unguarded write
+        # here could lose that clear — and joining a published-but-unstarted
+        # thread raises RuntimeError, so start() must happen before any
+        # other thread can observe the handle (start is microseconds; the
+        # fit itself runs on the new thread, not under the lock)
+        with self._fit_lock:
+            self._fit_thread = threading.Thread(target=_fit_safe, daemon=True,
+                                                name="ScorerFit")
+            self._fit_thread.start()
 
     def _finish_fit(self, wait: bool = False) -> None:
         """Join a finished (or, with ``wait``, still-running) fit thread and
@@ -923,6 +932,7 @@ class JaxScorerDetector(CoreDetector):
         save_checkpoint / flush_final — mixed usage the class supports) may
         call this concurrently; without the lock both could observe a
         non-empty backlog and double-dispatch it."""
+        # dmlint: ignore[DM-L001] racy pre-check; the read repeats under the lock
         pre = self._fit_thread  # local read: another thread may None it
         if pre is not None and pre.is_alive() and not wait:
             return  # cheap pre-check without the lock
@@ -932,6 +942,8 @@ class JaxScorerDetector(CoreDetector):
                 return
             if thread.is_alive() and not wait:
                 return
+            # the fit thread never takes _fit_lock, so no deadlock here:
+            # dmlint: ignore[DM-L002] _fit_lock IS the handoff serializer
             thread.join()
             self._fit_thread = None
             if self._pending:
@@ -1018,6 +1030,7 @@ class JaxScorerDetector(CoreDetector):
         slots. jax dispatch is thread-safe; a failure is stored on the slot
         (surfaced and counted at drain) so a poisoned batch can never leave
         the engine thread waiting on a slot that nobody will complete."""
+        # dmlint: hot-loop
         while True:
             item = self._upload_queue.get()
             if item is None:

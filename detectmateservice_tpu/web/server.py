@@ -44,8 +44,9 @@ class WebServer:
     @property
     def port(self) -> int:
         """Actual bound port (useful when settings request port 0)."""
-        if self._httpd is not None:
-            return self._httpd.server_address[1]
+        with self._lock:
+            if self._httpd is not None:
+                return self._httpd.server_address[1]
         return self.service.settings.http_port
 
     def start(self) -> None:
@@ -67,15 +68,19 @@ class WebServer:
             self._thread.start()
 
     def stop(self) -> None:
+        # swap the references out under the lock, block outside it:
+        # shutdown() waits for serve_forever's poll loop and join() for the
+        # thread — holding the lock across either would stall a concurrent
+        # start()/port() for up to the join timeout (dmlint: DM-L002)
         with self._lock:
-            if self._httpd is None:
-                return
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-            if self._thread is not None:
-                self._thread.join(timeout=2.0)
-                self._thread = None
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=2.0)
 
 
 def _make_handler(service):

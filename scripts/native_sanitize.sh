@@ -1,0 +1,67 @@
+#!/bin/sh
+# Run the native test files under sanitizer-instrumented builds.
+#
+#   scripts/native_sanitize.sh               # asan+ubsan, then tsan
+#   scripts/native_sanitize.sh address,undefined
+#   scripts/native_sanitize.sh thread
+#
+# For each requested mode this script rebuilds the .so's with
+# `native/build.sh --sanitize=...`, preloads the matching sanitizer
+# runtime into the Python process (an instrumented shared library needs the
+# runtime resident before dlopen), runs the native kernel + transport test
+# files, and finally restores a clean release build so the working tree is
+# never left instrumented. A sanitizer report aborts the test process and
+# fails this script.
+#
+# Coverage notes:
+#  * ASan+UBSan: heap overflows / UAF / UB across the protobuf wire-format
+#    walk, tokenizer, frame packer, and the transport framing.
+#  * TSan: the dmkern row-parallel pthread pool (tests/test_native_kernels.py
+#    drives multi-threaded featurize via DM_FEATURIZE_THREADS) — lock/cv
+#    handshakes and the atomic row cursor.
+#  * Leak detection is off: a long-lived CPython process is not leak-clean
+#    by design (interned objects, arenas), and the kernels' capacity buffers
+#    are deliberately persistent.
+set -e
+cd "$(dirname "$0")/.."
+
+MODES="${1:-address,undefined thread}"
+PY="${PYTHON:-python}"
+CC_BIN="${CC:-cc}"
+
+run_mode() {
+    mode="$1"
+    echo "==> native sanitize: $mode"
+    sh native/build.sh --sanitize="$mode"
+    case "$mode" in
+        thread)
+            preload="$($CC_BIN -print-file-name=libtsan.so)"
+            # second_deadlock_stack: report both stacks of a lock inversion
+            env_extra="TSAN_OPTIONS=halt_on_error=1:second_deadlock_stack=1"
+            # the pthread pool is the TSan target: force a real multi-thread
+            # featurize even on small CI boxes
+            tests="tests/test_native_kernels.py"
+            threads=4
+            ;;
+        *)
+            preload="$($CC_BIN -print-file-name=libasan.so) $($CC_BIN -print-file-name=libubsan.so)"
+            env_extra="ASAN_OPTIONS=detect_leaks=0:halt_on_error=1 UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1"
+            tests="tests/test_native_kernels.py tests/test_native_transport.py"
+            threads=2
+            ;;
+    esac
+    # shellcheck disable=SC2086
+    env LD_PRELOAD="$(echo $preload | tr ' ' ':')" $env_extra \
+        DM_FEATURIZE_THREADS=$threads JAX_PLATFORMS=cpu \
+        "$PY" -m pytest $tests -q -p no:cacheprovider
+    echo "==> $mode: PASS"
+}
+
+status=0
+for mode in $MODES; do
+    run_mode "$mode" || { status=$?; break; }
+done
+
+echo "==> restoring clean release build"
+sh native/build.sh
+exit $status

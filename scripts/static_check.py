@@ -1,111 +1,45 @@
-"""Stdlib-only static gate — the analysis layer that runs in ANY
-environment, including this repo's no-network build sandbox where the
-pre-commit suite's wheels (flake8/mypy/bandit) cannot be installed.
+"""Thin compatibility shim: the stdlib static gate grew into the
+``detectmateservice_tpu.analysis`` package (``detectmate-lint``).
 
-CI runs this first (fail-fast), then the full pre-commit suite
-(.github/workflows/ci.yml). Locally: ``python scripts/static_check.py``.
+Every historical invocation (``python scripts/static_check.py``) keeps
+working — this execs the real CLI, forwarding argv. The old 4-rule AST gate
+lives on as the DM-B rule family; the analyzer suite adds lock discipline
+(DM-L), hot-loop purity (DM-H), cross-artifact contracts (DM-C),
+pytest-marker registration (DM-T), and the suppression baseline
+(docs/static_analysis.md).
 
-Checks:
-  1. byte-compilation of every tracked .py (syntax gate),
-  2. AST rules on package + scripts code:
-       - mutable default arguments (list/dict/set literals),
-       - bare ``except:`` clauses (mask KeyboardInterrupt/SystemExit),
-       - comparisons to None with ==/!=,
-       - tab characters in indentation,
-  3. YAML well-formedness of committed config artifacts.
-
-Exit code 0 = clean, 1 = findings (printed one per line).
+The analysis package is loaded STANDALONE (importlib, bypassing
+``detectmateservice_tpu/__init__``): the top-level package imports the
+runtime stack (pydantic, zmq, prometheus_client), and this gate must run in
+environments that have none of it — the whole point of a stdlib-only suite.
+Installed environments can use the ``detectmate-lint`` entry point instead.
 """
 from __future__ import annotations
 
-import ast
-import py_compile
+import importlib.util
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
-CHECK_DIRS = ("detectmateservice_tpu", "scripts", "tests")
-TOP_FILES = ("bench.py", "__graft_entry__.py")
-SKIP_PARTS = {"__pycache__", "_native"}
-SKIP_FILES = {"schemas_pb2.py"}  # generated by protoc
+_PKG_DIR = Path(__file__).resolve().parent.parent / "detectmateservice_tpu" / "analysis"
 
 
-def iter_py_files():
-    for name in TOP_FILES:
-        path = REPO / name
-        if path.exists():
-            yield path
-    for base in CHECK_DIRS:
-        for path in sorted((REPO / base).rglob("*.py")):
-            if SKIP_PARTS & set(path.parts) or path.name in SKIP_FILES:
-                continue
-            yield path
-
-
-def check_ast(path: Path, findings: list) -> None:
-    src = path.read_text(encoding="utf-8")
-    rel = path.relative_to(REPO)
-    for lineno, line in enumerate(src.splitlines(), 1):
-        stripped_len = len(line) - len(line.lstrip())
-        if "\t" in line[:stripped_len]:
-            findings.append(f"{rel}:{lineno}: tab in indentation")
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as exc:  # compileall reports it too; keep one line
-        findings.append(f"{rel}:{exc.lineno}: syntax error: {exc.msg}")
-        return
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in list(node.args.defaults) + [
-                    d for d in node.args.kw_defaults if d is not None]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    findings.append(
-                        f"{rel}:{default.lineno}: mutable default argument "
-                        f"in {node.name}()")
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(f"{rel}:{node.lineno}: bare except:")
-        elif isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if (isinstance(op, (ast.Eq, ast.NotEq))
-                        and isinstance(comp, ast.Constant)
-                        and comp.value is None):
-                    findings.append(
-                        f"{rel}:{node.lineno}: use 'is None', not '== None'")
-
-
-def check_yaml(findings: list) -> None:
-    try:
-        import yaml
-    except ImportError:
-        return
-    for pattern in ("examples/*.yaml", "ops/*.yml", "ops/*.yaml",
-                    ".pre-commit-config.yaml", ".github/workflows/*.yml"):
-        for path in sorted(REPO.glob(pattern)):
-            try:
-                with open(path) as f:
-                    yaml.safe_load(f)
-            except yaml.YAMLError as exc:
-                findings.append(f"{path.relative_to(REPO)}: invalid YAML: {exc}")
-
-
-def main() -> int:
-    findings: list = []
-    n = 0
-    for path in iter_py_files():
-        n += 1
-        try:
-            py_compile.compile(str(path), doraise=True)
-        except py_compile.PyCompileError as exc:
-            findings.append(str(exc).strip())
-            continue
-        check_ast(path, findings)
-    check_yaml(findings)
-    for f in findings:
-        print(f)
-    print(f"static_check: {n} files, {len(findings)} finding(s)",
-          file=sys.stderr)
-    return 1 if findings else 0
+def _load_analysis_cli():
+    spec = importlib.util.spec_from_file_location(
+        "dmlint_analysis", _PKG_DIR / "__init__.py",
+        submodule_search_locations=[str(_PKG_DIR)])
+    assert spec is not None and spec.loader is not None
+    package = importlib.util.module_from_spec(spec)
+    sys.modules["dmlint_analysis"] = package
+    spec.loader.exec_module(package)
+    cli_spec = importlib.util.spec_from_file_location(
+        "dmlint_analysis.cli", _PKG_DIR / "cli.py")
+    assert cli_spec is not None and cli_spec.loader is not None
+    cli = importlib.util.module_from_spec(cli_spec)
+    cli.__package__ = "dmlint_analysis"
+    sys.modules["dmlint_analysis.cli"] = cli
+    cli_spec.loader.exec_module(cli)
+    return cli
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_load_analysis_cli().main(sys.argv[1:]))

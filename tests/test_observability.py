@@ -125,11 +125,13 @@ class TestAlertRules:
 
     def test_health_and_slo_series_are_covered_by_rules(self):
         """Reverse direction: the health/SLO series the exporter declares
-        must each be the subject of some alert rule."""
+        must each be the subject of some alert rule. The covered set is the
+        analyzer's constant (dmlint DM-C004) so the test and the lint gate
+        can never drift apart."""
+        from detectmateservice_tpu.analysis.contracts import ALERT_COVERED_SERIES
+
         exprs = "\n".join(e for _, e in alert_exprs())
-        for base in ("engine_heartbeat_age_seconds", "engine_health_state",
-                     "output_send_backlog", "data_dropped_lines_total",
-                     "pipeline_e2e_latency_seconds"):
+        for base in ALERT_COVERED_SERIES:
             assert re.search(rf"\b{base}", exprs), f"no alert rule uses {base}"
 
     def test_burn_rate_buckets_exist_in_exporter_histogram(self):
