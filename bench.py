@@ -394,6 +394,14 @@ def main() -> None:
     tpu_run: _Child | None = None
     tpu_result: dict | None = None       # largest-N successful TPU run
     tpu_run_failures = 0
+    # fail-fast on a WEDGED tunnel (BENCH_r05: eight consecutive probes each
+    # burned the full 120 s window on the experimental axon platform): a
+    # probe that TIMES OUT means backend init hangs — re-probing only chains
+    # more 120 s burns, so the first timeout abandons the platform pin and
+    # the concurrent CPU insurance plane carries the round. Fast probe
+    # CRASHES keep the re-probe cadence: a transient tunnel error can
+    # recover, a hang does not.
+    tpu_probe_timed_out = False
 
     def launch_tpu_run() -> "_Child | None":
         """Pick the next TPU run size for the remaining budget, or None."""
@@ -439,10 +447,13 @@ def main() -> None:
         # -- TPU plane: keep exactly one child in flight
         if tpu_probe is not None and tpu_probe.poll():
             res = harvest(tpu_probe)
+            if tpu_probe.diag.get("outcome") == "timeout":
+                tpu_probe_timed_out = True   # wedged tunnel: stop re-probing
             tpu_probe = None
             if res is not None and res.get("platform") not in (None, "cpu"):
                 tpu_run = launch_tpu_run()
             # else: fall through; the cadence below schedules the re-probe
+            # (unless the probe timed out — then the platform is abandoned)
         if tpu_run is not None and tpu_run.poll():
             res = harvest(tpu_run)
             tpu_run = None
@@ -473,6 +484,7 @@ def main() -> None:
                     tpu_run = launch_tpu_run()
                 # else: back to the cadenced probe cycle below
         if (tpu_probe is None and tpu_run is None and tpu_result is None
+                and not tpu_probe_timed_out
                 and tpu_run_failures < MAX_TPU_RUN_FAILURES
                 and time.monotonic() - last_probe_start >= REPROBE_INTERVAL_S
                 and left() > REPORT_MARGIN_S + TPU_MIN_RUN_BUDGET_S):
@@ -484,7 +496,8 @@ def main() -> None:
         # stays alive for the whole deadline — that persistence IS the fix.
         tpu_active = tpu_probe is not None or tpu_run is not None
         cpu_active = cpu_probe is not None or cpu_smoke is not None or cpu_run is not None
-        tpu_abandoned = tpu_run_failures >= MAX_TPU_RUN_FAILURES
+        tpu_abandoned = (tpu_run_failures >= MAX_TPU_RUN_FAILURES
+                         or tpu_probe_timed_out)
         if (not tpu_active and not cpu_active
                 and (tpu_result is not None or tpu_abandoned)):
             break
@@ -516,9 +529,12 @@ def main() -> None:
             out["cpu_lines_per_s_per_core"] = round(per_core, 1)
             out["cpu_floor_lines_per_s_per_core"] = CPU_FLOOR_LINES_PER_S_PER_CORE
             out["cpu_floor_ok"] = per_core >= CPU_FLOOR_LINES_PER_S_PER_CORE
+            probe_note = (
+                "first TPU probe timed out — wedged tunnel, platform "
+                "abandoned fail-fast" if tpu_probe_timed_out else
+                f"persistent re-probe every ~{REPROBE_INTERVAL_S}s")
             out["note"] = (
-                f"TPU backend unreachable for the whole {DEADLINE_S}s window "
-                f"(persistent re-probe every ~{REPROBE_INTERVAL_S}s); float32 "
+                f"TPU backend unreachable ({probe_note}); float32 "
                 f"CPU fallback on {cores} core(s) — vs_baseline is defined "
                 "against 1x TPU v5e, cpu_floor_ok is the regression signal")
         print(json.dumps(out))

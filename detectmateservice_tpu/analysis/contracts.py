@@ -17,6 +17,10 @@ YAMLs. These rules hold them in sync, in both directions:
   DM-C006  an example settings YAML uses a key ServiceSettings would reject
            (``extra="forbid"`` makes this a startup crash for whoever copies
            the example)
+  DM-C007  an admin route declared in web/router.py's ROUTES table is not
+           documented in docs/usage.md (the operator cannot find it)
+  DM-C008  docs/usage.md documents a ``GET/POST /admin/...`` route the
+           router never declares (the documented call 404s)
 
 Everything is parsed statically — the series registry and the settings
 fields are read from the AST, not by importing the package — so the checker
@@ -44,6 +48,8 @@ ALERT_COVERED_SERIES = (
     "output_send_backlog",
     "data_dropped_lines_total",
     "pipeline_e2e_latency_seconds",
+    "scorer_xla_recompiles_unexpected_total",
+    "device_hbm_bytes",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")
@@ -236,5 +242,62 @@ def check_settings_contract(repo: Path) -> List[Finding]:
     return findings
 
 
+def declared_routes(router_path: Path) -> Dict[str, int]:
+    """Parse ``web/router.py`` for ``Route("<METHOD>", "<path>", ...)``
+    declarations → {"METHOD /path": line}. AST-only: no package import."""
+    tree = ast.parse(router_path.read_text(encoding="utf-8"))
+    routes: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else getattr(func, "attr", "")
+        if name != "Route" or len(node.args) < 2:
+            continue
+        method, path = node.args[0], node.args[1]
+        if (isinstance(method, ast.Constant) and isinstance(method.value, str)
+                and isinstance(path, ast.Constant)
+                and isinstance(path.value, str)):
+            routes[f"{method.value} {path.value}"] = node.lineno
+    return routes
+
+
+# a documented route reference: `GET /admin/...` or `POST /admin/...` (or
+# the /metrics exposition) inside backticks, the docs/usage.md table idiom
+_DOC_ROUTE_RE = re.compile(r"`(GET|POST)\s+((?:/admin/|/metrics)[^\s`]*)`")
+
+
+def check_routes_contract(repo: Path) -> List[Finding]:
+    """DM-C007/8: the admin route table (web/router.py ROUTES) and the
+    docs/usage.md route reference stay in sync, both directions."""
+    findings: List[Finding] = []
+    router_py = repo / "detectmateservice_tpu" / "web" / "router.py"
+    usage_doc = repo / "docs" / "usage.md"
+    if not router_py.exists() or not usage_doc.exists():
+        return findings
+    routes = declared_routes(router_py)
+    doc_text = usage_doc.read_text(encoding="utf-8")
+    documented = {f"{method} {path}"
+                  for method, path in _DOC_ROUTE_RE.findall(doc_text)}
+
+    for route, line in sorted(routes.items()):
+        if route not in documented:
+            findings.append(Finding(
+                "DM-C007", "detectmateservice_tpu/web/router.py", line,
+                f"admin route {route!r} is not documented in docs/usage.md",
+                hint="add a row to the Admin HTTP API table "
+                     "(format: | `METHOD /path` | effect |)",
+                key=f"route-doc:{route}"))
+    for route in sorted(documented - set(routes)):
+        findings.append(Finding(
+            "DM-C008", "docs/usage.md", 1,
+            f"docs/usage.md documents route {route!r} which web/router.py "
+            "never declares (the documented call 404s)",
+            hint="remove the row or declare the Route in ROUTES",
+            key=f"route-phantom:{route}"))
+    return findings
+
+
 def check_all(repo: Path) -> List[Finding]:
-    return check_metrics_contract(repo) + check_settings_contract(repo)
+    return (check_metrics_contract(repo) + check_settings_contract(repo)
+            + check_routes_contract(repo))

@@ -6,7 +6,10 @@ subcommands ``start`` / ``stop`` / ``status`` / ``metrics`` /
 ``checkpoint`` (save component state to the service's checkpoint_dir),
 ``trace [--chrome] [-o FILE]`` (read the pipeline flight recorder; --chrome
 fetches a Perfetto-loadable trace-event document), ``events`` (the
-structured-event ring) and ``health`` — which fans out across every stage of
+structured-event ring), ``xla [--limit N]`` (the device-side XLA compile
+ledger + batch spans), ``profile [--seconds N] [--wait] [-o FILE]`` (start an
+on-demand jax.profiler capture and, with --wait, download the artifact zip)
+and ``health`` — which fans out across every stage of
 a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
 ``stages:`` mapping), prints a roll-up table, and exits non-zero when any
 stage is degraded, unhealthy, or unreachable.
@@ -43,6 +46,8 @@ class DetectMateClient:
             ctype = resp.headers.get("Content-Type", "")
             if "json" in ctype:
                 return json.loads(raw)
+            if "zip" in ctype or "octet-stream" in ctype:
+                return raw  # binary artifact (profile download)
             return raw.decode("utf-8", errors="replace")
 
     def start(self) -> Any:
@@ -94,6 +99,26 @@ class DetectMateClient:
         """Read the structured event ring (``GET /admin/events``)."""
         suffix = f"?limit={int(limit)}" if limit is not None else ""
         return self._request("GET", "/admin/events" + suffix)
+
+    def xla(self, limit: Optional[int] = None) -> Any:
+        """Read the XLA compile ledger + device-batch spans
+        (``GET /admin/xla``)."""
+        suffix = f"?limit={int(limit)}" if limit is not None else ""
+        return self._request("GET", "/admin/xla" + suffix)
+
+    def profile_start(self, seconds: float = 1.0) -> Any:
+        """Start an on-demand jax.profiler capture
+        (``POST /admin/profile?seconds=N``)."""
+        return self._request("POST", f"/admin/profile?seconds={float(seconds)}")
+
+    def profile_status(self) -> Any:
+        """Capture status (``GET /admin/profile``)."""
+        return self._request("GET", "/admin/profile")
+
+    def profile_latest(self) -> bytes:
+        """Download the newest completed capture as zip bytes
+        (``GET /admin/profile/latest``)."""
+        return self._request("GET", "/admin/profile/latest")
 
 
 def resolve_stages(default_url: str, targets: List[str]) -> List[Tuple[str, str]]:
@@ -164,6 +189,34 @@ def health_rollup(default_url: str, targets: List[str],
     return exit_code
 
 
+def run_profile(client: DetectMateClient, seconds: float, wait: bool,
+                out: str) -> int:
+    """``client.py profile``: start a capture; with ``--wait``, poll until it
+    completes and download the artifact zip. Exit 1 when the capture errors
+    or the service rejects it (another capture running → HTTP 409)."""
+    import time as _time
+
+    started = client.profile_start(seconds=seconds)
+    print(json.dumps(started, indent=2))
+    if not wait:
+        return 0
+    deadline = _time.monotonic() + seconds + 30.0
+    status = client.profile_status()
+    while status.get("running") and _time.monotonic() < deadline:
+        _time.sleep(min(0.25, max(0.05, seconds / 4)))
+        status = client.profile_status()
+    last = status.get("last") or {}
+    if status.get("running") or last.get("state") != "done":
+        print(f"capture did not complete cleanly: {json.dumps(status)}",
+              file=sys.stderr)
+        return 1
+    data = client.profile_latest()
+    with open(out, "wb") as fh:
+        fh.write(data)
+    print(f"wrote {out} ({len(data)} bytes) from {last.get('dir')}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="detectmate-client", description="Admin client for DetectMate TPU services"
@@ -189,6 +242,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "events", help="read the structured event ring (/admin/events)")
     events_p.add_argument("--limit", type=int, default=None,
                           help="only the newest N events")
+    xla_p = sub.add_parser(
+        "xla", help="read the XLA compile ledger + device-batch spans "
+                    "(/admin/xla)")
+    xla_p.add_argument("--limit", type=int, default=None,
+                       help="only the newest N compile events / spans")
+    profile_p = sub.add_parser(
+        "profile",
+        help="start an on-demand jax.profiler capture (/admin/profile)")
+    profile_p.add_argument("--seconds", type=float, default=1.0,
+                           help="capture duration (default 1.0)")
+    profile_p.add_argument("--wait", action="store_true",
+                           help="block until the capture completes, then "
+                                "download the artifact zip")
+    profile_p.add_argument("-o", "--out", default="profile.zip",
+                           help="artifact path for --wait (default "
+                                "profile.zip)")
     trace = sub.add_parser(
         "trace", help="read the pipeline flight recorder (/admin/trace)")
     trace.add_argument("--chrome", action="store_true",
@@ -204,8 +273,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.command == "health":
             return health_rollup(args.url, args.targets, deep=args.deep)
+        if args.command == "profile":
+            return run_profile(client, args.seconds, args.wait, args.out)
         if args.command == "events":
             result = client.events(limit=args.limit)
+        elif args.command == "xla":
+            result = client.xla(limit=args.limit)
         elif args.command == "reconfigure":
             with open(args.config_file, "r", encoding="utf-8") as fh:
                 config = yaml.safe_load(fh) or {}

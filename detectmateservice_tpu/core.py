@@ -245,6 +245,18 @@ class Service:
         self.engine = Engine(settings, self.processor, socket_factory,
                              self.logger, health=self.health)
         self.health.trace_recorder = self.engine.trace_recorder
+        # device-observability plane (engine/device_obs.py): bind the
+        # process-wide XLA compile ledger to THIS service's identity and
+        # health plane, so an unexpected recompile lands in the event ring,
+        # the xla_recompile_storm check, and scorer_xla_* series with the
+        # right labels. Importless on non-jax stages — the ledger's jax
+        # monitoring listener installs lazily from the scorer.
+        from .engine import device_obs
+
+        device_obs.get_ledger().bind(
+            labels=dict(self._labels), monitor=self.health,
+            emit_events=settings.recompile_alert_enabled,
+            register_check=settings.recompile_alert_enabled)
         if settings.watchdog_enabled:
             self.health.start()
 

@@ -1,0 +1,420 @@
+"""Device-side observability: the XLA compile ledger and batch span log.
+
+The accelerator side of the pipeline — ``jax.jit`` scoring, bucket warm-up,
+host/device routing — was a black box: a recompile storm or a
+padding-wasteful bucket mix was invisible until it surfaced as e2e latency.
+This module closes that gap with the same contract machinery the host
+pipeline already has (declared series, Grafana row, alert rules, structured
+events):
+
+* :class:`CompileLedger` — every XLA backend compile in the process is
+  recorded (jax.monitoring's ``backend_compile_duration`` event) and
+  attributed to the dispatch bucket / code path that triggered it via a
+  thread-local :meth:`CompileLedger.context` the scorer wraps around its jit
+  call sites. Counters: ``scorer_xla_compiles_total{bucket,backend}`` and
+  ``scorer_xla_compile_seconds_total{bucket,backend}``. A bounded ring of
+  compile events is served at ``GET /admin/xla``.
+* **unexpected-recompile detection** — after the scorer marks warm-up
+  complete, any compile inside a *dispatch* context (``expected=False``) is
+  a recompile the bucket design promised would never happen. Each one
+  increments ``scorer_xla_recompiles_unexpected_total`` (the
+  ``RecompileStorm`` alert signal), emits a structured
+  ``unexpected_recompile`` event through the bound
+  :class:`~detectmateservice_tpu.engine.health.HealthMonitor` (ring +
+  logger, with the flight recorder's last trace id), and arms the
+  ``xla_recompile_storm`` watchdog check.
+* **batch span log** — each drained device batch records a span (bucket,
+  real rows, path, queue-wait vs device-time split, the PR-1 trace id
+  current at dispatch) into a bounded ring, also on ``GET /admin/xla``.
+* :func:`export_hbm_gauges` — ``device_hbm_bytes{device,kind}`` computed at
+  scrape time from ``jax.Device.memory_stats()`` (absent on CPU backends,
+  which return ``None`` — then nothing is exported).
+
+Attribution contract: only compiles that fire inside *some* ledger context
+participate in unexpected-recompile detection. Compiles with no active
+context (another library jit-compiling in the same process) are still
+recorded in the ring — ``where: external`` — but never flagged, so the
+detector cannot false-alarm on co-tenant compilation.
+
+The module imports no jax at import time: non-jax stages (parsers, readers)
+construct Services without paying jax's import cost; the monitoring listener
+installs lazily from the scorer.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from . import metrics as m
+from .health import DEGRADED, PASS
+
+# the jax.monitoring event name that marks one XLA backend compile
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# how long after the last unexpected recompile the watchdog check stays
+# degraded (long enough to survive a scrape/evaluation gap, short enough
+# that a one-off mis-sized batch does not page for an hour)
+RECOMPILE_STORM_WINDOW_S = 120.0
+
+
+class CompileLedger:
+    """Bounded record of XLA compiles + device-batch spans for one process.
+
+    Thread-safe; the hot cost is zero when no compile happens (the listener
+    only fires on actual backend compiles, and span recording is one lock +
+    deque append per *drained batch*, never per message)."""
+
+    def __init__(self, max_events: int = 256, max_spans: int = 256,
+                 storm_window_s: float = RECOMPILE_STORM_WINDOW_S) -> None:
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(1, max_events))
+        self._spans: deque = deque(maxlen=max(1, max_spans))
+        self._seq = 0
+        self._span_seq = 0
+        self._warmed = False
+        self._storm_window_s = storm_window_s
+        self._labels = {"component_type": "core", "component_id": "unknown"}
+        self.monitor = None               # HealthMonitor, set via bind()
+        self._emit_events = True
+        self._tls = threading.local()
+        # label-children cache: a compile is rare but the .labels() dict
+        # hash on every record would still be waste (dmlint DM-H001 idiom)
+        self._compile_children: Dict[Tuple[str, str], tuple] = {}
+        self._unexpected_child = None
+        self._totals = {"compiles": 0, "seconds": 0.0, "unexpected": 0}
+        self._last_unexpected_mono: Optional[float] = None
+        self._recent_unexpected: deque = deque(maxlen=64)  # monotonic stamps
+
+    # -- wiring ----------------------------------------------------------
+    def bind(self, labels: Optional[Dict[str, str]] = None, monitor=None,
+             emit_events: bool = True, register_check: bool = True) -> None:
+        """Attach component identity + the health plane (called by the
+        Service at construction; last bind wins — the ledger is per-process,
+        like the metric registry)."""
+        with self._lock:
+            if labels:
+                self._labels = dict(labels)
+                self._compile_children.clear()
+                self._unexpected_child = None
+            if monitor is not self.monitor:
+                # a storm that predates this binding belongs to the previous
+                # service — a freshly-bound monitor starts with a clean
+                # storm window (the ring and counters keep the history)
+                self._recent_unexpected.clear()
+                self._last_unexpected_mono = None
+            self.monitor = monitor
+            self._emit_events = emit_events
+        if monitor is not None and register_check:
+            monitor.remove_check(RecompileStormCheck.name)
+            monitor.add_check(RecompileStormCheck(self, monitor,
+                                                  self._storm_window_s))
+
+    # -- attribution contexts -------------------------------------------
+    @contextlib.contextmanager
+    def context(self, bucket: Optional[int] = None,
+                backend: Optional[str] = None, where: Optional[str] = None,
+                expected: Optional[bool] = None) -> Iterator[None]:
+        """Attribute compiles fired by the enclosed code to (bucket, where).
+
+        ``expected`` is inherited from the enclosing context when ``None``
+        (outermost default: True) — so a sharded-scorer context nested
+        inside the dispatch path keeps the dispatch path's ``False``."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append({"bucket": bucket, "backend": backend, "where": where,
+                      "expected": expected})
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    def _effective_context(self) -> Optional[Dict[str, Any]]:
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return None
+        eff: Dict[str, Any] = {"bucket": None, "backend": None,
+                               "where": None, "expected": True}
+        for frame in stack:
+            for key, value in frame.items():
+                if value is not None:
+                    eff[key] = value
+        return eff
+
+    # -- warm-up lifecycle ----------------------------------------------
+    def mark_warmup_complete(self) -> None:
+        with self._lock:
+            self._warmed = True
+
+    @property
+    def warmup_complete(self) -> bool:
+        with self._lock:
+            return self._warmed
+
+    def reset(self) -> None:
+        """Back to the un-warmed state with empty rings and zeroed totals
+        (tests; a rebuilt scorer re-runs its warm-up and re-marks). The
+        Prometheus counters are cumulative by contract and stay untouched."""
+        with self._lock:
+            self._warmed = False
+            self._events.clear()
+            self._spans.clear()
+            self._totals = {"compiles": 0, "seconds": 0.0, "unexpected": 0}
+            self._last_unexpected_mono = None
+            self._recent_unexpected.clear()
+
+    # -- recording -------------------------------------------------------
+    def _compile_counters(self, bucket: str, backend: str) -> tuple:
+        pair = self._compile_children.get((bucket, backend))
+        if pair is None:
+            labels = dict(self._labels, bucket=bucket, backend=backend)
+            pair = (m.XLA_COMPILES().labels(**labels),
+                    m.XLA_COMPILE_SECONDS().labels(**labels))
+            self._compile_children[(bucket, backend)] = pair
+        return pair
+
+    def record_compile(self, duration_s: float,
+                       bucket: Optional[int] = None,
+                       backend: Optional[str] = None,
+                       where: Optional[str] = None,
+                       expected: Optional[bool] = None) -> Dict[str, Any]:
+        """Record one backend compile. Normally driven by the monitoring
+        listener (attribution from the thread-local context); the explicit
+        keyword arguments are the injection seam for tests."""
+        eff = self._effective_context()
+        attributed = eff is not None or bucket is not None
+        if eff is None:
+            eff = {"bucket": None, "backend": None, "where": None,
+                   "expected": True}
+        if bucket is not None:
+            eff["bucket"] = bucket
+        if backend is not None:
+            eff["backend"] = backend
+        if where is not None:
+            eff["where"] = where
+        if expected is not None:
+            eff["expected"] = expected
+        bucket_s = "?" if eff["bucket"] is None else str(eff["bucket"])
+        backend_s = eff["backend"] or _default_backend()
+        where_s = eff["where"] or ("unattributed" if attributed else "external")
+        event: Dict[str, Any]
+        with self._lock:
+            self._seq += 1
+            phase = "runtime" if self._warmed else "warmup"
+            unexpected = bool(self._warmed and attributed
+                              and not eff["expected"])
+            self._totals["compiles"] += 1
+            self._totals["seconds"] += float(duration_s)
+            compiles_c, seconds_c = self._compile_counters(bucket_s, backend_s)
+            event = {
+                "seq": self._seq,
+                "ts": round(time.time(), 6),
+                "bucket": bucket_s,
+                "backend": backend_s,
+                "seconds": round(float(duration_s), 6),
+                "where": where_s,
+                "phase": phase,
+                "unexpected": unexpected,
+            }
+            unexpected_c = None
+            if unexpected:
+                self._totals["unexpected"] += 1
+                now = time.monotonic()
+                self._last_unexpected_mono = now
+                self._recent_unexpected.append(now)
+                if self._unexpected_child is None:
+                    self._unexpected_child = (
+                        m.XLA_RECOMPILES_UNEXPECTED().labels(**self._labels))
+                unexpected_c = self._unexpected_child
+            monitor = self.monitor
+            emit = unexpected and self._emit_events and monitor is not None
+            self._events.append(event)
+        compiles_c.inc()
+        seconds_c.inc(float(duration_s))
+        if unexpected_c is not None:
+            unexpected_c.inc()
+        if emit:
+            # outside the ledger lock: the monitor fans out to the event
+            # ring and the logger, neither of which may nest under it
+            monitor.emit_event(dict(event, kind="unexpected_recompile"))
+        return event
+
+    def record_span(self, bucket: int, real: int, path: str,
+                    queue_wait_s: float, device_s: float,
+                    trace_id: Optional[str] = None) -> None:
+        """One drained device batch: the span the flight recorder's trace id
+        links back to (PR-1 `/admin/trace` ↔ this batch)."""
+        with self._lock:
+            self._span_seq += 1
+            self._spans.append({
+                "seq": self._span_seq,
+                "ts": round(time.time(), 6),
+                "bucket": int(bucket),
+                "real": int(real),
+                "occupancy": round(int(real) / max(1, int(bucket)), 4),
+                "path": path,
+                "queue_wait_s": round(float(queue_wait_s), 6),
+                "device_s": round(float(device_s), 6),
+                "trace_id": trace_id,
+            })
+
+    # -- reads -----------------------------------------------------------
+    def unexpected_in_window(self, window_s: Optional[float] = None,
+                             now: Optional[float] = None) -> int:
+        window = self._storm_window_s if window_s is None else window_s
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sum(1 for t in self._recent_unexpected
+                       if now - t <= window)
+
+    def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /admin/xla`` document."""
+        with self._lock:
+            events = list(self._events)
+            spans = list(self._spans)
+            totals = dict(self._totals)
+            totals["seconds"] = round(totals["seconds"], 6)
+            warmed = self._warmed
+        if limit is not None and limit >= 0:
+            events = events[-limit:]
+            spans = spans[-limit:]
+        return {
+            "warmup_complete": warmed,
+            "totals": totals,
+            "compiles": events,
+            "batches": spans,
+        }
+
+
+class RecompileStormCheck:
+    """Watchdog check: degraded while unexpected recompiles are recent.
+
+    Only reports for the monitor the ledger is currently bound to — a
+    monitor from an earlier Service in the same process (tests build many)
+    keeps the check object but it evaluates to PASS, so a storm can never be
+    blamed on a component that did not dispatch the batch."""
+
+    name = "xla_recompile_storm"
+
+    def __init__(self, ledger: CompileLedger, monitor,
+                 window_s: float = RECOMPILE_STORM_WINDOW_S) -> None:
+        self._ledger = ledger
+        self._monitor = monitor
+        self._window_s = window_s
+
+    def evaluate(self, now: float) -> Tuple[str, str]:
+        if self._ledger.monitor is not self._monitor:
+            return PASS, "ledger bound to another service"
+        recent = self._ledger.unexpected_in_window(self._window_s)
+        if recent:
+            return DEGRADED, (
+                f"{recent} unexpected XLA recompile(s) in the last "
+                f"{self._window_s:.0f}s — see GET /admin/xla")
+        return PASS, "no unexpected recompiles"
+
+
+# ---------------------------------------------------------------------------
+# process-wide ledger + the (single) jax.monitoring listener
+# ---------------------------------------------------------------------------
+_ACTIVE = CompileLedger()
+_INSTALL_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+
+def get_ledger() -> CompileLedger:
+    return _ACTIVE
+
+
+def activate(ledger: CompileLedger) -> CompileLedger:
+    """Swap the ledger the process-wide listener feeds (tests); returns the
+    previous one so callers can restore it."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, ledger
+    return prev
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    if event != COMPILE_EVENT:
+        return
+    try:
+        _ACTIVE.record_compile(duration)
+    except Exception:  # noqa: BLE001 — telemetry must never break a compile
+        pass
+
+
+def install_listener() -> bool:
+    """Register the compile listener with jax.monitoring (idempotent; once
+    per process). Returns False when jax is unavailable."""
+    global _LISTENER_INSTALLED
+    with _INSTALL_LOCK:
+        if _LISTENER_INSTALLED:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _LISTENER_INSTALLED = True
+        return True
+
+
+def _default_backend() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — jax absent or not yet initialized
+        return "unknown"
+
+
+# ---------------------------------------------------------------------------
+# HBM gauges
+# ---------------------------------------------------------------------------
+_HBM_LOCK = threading.Lock()
+_HBM_EXPORTED: set = set()
+
+# jax Device.memory_stats() key → exported `kind` label value
+_HBM_KINDS = (("in_use", "bytes_in_use"), ("limit", "bytes_limit"))
+
+
+def _hbm_reader(device, stat_key: str):
+    def read() -> float:
+        try:
+            stats = device.memory_stats()
+        except Exception:  # noqa: BLE001 — a dead device must not kill the scrape
+            return 0.0
+        return float((stats or {}).get(stat_key, 0.0))
+
+    return read
+
+
+def export_hbm_gauges(labels: Dict[str, str]) -> int:
+    """Export ``device_hbm_bytes{device,kind}`` for every local device whose
+    backend reports memory stats, computed at scrape time. Returns how many
+    devices export (0 on CPU, whose ``memory_stats()`` is ``None``)."""
+    try:
+        import jax
+    except ImportError:
+        return 0
+    exported = 0
+    for device in jax.local_devices():
+        try:
+            stats = device.memory_stats()
+        except Exception:  # noqa: BLE001 — probe failure == no stats
+            stats = None
+        if not stats:
+            continue
+        exported += 1
+        key = (tuple(sorted(labels.items())), str(device))
+        with _HBM_LOCK:
+            if key in _HBM_EXPORTED:
+                continue
+            _HBM_EXPORTED.add(key)
+        for kind, stat_key in _HBM_KINDS:
+            m.DEVICE_HBM().labels(device=str(device), kind=kind,
+                                  **labels).set_function(
+                _hbm_reader(device, stat_key))
+    return exported

@@ -440,6 +440,30 @@ class HealthMonitor:
         self._effective[name] = status
         return status, detail
 
+    def emit_event(self, event: Dict[str, Any],
+                   level: int = logging.WARNING) -> Dict[str, Any]:
+        """Public seam for subsystems (e.g. the device-observability compile
+        ledger) to emit a structured event with this service's identity and
+        the flight recorder's last trace id attached — ring + logger, the
+        same fan-out health transitions get. Takes no monitor lock, so it is
+        safe to call from any thread, including under other locks."""
+        doc: Dict[str, Any] = {
+            "component_type": self._labels.get("component_type"),
+            "component_id": self._labels.get("component_id"),
+            "stage": self._stage,
+        }
+        doc.update(event)
+        recorder = self.trace_recorder
+        if recorder is not None and "trace_id" not in doc:
+            doc["trace_id"] = getattr(recorder, "last_trace_id", None)
+        if self._events is not None:
+            self._events.emit(doc)
+        if self._logger is not None:
+            self._logger.log(level, "event %s: %s",
+                             doc.get("kind", "unknown"), doc,
+                             extra={"dm_event": doc})
+        return doc
+
     def _emit_transition(self, check: str, old: str, new: str,
                          detail: str) -> None:
         trace_id = None

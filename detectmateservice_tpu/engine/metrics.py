@@ -175,3 +175,77 @@ BUILD_INFO = _series(
     "native kernels' feature versions",
     BUILD_INFO_LABELS,
 )
+
+# device-side observability (engine/device_obs.py): the XLA compile ledger
+# attributes every backend compile to the dispatch bucket that triggered it
+# (few compiled shapes is the TPU-serving contract — SURVEY.md hard part #2),
+# and flags compiles that happen on the dispatch path AFTER warm-up completed
+# as unexpected recompiles, the RecompileStorm alert signal.
+XLA_LABELS = ("component_type", "component_id", "bucket", "backend")
+XLA_COMPILES = _series(
+    Counter,
+    "scorer_xla_compiles_total",
+    "XLA backend compiles, attributed to the batch bucket that triggered them",
+    XLA_LABELS,
+)
+XLA_COMPILE_SECONDS = _series(
+    Counter,
+    "scorer_xla_compile_seconds_total",
+    "Wall seconds spent in XLA backend compiles per bucket",
+    XLA_LABELS,
+)
+XLA_RECOMPILES_UNEXPECTED = _series(
+    Counter,
+    "scorer_xla_recompiles_unexpected_total",
+    "Compiles on the dispatch path after warm-up completed — each one "
+    "stalls the engine loop for the full compile; a nonzero rate is a "
+    "recompile storm (ops/alerts.yml RecompileStorm)",
+)
+# HBM residency, refreshed AT SCRAPE TIME (Gauge.set_function bound to
+# jax Device.memory_stats) — absent on backends without memory stats (CPU)
+HBM_LABELS = ("component_type", "component_id", "device", "kind")
+DEVICE_HBM = _series(
+    Gauge,
+    "device_hbm_bytes",
+    "Device memory from jax Device.memory_stats(), kind=in_use|limit, "
+    "read at scrape time",
+    HBM_LABELS,
+)
+
+# per-dispatch batch telemetry (library/detectors/jax_scorer.py): occupancy
+# is real rows / padded bucket rows (padding waste is 1 - occupancy); the
+# queue-wait vs device-time split attributes each batch's latency to host
+# queueing (upload workers / fit backlog) vs device compute + readback, with
+# the host-CPU-twin path and the accelerator path as separate label values.
+PATH_LABELS = ("component_type", "component_id", "path")
+BATCH_OCCUPANCY = _series(
+    Histogram,
+    "detector_batch_occupancy",
+    "Real rows / padded bucket size per dispatched batch (1.0 = no padding)",
+    PATH_LABELS,
+    buckets=(0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+)
+BATCH_QUEUE_WAIT = _series(
+    Histogram,
+    "detector_queue_wait_seconds",
+    "Dispatch-call to scoring-call-start wait per batch (worker queue / "
+    "inline ~0)",
+    PATH_LABELS,
+    buckets=_DWELL_BUCKETS,
+)
+BATCH_DEVICE_SECONDS = _series(
+    Histogram,
+    "detector_device_seconds",
+    "Scoring-call start to host-readable scores per batch (device compute "
+    "+ readback on the device path; synchronous compute on the host path)",
+    PATH_LABELS,
+    buckets=_DWELL_BUCKETS,
+)
+BUCKET_LABELS = ("component_type", "component_id", "bucket", "path")
+BUCKET_SELECTED = _series(
+    Counter,
+    "detector_bucket_selected_total",
+    "Dispatches per compile bucket and scoring path (host CPU twin vs "
+    "accelerator)",
+    BUCKET_LABELS,
+)

@@ -131,11 +131,18 @@ class _InflightSlot:
     ``error`` is populated. Inline dispatch fills the slot before it is
     appended; the upload worker fills it after — but the slot joins
     ``_inflight`` at dispatch-call time either way, so output order is the
-    dispatch order regardless of which thread ran the jax calls."""
+    dispatch order regardless of which thread ran the jax calls.
 
-    __slots__ = ("scores", "raws", "real", "error", "done")
+    Telemetry fields (engine/device_obs.py batch spans): ``t_enqueue`` is
+    dispatch-call time, ``t_start`` when the scoring call actually began
+    (worker pickup), ``trace_id`` the flight recorder's last completed
+    trace at dispatch — the link from a device batch back to PR-1 traces."""
 
-    def __init__(self, raws, real: int):
+    __slots__ = ("scores", "raws", "real", "error", "done",
+                 "t_enqueue", "t_start", "bucket", "path", "trace_id")
+
+    def __init__(self, raws, real: int, bucket: int = 0,
+                 path: str = "device", trace_id: Optional[str] = None):
         import threading
 
         self.scores = None
@@ -143,6 +150,11 @@ class _InflightSlot:
         self.real = real
         self.error: Optional[Exception] = None
         self.done = threading.Event()
+        self.t_enqueue = time.monotonic()
+        self.t_start: Optional[float] = None
+        self.bucket = bucket
+        self.path = path
+        self.trace_id = trace_id
 
 
 class JaxScorerDetector(CoreDetector):
@@ -191,6 +203,15 @@ class JaxScorerDetector(CoreDetector):
         self._ready_supported: Optional[bool] = None   # jax.Array.is_ready seen?
         self._metrics_labels = None
         self._feat_counters = None  # (native_rows, fallback_rows) label pair
+        # device observability (engine/device_obs.py): the process-wide XLA
+        # compile ledger (set in _ensure_scorer) plus cached label children
+        # for the per-dispatch batch telemetry — occupancy, bucket
+        # selection, queue-wait vs device-time (one .labels() hash per
+        # (path) / (bucket, path), never per batch)
+        self._ledger = None
+        self._obs_backend = "unknown"
+        self._batch_obs: Dict[str, tuple] = {}
+        self._bucket_children: Dict[tuple, Any] = {}
         if self.config.featurize_threads > 0:
             kern = self._matchkern()
             if kern is not None:
@@ -253,23 +274,32 @@ class JaxScorerDetector(CoreDetector):
         # (the host twin warms its own buckets at fit time)
         host_path = self._cpu_device is not None
         small = () if host_path else (1, 8)
-        for b in (*small, self.config.train_batch_size, self.config.max_batch):
-            bucket = _bucket(b, self.config.max_batch)
-            tokens = np.zeros((bucket, self.config.seq_len), np.int32)
+        # compiles in here are the expected warm-up set; after
+        # mark_warmup_complete any dispatch-path compile is an unexpected
+        # recompile (engine/device_obs.py — the RecompileStorm signal)
+        with self._ledger.context(where="warmup", backend=self._obs_backend,
+                                  expected=True):
+            for b in (*small, self.config.train_batch_size, self.config.max_batch):
+                bucket = _bucket(b, self.config.max_batch)
+                tokens = np.zeros((bucket, self.config.seq_len), np.int32)
+                with self._ledger.context(bucket=bucket):
+                    if position:
+                        self._norm_mu, self._norm_sigma = (
+                            np.zeros_like(dummy_stats), dummy_stats)
+                        try:
+                            jax.block_until_ready(self._score_dev(tokens))
+                        finally:
+                            self._norm_mu = self._norm_sigma = None
+                    else:
+                        jax.block_until_ready(self._score_dev(tokens))
             if position:
-                self._norm_mu, self._norm_sigma = (np.zeros_like(dummy_stats),
-                                                   dummy_stats)
-                try:
-                    jax.block_until_ready(self._score_dev(tokens))
-                finally:
-                    self._norm_mu = self._norm_sigma = None
-            else:
-                jax.block_until_ready(self._score_dev(tokens))
-        if position:
-            # fit's calibration pass runs token_nlls at the train bucket
-            bucket = _bucket(self.config.train_batch_size, self.config.max_batch)
-            tokens = np.zeros((bucket, self.config.seq_len), np.int32)
-            jax.block_until_ready(self._token_nlls_dev(tokens))
+                # fit's calibration pass runs token_nlls at the train bucket
+                bucket = _bucket(self.config.train_batch_size,
+                                 self.config.max_batch)
+                tokens = np.zeros((bucket, self.config.seq_len), np.int32)
+                with self._ledger.context(bucket=bucket):
+                    jax.block_until_ready(self._token_nlls_dev(tokens))
+        self._ledger.mark_warmup_complete()
 
     def _ensure_scorer(self) -> None:
         if self._scorer is not None:
@@ -282,6 +312,13 @@ class JaxScorerDetector(CoreDetector):
         from ...utils.profiling import enable_compilation_cache
 
         enable_compilation_cache()
+        # XLA compile ledger: the jax.monitoring listener installs once per
+        # process; this detector's jit call sites wrap themselves in ledger
+        # contexts so every compile attributes to a (bucket, trigger) pair
+        from ...engine import device_obs
+
+        self._ledger = device_obs.get_ledger()
+        device_obs.install_listener()
         cfg = self.config
         self._validate_static_config()
         import jax.numpy as jnp
@@ -335,6 +372,8 @@ class JaxScorerDetector(CoreDetector):
             mesh = make_mesh(dict(cfg.mesh_shape))
             self._sharded = ShardedScorer(self._scorer, mesh=mesh, rng=self._rng)
             self._device = f"mesh({','.join(f'{k}={v}' for k, v in mesh.shape.items())})"
+            self._obs_backend = "mesh"
+            device_obs.export_hbm_gauges(self._obs_labels())
             return
         devices = jax.devices()
         self._device = devices[0]
@@ -343,6 +382,8 @@ class JaxScorerDetector(CoreDetector):
                 if str(d).lower().startswith(cfg.device.lower()):
                     self._device = d
                     break
+        self._obs_backend = getattr(self._device, "platform", "unknown")
+        device_obs.export_hbm_gauges(self._obs_labels())
         params, opt_state = self._scorer.init(self._rng)
         # params pinned in device memory once (HBM residency; north-star item)
         self._params = jax.device_put(params, self._device)
@@ -411,8 +452,10 @@ class JaxScorerDetector(CoreDetector):
         # device path, so the engine loop never blocks on a host compile
         cap = self.config.host_score_max_batch
         try:
-            jax.block_until_ready(self._score_host(
-                np.zeros((1, self.config.seq_len), np.int32)))
+            with self._ledger.context(bucket=1, backend="cpu",
+                                      where="host_warm", expected=True):
+                jax.block_until_ready(self._score_host(
+                    np.zeros((1, self.config.seq_len), np.int32)))
             self._host_warm.add(1)
         except Exception:
             self._host_params = None
@@ -427,8 +470,13 @@ class JaxScorerDetector(CoreDetector):
                 sizes.append(cap)
             for size in sizes:
                 try:
-                    jax.block_until_ready(self._score_host(
-                        np.zeros((size, self.config.seq_len), np.int32)))
+                    # own thread → own context stack; these compiles are the
+                    # planned host-bucket warm set, never recompile storms
+                    with self._ledger.context(bucket=size, backend="cpu",
+                                              where="host_warm",
+                                              expected=True):
+                        jax.block_until_ready(self._score_host(
+                            np.zeros((size, self.config.seq_len), np.int32)))
                     self._host_warm.add(size)
                 except Exception:
                     return
@@ -532,6 +580,14 @@ class JaxScorerDetector(CoreDetector):
     def fit(self) -> Dict[str, float]:
         """Train on the buffered normal traffic, calibrate the threshold."""
         self._ensure_scorer()
+        # the boundary fit legitimately compiles (train step, calibration
+        # buckets) after warm-up — attributed here so it never counts as an
+        # unexpected recompile
+        with self._ledger.context(where="fit", backend=self._obs_backend,
+                                  expected=True):
+            return self._fit_impl()
+
+    def _fit_impl(self) -> Dict[str, float]:
         import jax
 
         cfg = self.config
@@ -602,7 +658,13 @@ class JaxScorerDetector(CoreDetector):
             if len(chunk) < bucket:
                 pad = np.zeros((bucket - len(chunk), tokens.shape[1]), np.int32)
                 chunk = np.concatenate([chunk, pad])
-            scores = np.asarray(self._score_dev(chunk))
+            # single-message parity path: compiles attribute to "detect" and
+            # stay expected — the storm detector watches the batched
+            # dispatch path, not per-message scoring
+            with self._ledger.context(bucket=bucket, where="detect",
+                                      backend=self._obs_backend,
+                                      expected=True):
+                scores = np.asarray(self._score_dev(chunk))
             out[start:start + min(bucket, n - start)] = scores[: min(bucket, n - start)]
         return out
 
@@ -977,9 +1039,19 @@ class JaxScorerDetector(CoreDetector):
                 if n < bucket:
                     chunk = np.concatenate(
                         [tokens, np.zeros((bucket - n, tokens.shape[1]), np.int32)])
-                slot = _InflightSlot(list(msgs), n)
-                slot.scores = np.asarray(self._score_host(chunk))[:n]
+                slot = _InflightSlot(list(msgs), n, bucket=bucket,
+                                     path="host",
+                                     trace_id=self._current_trace_id())
+                slot.t_start = time.monotonic()
+                # only warmed host buckets reach here, so a compile in this
+                # context IS an unexpected recompile (a warm-set bug)
+                with self._ledger.context(bucket=bucket, backend="cpu",
+                                          where="host", expected=False):
+                    slot.scores = np.asarray(self._score_host(chunk))[:n]
                 slot.done.set()
+                # synchronous path: scores are host-readable now — record
+                # the span/occupancy here, not at drain
+                self._observe_batch(slot, time.monotonic() - slot.t_start)
                 self._inflight.append(slot)
                 return
         bucket = _bucket(n, self.config.max_batch)
@@ -993,18 +1065,24 @@ class JaxScorerDetector(CoreDetector):
                 chunk = np.concatenate(
                     [chunk, np.zeros((bucket - real, tokens.shape[1]), np.int32)]
                 )
-            slot = _InflightSlot(msgs[start:start + real], real)
+            slot = _InflightSlot(msgs[start:start + real], real,
+                                 bucket=bucket, path="device",
+                                 trace_id=self._current_trace_id())
             self._inflight.append(slot)
             if use_workers:
                 self._upload_queue.put((slot, chunk))
             else:
                 # inline: fill before returning; dispatch errors propagate
                 # to the caller exactly as before
-                slot.scores = self._score_dev(chunk)
-                try:
-                    slot.scores.copy_to_host_async()
-                except AttributeError:
-                    pass
+                slot.t_start = time.monotonic()
+                with self._ledger.context(bucket=bucket,
+                                          backend=self._obs_backend,
+                                          where="dispatch", expected=False):
+                    slot.scores = self._score_dev(chunk)
+                    try:
+                        slot.scores.copy_to_host_async()
+                    except AttributeError:
+                        pass
                 slot.done.set()
 
     def _ensure_upload_workers(self) -> None:
@@ -1038,12 +1116,16 @@ class JaxScorerDetector(CoreDetector):
             if self._dispatch_hb is not None:
                 self._dispatch_hb.beat()
             slot, chunk = item
+            slot.t_start = time.monotonic()  # queue wait ends here
             try:
-                scores = self._score_dev(chunk)
-                try:
-                    scores.copy_to_host_async()
-                except AttributeError:
-                    pass
+                with self._ledger.context(bucket=slot.bucket,
+                                          backend=self._obs_backend,
+                                          where="dispatch", expected=False):
+                    scores = self._score_dev(chunk)
+                    try:
+                        scores.copy_to_host_async()
+                    except AttributeError:
+                        pass
                 slot.scores = scores
             except Exception as exc:  # noqa: BLE001 — containment boundary
                 slot.error = exc
@@ -1071,6 +1153,12 @@ class JaxScorerDetector(CoreDetector):
             return []
         raws, real = slot.raws, slot.real
         scores = np.asarray(slot.scores)[:real]
+        if slot.path != "host":
+            # np.asarray above forced the readback: scoring-call start →
+            # now is the batch's device compute + readback time (the host
+            # path recorded its synchronous span at dispatch)
+            start = slot.t_start if slot.t_start is not None else slot.t_enqueue
+            self._observe_batch(slot, time.monotonic() - start)
         threshold = self._threshold if self._threshold is not None else float("inf")
         out: List[Optional[bytes]] = []
         hits = np.flatnonzero(scores > threshold)
@@ -1187,6 +1275,55 @@ class JaxScorerDetector(CoreDetector):
             )
         m.DEVICE_LINES().labels(**self._metrics_labels).inc(n)
         m.DEVICE_BATCHES().labels(**self._metrics_labels).inc()
+
+    # -- device observability (engine/device_obs.py) ---------------------
+    def _obs_labels(self) -> Dict[str, str]:
+        return dict(component_type=self.config.method_type,
+                    component_id=self.name)
+
+    def _current_trace_id(self) -> Optional[str]:
+        """Flight recorder's last completed trace id (the PR-1 link a
+        device-batch span carries), or None off a traced pipeline."""
+        monitor = self.health_monitor
+        recorder = (getattr(monitor, "trace_recorder", None)
+                    if monitor is not None else None)
+        return (getattr(recorder, "last_trace_id", None)
+                if recorder is not None else None)
+
+    def _observe_batch(self, slot: "_InflightSlot",
+                       device_s: float) -> None:
+        """Per-dispatch batch telemetry, recorded when a batch's scores
+        become host-readable: occupancy (real/bucket — 1 minus padding
+        waste), bucket selection, and the queue-wait vs device-time split,
+        attributed to the host or device path; plus a span in the compile
+        ledger carrying the dispatch-time trace id."""
+        from ...engine import metrics as m
+
+        bucket, path = slot.bucket, slot.path
+        if bucket <= 0:
+            return
+        t_start = slot.t_start if slot.t_start is not None else slot.t_enqueue
+        queue_wait_s = max(0.0, t_start - slot.t_enqueue)
+        children = self._batch_obs.get(path)
+        if children is None:
+            labels = dict(self._obs_labels(), path=path)
+            children = (m.BATCH_OCCUPANCY().labels(**labels),
+                        m.BATCH_QUEUE_WAIT().labels(**labels),
+                        m.BATCH_DEVICE_SECONDS().labels(**labels))
+            self._batch_obs[path] = children
+        occ_h, wait_h, dev_h = children
+        occ_h.observe(slot.real / bucket)
+        wait_h.observe(queue_wait_s)
+        dev_h.observe(max(0.0, device_s))
+        bucket_child = self._bucket_children.get((bucket, path))
+        if bucket_child is None:
+            bucket_child = m.BUCKET_SELECTED().labels(
+                bucket=str(bucket), path=path, **self._obs_labels())
+            self._bucket_children[(bucket, path)] = bucket_child
+        bucket_child.inc()
+        if self._ledger is not None:
+            self._ledger.record_span(bucket, slot.real, path, queue_wait_s,
+                                     max(0.0, device_s), slot.trace_id)
 
     # -- runtime reconfigure (POST /admin/reconfigure end-to-end) --------
     def validate_reconfigure(self, new_config) -> None:

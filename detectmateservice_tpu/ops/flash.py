@@ -52,7 +52,11 @@ try:  # pallas import kept lazy-tolerant: CPU-only deployments skip the kernel
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    _PALLAS_OK = True
+    # jax renamed TPUCompilerParams -> CompilerParams (~0.5); support both so
+    # the kernels run on this image's 0.4.x AND current jax
+    _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None)
+    _PALLAS_OK = _COMPILER_PARAMS is not None
 except Exception:  # pragma: no cover - environment without pallas
     _PALLAS_OK = False
 
@@ -203,7 +207,7 @@ def _flash_forward(q, k, v, key_mask, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max
             pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -324,7 +328,7 @@ def _flash_bwd(block_q, block_k, interpret, residuals, g):
         out_specs=pl.BlockSpec((1, bq, d), lambda bh_, i, j: (bh_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(bias, qr, kr, vr, dor, lse, deltar)
@@ -349,7 +353,7 @@ def _flash_bwd(block_q, block_k, interpret, residuals, g):
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(bias, qr, kr, vr, dor, lse, deltar)

@@ -43,7 +43,11 @@ try:  # pallas import kept lazy-tolerant: CPU-only deployments skip the kernel
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    _PALLAS_OK = True
+    # jax renamed TPUCompilerParams -> CompilerParams (~0.5); support both so
+    # the kernels run on this image's 0.4.x AND current jax
+    _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None)
+    _PALLAS_OK = _COMPILER_PARAMS is not None
 except Exception:  # pragma: no cover - environment without pallas
     _PALLAS_OK = False
 
@@ -125,7 +129,7 @@ def candidate_lse(hidden: jax.Array, emb_c: jax.Array,
             pltpu.VMEM((block_n, 128), jnp.float32),  # running max
             pltpu.VMEM((block_n, 128), jnp.float32),  # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..engine import device_obs
 from ..models.tokenizer import narrow_tokens
 from .mesh import (
     AXIS_DATA,
@@ -68,14 +69,18 @@ class ShardedScorer:
         # flax init runs on a [1, S] dummy, and a batch of 1 cannot shard
         # over a data axis of 2+
         init_rng = rng if rng is not None else jax.random.PRNGKey(0)
-        if self._seq_axis is None:
-            params, opt_state = scorer.init(init_rng)
-        else:
-            from ..ops.attention import ring_context
-
-            with ring_context(self.mesh, batch_axis=None,
-                              axis_name=self._seq_axis):
+        # construction-time tracing/compiles attribute to the mesh init —
+        # always an expected phase, whatever context the caller holds
+        with device_obs.get_ledger().context(where="sharded_init",
+                                             backend="mesh", expected=True):
+            if self._seq_axis is None:
                 params, opt_state = scorer.init(init_rng)
+            else:
+                from ..ops.attention import ring_context
+
+                with ring_context(self.mesh, batch_axis=None,
+                                  axis_name=self._seq_axis):
+                    params, opt_state = scorer.init(init_rng)
         self._param_sharding = tree_shardings(self.mesh, params, rules)
         self._opt_sharding = tree_shardings(self.mesh, opt_state, rules)
         self.params = jax.device_put(params, self._param_sharding)
@@ -112,17 +117,24 @@ class ShardedScorer:
     def data_parallelism(self) -> int:
         return int(self.mesh.shape.get(AXIS_DATA, 1))
 
-    def _traced(self, fn, *args):
+    def _traced(self, fn, *args, bucket: Optional[int] = None):
         """Invoke a jitted fn; on a seq mesh, tracing happens inside
         ring_context so the model's ``attention(impl="ring")`` resolves to
-        this mesh. Trace-time only: cached executions skip the context."""
-        if self._seq_axis is None:
-            return fn(*args)
-        from ..ops.attention import ring_context
+        this mesh. Trace-time only: cached executions skip the context.
 
-        with ring_context(self.mesh, batch_axis=self._data_axis,
-                          axis_name=self._seq_axis):
-            return fn(*args)
+        Compiles fired here attribute to the padded batch bucket on the
+        mesh backend (engine/device_obs.py); ``expected`` is inherited from
+        the caller — the detector's dispatch path marks itself
+        unexpected-after-warm-up, its fit/warm-up paths expected."""
+        with device_obs.get_ledger().context(bucket=bucket, backend="mesh",
+                                             where="sharded"):
+            if self._seq_axis is None:
+                return fn(*args)
+            from ..ops.attention import ring_context
+
+            with ring_context(self.mesh, batch_axis=self._data_axis,
+                              axis_name=self._seq_axis):
+                return fn(*args)
 
     def _pad_batch(self, tokens: np.ndarray) -> Tuple[np.ndarray, int]:
         """Pad the batch to a multiple of the data-axis size (and narrow to
@@ -138,7 +150,8 @@ class ShardedScorer:
     def score(self, tokens: np.ndarray) -> np.ndarray:
         tokens, n = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return np.asarray(self._traced(self._score, self.params, tokens))[:n]
+        return np.asarray(self._traced(self._score, self.params, tokens,
+                                       bucket=len(tokens)))[:n]
 
     def score_device(self, tokens: np.ndarray) -> jax.Array:
         """Asynchronous scoring: dispatch and return the device array without
@@ -147,19 +160,22 @@ class ShardedScorer:
         overlap readback with the next batch's featurization."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return self._traced(self._score, self.params, tokens)
+        return self._traced(self._score, self.params, tokens,
+                            bucket=tokens.shape[0])
 
     def token_nlls_device(self, tokens: np.ndarray) -> jax.Array:
         """[n, S] → [n_padded, S] per-position NLLs on device."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return self._traced(self._token_nlls, self.params, tokens)
+        return self._traced(self._token_nlls, self.params, tokens,
+                            bucket=tokens.shape[0])
 
     def normscore_device(self, tokens: np.ndarray, mu, sigma) -> jax.Array:
         """Per-position-normalized scores (models.logbert.positional_z_max)."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
-        return self._traced(self._normscore, self.params, tokens, mu, sigma)
+        return self._traced(self._normscore, self.params, tokens, mu, sigma,
+                            bucket=tokens.shape[0])
 
     def train_step(self, rng: jax.Array, tokens: np.ndarray) -> float:
         # pad by wrapping real rows, NOT zeros: synthetic all-PAD rows would
@@ -177,6 +193,7 @@ class ShardedScorer:
         tokens = jax.device_put(narrow_tokens(tokens, self._vocab_size),
                                 self._batch_sharding)
         self.params, self.opt_state, loss = self._traced(
-            self._train, self.params, self.opt_state, rng, tokens
+            self._train, self.params, self.opt_state, rng, tokens,
+            bucket=tokens.shape[0]
         )
         return float(loss)

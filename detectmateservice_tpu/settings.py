@@ -210,7 +210,18 @@ class ServiceSettings(BaseModel):
     # component state checkpointing (core.py): restore at setup_io when a
     # checkpoint exists, save at clean shutdown and on POST /admin/checkpoint
     checkpoint_dir: Optional[str] = None
+    # on-demand jax.profiler capture (POST /admin/profile): captures land in
+    # numbered subdirectories of profile_dir (default: a per-process dir
+    # under the system temp dir), pruned to the newest profile_max_captures
+    # so a capture-happy operator cannot fill the disk
     profile_dir: Optional[str] = None
+    profile_max_captures: int = Field(default=4, ge=1, le=64)
+    # device observability (engine/device_obs.py): when true, a compile on
+    # the dispatch path after warm-up completes emits an unexpected_recompile
+    # structured event and arms the xla_recompile_storm watchdog check (the
+    # scorer_xla_recompiles_unexpected_total counter feeding the
+    # RecompileStorm alert moves either way)
+    recompile_alert_enabled: bool = True
     # multi-host chip plane (parallel/distributed.py): when a coordinator is
     # set, jax.distributed joins this process's devices into the global mesh
     # (ICI within a pod, DCN across pods). Env (via the standard settings

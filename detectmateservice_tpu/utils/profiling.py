@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 
 _cache_enabled = False
@@ -95,18 +95,183 @@ def enable_compilation_cache(path: str = "") -> None:
             pass
 
 
-def capture_trace(out_dir: str, duration_ms: int = 1000) -> Dict[str, Any]:
-    """Record a jax.profiler trace for ``duration_ms`` into ``out_dir``.
+class ProfileError(ValueError):
+    """On-demand profiler capture failure (ValueError so the admin layer
+    maps bad capture parameters to HTTP 400, not 500)."""
 
-    Runs on a background thread so the admin HTTP call returns immediately.
+
+class ProfileBusyError(ProfileError):
+    """A capture is already running in this process (jax.profiler allows at
+    most one trace at a time; the admin route surfaces this as HTTP 409)."""
+
+
+_CAPTURE_PREFIX = "capture-"
+_DONE_MARKER = "capture.json"
+MAX_CAPTURE_SECONDS = 300.0
+
+
+class ProfileManager:
+    """Bounded, concurrency-guarded ``jax.profiler`` captures.
+
+    ``POST /admin/profile`` calls :meth:`start`: one capture per process at
+    a time (the guard, not jax's crash), each landing in its own numbered
+    ``capture-NNNN`` subdirectory of the configured ``profile_dir``, pruned
+    to the newest ``max_captures`` so repeated captures cannot fill the
+    disk. A finished capture writes a ``capture.json`` marker — only marked
+    directories count as downloadable, so ``GET /admin/profile/latest``
+    never serves a half-written trace.
     """
-    import jax
 
-    def _run() -> None:
-        jax.profiler.start_trace(out_dir)
-        time.sleep(duration_ms / 1000.0)
-        jax.profiler.stop_trace()
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._current: Optional[Dict[str, Any]] = None
+        self._last: Optional[Dict[str, Any]] = None
 
-    thread = threading.Thread(target=_run, name="ProfileTrace", daemon=True)
-    thread.start()
-    return {"detail": "trace started", "out_dir": out_dir, "duration_ms": duration_ms}
+    @staticmethod
+    def default_dir() -> str:
+        import os
+        import tempfile
+
+        return os.path.join(tempfile.gettempdir(),
+                            f"detectmate_profile_{os.getpid()}")
+
+    # -- capture ---------------------------------------------------------
+    def start(self, base_dir: str, seconds: float,
+              max_captures: int = 4) -> Dict[str, Any]:
+        import os
+
+        seconds = float(seconds)
+        if not 0.0 < seconds <= MAX_CAPTURE_SECONDS:
+            raise ProfileError(
+                f"seconds must be in (0, {MAX_CAPTURE_SECONDS:.0f}], "
+                f"got {seconds}")
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                raise ProfileBusyError(
+                    "a profiler capture is already running "
+                    f"({(self._current or {}).get('dir')})")
+            os.makedirs(base_dir, exist_ok=True)
+            seq = 1 + max((int(name[len(_CAPTURE_PREFIX):])
+                           for name in os.listdir(base_dir)
+                           if name.startswith(_CAPTURE_PREFIX)
+                           and name[len(_CAPTURE_PREFIX):].isdigit()),
+                          default=0)
+            out_dir = os.path.join(base_dir, f"{_CAPTURE_PREFIX}{seq:04d}")
+            os.makedirs(out_dir)
+            info: Dict[str, Any] = {
+                "state": "running",
+                "dir": out_dir,
+                "seq": seq,
+                "seconds": seconds,
+                "started_ts": round(time.time(), 6),
+            }
+            self._current = info
+            self._thread = threading.Thread(
+                target=self._run, args=(dict(info), base_dir, max_captures),
+                name="ProfileCapture", daemon=True)
+            self._thread.start()
+            return dict(info)
+
+    def _run(self, info: Dict[str, Any], base_dir: str,
+             max_captures: int) -> None:
+        import json
+        import os
+
+        import jax
+
+        try:
+            jax.profiler.start_trace(info["dir"])
+            time.sleep(info["seconds"])
+            jax.profiler.stop_trace()
+            info["state"] = "done"
+        except Exception as exc:  # noqa: BLE001 — a failed capture must report, not die silently
+            info["state"] = "error"
+            info["error"] = repr(exc)
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — trace may not have started
+                pass
+        info["finished_ts"] = round(time.time(), 6)
+        try:
+            with open(os.path.join(info["dir"], _DONE_MARKER), "w",
+                      encoding="utf-8") as fh:
+                json.dump(info, fh)
+        except OSError:
+            pass
+        with self._lock:
+            self._last = info
+            self._current = None
+        self._prune(base_dir, max_captures)
+
+    @staticmethod
+    def _prune(base_dir: str, max_captures: int) -> None:
+        import os
+        import shutil
+
+        try:
+            captures = sorted(
+                name for name in os.listdir(base_dir)
+                if name.startswith(_CAPTURE_PREFIX))
+        except OSError:
+            return
+        for name in captures[:max(0, len(captures) - max(1, max_captures))]:
+            shutil.rmtree(os.path.join(base_dir, name), ignore_errors=True)
+
+    # -- reads -----------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            running = (self._thread is not None and self._thread.is_alive())
+            return {
+                "running": running,
+                "current": dict(self._current) if self._current else None,
+                "last": dict(self._last) if self._last else None,
+            }
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the running capture (if any) finishes; True when no
+        capture is left running (tests / CI smoke)."""
+        with self._lock:
+            thread = self._thread
+        if thread is None:
+            return True
+        thread.join(timeout)
+        return not thread.is_alive()
+
+    def latest_dir(self, base_dir: str) -> Optional[str]:
+        """Newest *completed* capture directory under ``base_dir``."""
+        import os
+
+        try:
+            captures = sorted(
+                (name for name in os.listdir(base_dir)
+                 if name.startswith(_CAPTURE_PREFIX)), reverse=True)
+        except OSError:
+            return None
+        for name in captures:
+            path = os.path.join(base_dir, name)
+            if os.path.exists(os.path.join(path, _DONE_MARKER)):
+                return path
+        return None
+
+    def zip_latest(self, base_dir: str) -> Optional[tuple]:
+        """(archive_name, zip_bytes) of the newest completed capture, or
+        None when no completed capture exists."""
+        import io
+        import os
+        import zipfile
+
+        latest = self.latest_dir(base_dir)
+        if latest is None:
+            return None
+        buffer = io.BytesIO()
+        with zipfile.ZipFile(buffer, "w", zipfile.ZIP_DEFLATED) as archive:
+            for root, _dirs, files in os.walk(latest):
+                for name in files:
+                    full = os.path.join(root, name)
+                    archive.write(full, os.path.relpath(full, latest))
+        return os.path.basename(latest) + ".zip", buffer.getvalue()
+
+
+# one per process, like the jax profiler itself
+PROFILER = ProfileManager()

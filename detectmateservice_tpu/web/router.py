@@ -1,0 +1,229 @@
+"""Admin route table: every HTTP route the admin plane serves, declared once.
+
+``web/server.py`` dispatches requests through :data:`ROUTES` — there is no
+second place a route can be added, so the table is the single source of
+truth for the admin API surface. dmlint's cross-artifact contract DM-C007/8
+(analysis/contracts.py) parses the ``Route(...)`` declarations below and
+holds them in sync with the route table in ``docs/usage.md`` in both
+directions: an undocumented route and a documented-but-phantom route both
+fail the gate.
+
+Handlers take ``(service, query, payload)`` — ``query`` is the parsed query
+string (``parse_qs`` shape), ``payload`` the decoded JSON body (``{}`` for
+an empty body; GET handlers receive ``None``) — and return a
+:class:`Response`. Exceptions surface as HTTP 500 with a JSON detail;
+``ValueError`` as HTTP 400 (client error semantics for bad parameters).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+
+@dataclass(frozen=True)
+class Response:
+    status: int
+    body: Any                        # dict/list → JSON; bytes → raw
+    content_type: str = "application/json"
+    # run AFTER the reply hits the wire (e.g. shutdown must answer first)
+    after: Optional[Callable[[], None]] = None
+
+
+@dataclass(frozen=True)
+class Route:
+    method: str
+    path: str
+    handler: Callable[..., Response]
+    doc: str
+
+
+def _int_param(query: Dict[str, List[str]], name: str,
+               default: Optional[int] = None) -> Optional[int]:
+    raw = (query.get(name) or [None])[0]
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer") from None
+
+
+def _float_param(query: Dict[str, List[str]], name: str,
+                 default: Optional[float] = None) -> Optional[float]:
+    raw = (query.get(name) or [None])[0]
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number") from None
+
+
+# -- GET handlers -----------------------------------------------------------
+def _metrics(service, query, payload) -> Response:
+    return Response(200, generate_latest(), CONTENT_TYPE_LATEST)
+
+
+def _status(service, query, payload) -> Response:
+    return Response(200, service._create_status_report())
+
+
+def _health(service, query, payload) -> Response:
+    deep = (query.get("deep") or ["0"])[0] not in ("", "0", "false")
+    monitor = getattr(service, "health", None)
+    if monitor is None:
+        return Response(200, {"state": "unknown",
+                              "detail": "no health monitor"})
+    if deep:
+        # fresh evaluation with per-check detail; non-200 on anything short
+        # of healthy so orchestration healthchecks (docker-compose/k8s) can
+        # gate on it directly
+        report = monitor.evaluate()
+        return Response(200 if report["state"] == "healthy" else 503, report)
+    # cheap liveness: the watchdog's last roll-up, no evaluation on the
+    # request path; degraded stays 200 (restarting a merely-degraded
+    # container makes it worse)
+    state = monitor.state
+    return Response(503 if state == "unhealthy" else 200, {"state": state})
+
+
+def _events(service, query, payload) -> Response:
+    events = getattr(service, "events", None)
+    if events is None:
+        return Response(404, {"detail": "service has no event log"})
+    limit = _int_param(query, "limit", default=-1)
+    return Response(200, events.snapshot(limit if limit >= 0 else None))
+
+
+def _trace(service, query, payload) -> Response:
+    fmt = (query.get("format") or ["json"])[0]
+    recorder = getattr(service.engine, "trace_recorder", None)
+    if recorder is None:
+        return Response(404, {"detail": "engine has no flight recorder"})
+    if fmt == "chrome":
+        return Response(200, recorder.chrome_events())
+    if fmt == "json":
+        body = recorder.snapshot()
+        body["tracing_enabled"] = bool(
+            getattr(service.settings, "engine_trace", False))
+        return Response(200, body)
+    return Response(400, {"detail": f"unknown format {fmt!r}"})
+
+
+def _xla(service, query, payload) -> Response:
+    from ..engine import device_obs
+
+    limit = _int_param(query, "limit", default=-1)
+    snapshot = device_obs.get_ledger().snapshot(
+        limit if limit is not None and limit >= 0 else None)
+    return Response(200, snapshot)
+
+
+def _profile_status(service, query, payload) -> Response:
+    from ..utils.profiling import PROFILER
+
+    status = PROFILER.status()
+    status["profile_dir"] = (service.settings.profile_dir
+                             or PROFILER.default_dir())
+    return Response(200, status)
+
+
+def _profile_latest(service, query, payload) -> Response:
+    from ..utils.profiling import PROFILER
+
+    base_dir = service.settings.profile_dir or PROFILER.default_dir()
+    if PROFILER.status()["running"]:
+        return Response(409, {"detail": "capture still running; retry when "
+                                        "GET /admin/profile reports done"})
+    archive = PROFILER.zip_latest(base_dir)
+    if archive is None:
+        return Response(404, {"detail": f"no completed capture under "
+                                        f"{base_dir}"})
+    _name, data = archive
+    return Response(200, data, content_type="application/zip")
+
+
+# -- POST handlers ----------------------------------------------------------
+def _start(service, query, payload) -> Response:
+    return Response(200, {"detail": service.start()})
+
+
+def _stop(service, query, payload) -> Response:
+    service.stop()
+    return Response(200, {"detail": "engine stopped"})
+
+
+def _shutdown(service, query, payload) -> Response:
+    # the reply must leave before run() unparks and tears the server down
+    return Response(200, {"detail": "service shutting down"},
+                    after=service.shutdown)
+
+
+def _reconfigure(service, query, payload) -> Response:
+    config = (payload or {}).get("config") or {}
+    persist = bool((payload or {}).get("persist", False))
+    updated = service.reconfigure(config, persist=persist)
+    return Response(200, {"detail": "reconfigured", "config": updated})
+
+
+def _checkpoint(service, query, payload) -> Response:
+    return Response(200, service.checkpoint())
+
+
+def _profile_start(service, query, payload) -> Response:
+    from ..utils.profiling import PROFILER, ProfileBusyError
+
+    payload = payload or {}
+    seconds = _float_param(query, "seconds")
+    if seconds is None:
+        seconds = payload.get("seconds")
+    if seconds is None:
+        # legacy body shape from the pre-ledger profile endpoint
+        seconds = float(payload.get("duration_ms", 1000)) / 1000.0
+    base_dir = (payload.get("out_dir") or service.settings.profile_dir
+                or PROFILER.default_dir())
+    try:
+        info = PROFILER.start(base_dir, float(seconds),
+                              service.settings.profile_max_captures)
+    except ProfileBusyError as exc:
+        return Response(409, {"detail": str(exc)})
+    info["detail"] = "capture started"
+    return Response(200, info)
+
+
+# one row per route; dmlint DM-C007/8 keeps this table and the route table
+# in docs/usage.md synchronized in both directions
+ROUTES: Tuple[Route, ...] = (
+    Route("GET", "/metrics", _metrics, "Prometheus exposition"),
+    Route("GET", "/admin/status", _status, "status report"),
+    Route("GET", "/admin/health", _health, "liveness / deep health"),
+    Route("GET", "/admin/events", _events, "structured event ring"),
+    Route("GET", "/admin/trace", _trace, "pipeline flight recorder"),
+    Route("GET", "/admin/xla", _xla,
+          "XLA compile ledger + device-batch spans"),
+    Route("GET", "/admin/profile", _profile_status,
+          "profiler capture status"),
+    Route("GET", "/admin/profile/latest", _profile_latest,
+          "download the newest completed capture as a zip"),
+    Route("POST", "/admin/start", _start, "start the engine"),
+    Route("POST", "/admin/stop", _stop, "stop the engine"),
+    Route("POST", "/admin/shutdown", _shutdown, "shut the service down"),
+    Route("POST", "/admin/reconfigure", _reconfigure,
+          "validate + apply component config"),
+    Route("POST", "/admin/checkpoint", _checkpoint,
+          "checkpoint component state"),
+    Route("POST", "/admin/profile", _profile_start,
+          "start an on-demand jax.profiler capture"),
+)
+
+
+def route_table() -> Dict[Tuple[str, str], Route]:
+    table: Dict[Tuple[str, str], Route] = {}
+    for route in ROUTES:
+        key = (route.method, route.path)
+        if key in table:
+            raise ValueError(f"duplicate route {key}")
+        table[key] = route
+    return table

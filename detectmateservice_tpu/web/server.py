@@ -1,26 +1,18 @@
 """Admin plane: HTTP server exposing lifecycle verbs + Prometheus metrics.
 
 Route parity with the reference (reference:
-src/service/features/web/router.py:18-46, server.py:22-27):
-
-* ``POST /admin/start`` / ``POST /admin/stop`` / ``POST /admin/shutdown``
-* ``GET  /admin/status``
-* ``POST /admin/reconfigure`` with JSON ``{"config": {...}, "persist": bool}``
-* ``GET  /metrics`` → ``prometheus_client.generate_latest()``
+src/service/features/web/router.py:18-46, server.py:22-27) — but the route
+surface itself lives in ``web/router.py`` as a declarative table; this
+module is only the transport shell (socket lifecycle, JSON encode/decode,
+error mapping). dmlint DM-C007/8 pins the table to the ``docs/usage.md``
+route reference in both directions.
 
 The reference runs FastAPI/uvicorn on a thread with signal handlers disabled
-(reference: server.py:40-42); this environment has neither, so the server is a
-stdlib ``ThreadingHTTPServer`` on a daemon thread — same observable surface,
-zero extra dependencies. The TPU build adds ``POST /admin/profile`` to capture
-a jax.profiler trace, ``GET /admin/trace`` to read the engine's pipeline
-flight recorder — ``?format=chrome`` returns a Perfetto/chrome://tracing
-loadable trace-event document (closes the tracing gap noted in SURVEY.md
-§5.1 at both the device and the pipeline layer) — plus the self-diagnosis
-surface (engine/health.py): ``GET /admin/health`` (cheap liveness; ``?deep=1``
-runs the checks and returns non-200 with per-check detail on degradation,
-the docker-compose/k8s healthcheck target) and ``GET /admin/events`` (the
-bounded structured-event ring: health transitions, thread exceptions,
-WARNING+ log records).
+(reference: server.py:40-42); this environment has neither, so the server is
+a stdlib ``ThreadingHTTPServer`` on a daemon thread — same observable
+surface, zero extra dependencies. Error mapping: a handler raising
+``ValueError`` is a client error (HTTP 400); anything else surfaces as
+HTTP 500 with a JSON detail.
 """
 from __future__ import annotations
 
@@ -31,7 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+from .router import Response, route_table
 
 
 class WebServer:
@@ -84,6 +76,8 @@ class WebServer:
 
 
 def _make_handler(service):
+    table = route_table()
+
     class AdminHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -110,105 +104,42 @@ def _make_handler(service):
             except json.JSONDecodeError as exc:
                 return None, str(exc)
 
-        # -- routes ----------------------------------------------------
-        def do_GET(self) -> None:
+        def _dispatch(self, method: str,
+                      payload: Optional[Dict[str, Any]]) -> None:
             parsed = urlparse(self.path)
-            if parsed.path == "/metrics":
-                self._send(200, generate_latest(), CONTENT_TYPE_LATEST)
-            elif parsed.path == "/admin/status":
-                self._send_json(200, service._create_status_report())
-            elif parsed.path == "/admin/health":
-                query = parse_qs(parsed.query)
-                deep = (query.get("deep") or ["0"])[0] not in ("", "0", "false")
-                monitor = getattr(service, "health", None)
-                if monitor is None:
-                    self._send_json(200, {"state": "unknown",
-                                          "detail": "no health monitor"})
-                elif deep:
-                    # fresh evaluation with per-check detail; non-200 on
-                    # anything short of healthy so orchestration healthchecks
-                    # (docker-compose/k8s) can gate on it directly
-                    report = monitor.evaluate()
-                    code = 200 if report["state"] == "healthy" else 503
-                    self._send_json(code, report)
-                else:
-                    # cheap liveness: the watchdog's last roll-up, no
-                    # evaluation on the request path; degraded stays 200
-                    # (restarting a merely-degraded container makes it worse)
-                    state = monitor.state
-                    self._send_json(503 if state == "unhealthy" else 200,
-                                    {"state": state})
-            elif parsed.path == "/admin/events":
-                query = parse_qs(parsed.query)
-                events = getattr(service, "events", None)
-                if events is None:
-                    self._send_json(404, {"detail": "service has no event log"})
-                    return
-                try:
-                    limit = int((query.get("limit") or ["-1"])[0])
-                except ValueError:
-                    self._send_json(400, {"detail": "limit must be an integer"})
-                    return
-                self._send_json(
-                    200, events.snapshot(limit if limit >= 0 else None))
-            elif parsed.path == "/admin/trace":
-                query = parse_qs(parsed.query)
-                fmt = (query.get("format") or ["json"])[0]
-                recorder = getattr(service.engine, "trace_recorder", None)
-                if recorder is None:
-                    self._send_json(404, {"detail": "engine has no flight recorder"})
-                elif fmt == "chrome":
-                    self._send_json(200, recorder.chrome_events())
-                elif fmt == "json":
-                    body = recorder.snapshot()
-                    body["tracing_enabled"] = bool(
-                        getattr(service.settings, "engine_trace", False))
-                    self._send_json(200, body)
-                else:
-                    self._send_json(400, {"detail": f"unknown format {fmt!r}"})
-            else:
+            route = table.get((method, parsed.path))
+            if route is None:
                 self._send_json(404, {"detail": "not found"})
-
-        def do_POST(self) -> None:
+                return
             try:
-                if self.path == "/admin/start":
-                    self._send_json(200, {"detail": service.start()})
-                elif self.path == "/admin/stop":
-                    service.stop()
-                    self._send_json(200, {"detail": "engine stopped"})
-                elif self.path == "/admin/shutdown":
-                    self._send_json(200, {"detail": "service shutting down"})
-                    service.shutdown()
-                elif self.path == "/admin/reconfigure":
-                    payload, err = self._read_json()
-                    if err is not None:
-                        self._send_json(400, {"detail": f"invalid JSON: {err}"})
-                        return
-                    config = (payload or {}).get("config") or {}
-                    persist = bool((payload or {}).get("persist", False))
-                    updated = service.reconfigure(config, persist=persist)
-                    self._send_json(200, {"detail": "reconfigured", "config": updated})
-                elif self.path == "/admin/checkpoint":
-                    self._send_json(200, service.checkpoint())
-                elif self.path == "/admin/profile":
-                    payload, _ = self._read_json()
-                    result = _capture_profile(service, payload or {})
-                    self._send_json(200, result)
-                else:
-                    self._send_json(404, {"detail": "not found"})
-            except Exception as exc:  # admin errors surface as HTTP 500s
+                response: Response = route.handler(
+                    service, parse_qs(parsed.query), payload)
+            except ValueError as exc:       # bad parameters — client error
+                self._send_json(400, {"detail": str(exc)})
+                return
+            except Exception as exc:        # admin errors surface as 500s
                 try:
                     self._send_json(500, {"detail": str(exc)})
                 except (BrokenPipeError, ConnectionResetError):
                     pass
+                return
+            body = response.body
+            if isinstance(body, (bytes, bytearray)):
+                self._send(response.status, bytes(body), response.content_type)
+            else:
+                self._send_json(response.status, body)
+            if response.after is not None:
+                response.after()
+
+        # -- routes ----------------------------------------------------
+        def do_GET(self) -> None:
+            self._dispatch("GET", None)
+
+        def do_POST(self) -> None:
+            payload, err = self._read_json()
+            if err is not None:
+                self._send_json(400, {"detail": f"invalid JSON: {err}"})
+                return
+            self._dispatch("POST", payload)
 
     return AdminHandler
-
-
-def _capture_profile(service, payload: Dict[str, Any]) -> Dict[str, Any]:
-    """Capture a jax.profiler trace for ``duration_ms`` (TPU-build addition)."""
-    from ..utils.profiling import capture_trace
-
-    duration_ms = int(payload.get("duration_ms", 1000))
-    out_dir = payload.get("out_dir") or service.settings.profile_dir or "/tmp/detectmate_profile"
-    return capture_trace(out_dir, duration_ms)

@@ -1,0 +1,95 @@
+"""CI smoke for the on-demand profiler: /admin/profile end to end on CPU.
+
+Boots a real Service (core passthrough component, in-proc data plane,
+ephemeral admin port), starts a capture through ``POST /admin/profile``
+exactly as an operator would (DetectMateClient), runs a few jax ops while
+the trace records so the artifact is non-trivial, waits for completion, and
+downloads ``GET /admin/profile/latest`` to a zip on disk — which the CI
+workflow uploads as a build artifact so a failed perf investigation can
+start from a known-good capture.
+
+Exit 0 only when the full loop worked and the zip contains at least one
+trace file. Also asserts the concurrency guard: a second capture while one
+runs must be rejected (HTTP 409).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import urllib.error
+import zipfile
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="profile-artifact.zip")
+    parser.add_argument("--seconds", type=float, default=1.0)
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # runnable from a checkout without an installed package (CI does both)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+    from detectmateservice_tpu.client import DetectMateClient
+    from detectmateservice_tpu.core import Service
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+    from detectmateservice_tpu.settings import ServiceSettings
+    from detectmateservice_tpu.utils.profiling import PROFILER
+
+    profile_dir = tempfile.mkdtemp(prefix="dm_profile_smoke_")
+    settings = ServiceSettings(
+        component_type="core",
+        engine_addr="inproc://profile-smoke",
+        engine_autostart=False,
+        http_port=0,
+        log_to_file=False,
+        profile_dir=profile_dir,
+    )
+    service = Service(settings, socket_factory=InprocQueueSocketFactory())
+    service.web_server.start()
+    try:
+        client = DetectMateClient(f"http://127.0.0.1:{service.web_server.port}")
+        started = client.profile_start(seconds=args.seconds)
+        print(f"capture started: {started}")
+
+        # concurrency guard: the second capture must be rejected with 409
+        try:
+            client.profile_start(seconds=args.seconds)
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 409, f"expected 409, got {exc.code}"
+            print("second capture correctly rejected (409)")
+        else:
+            print("ERROR: concurrent capture was not rejected", file=sys.stderr)
+            return 1
+
+        # some device work while the trace records (otherwise the capture
+        # is legal but empty of ops)
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: (x @ x.T).sum())
+        for _ in range(50):
+            f(jnp.ones((128, 128))).block_until_ready()
+
+        assert PROFILER.wait(args.seconds + 60), "capture never finished"
+        status = client.profile_status()
+        assert (status.get("last") or {}).get("state") == "done", status
+
+        data = client.profile_latest()
+        with open(args.out, "wb") as fh:
+            fh.write(data)
+        with zipfile.ZipFile(args.out) as archive:
+            names = archive.namelist()
+        assert names, "artifact zip is empty"
+        print(f"wrote {args.out}: {len(data)} bytes, {len(names)} entries")
+        return 0
+    finally:
+        service.web_server.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

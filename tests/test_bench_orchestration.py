@@ -101,6 +101,39 @@ class TestAcquisitionLoop:
         tpu_probes = [c for c in calls if c[0] == "probe" and c[1] is None]
         assert len(tpu_probes) >= 3, "one probe window must not end the hunt"
 
+    def test_first_probe_timeout_abandons_platform_fail_fast(
+            self, bench, monkeypatch, capsys):
+        """BENCH_r05 failure mode: eight consecutive probes each burned the
+        full 120 s window against a wedged axon tunnel. A probe TIMEOUT
+        (hung backend init — unlike a fast crash, which stays on the
+        re-probe cadence) must abandon the platform pin after the FIRST
+        window and let the concurrent CPU insurance carry the round."""
+        class TimeoutChild(ScriptedChild):
+            def __init__(self, stage, timeout_s, platform=None, arg=""):
+                super().__init__(stage, timeout_s, platform=platform, arg=arg)
+                if (stage == "probe" and platform is None
+                        and self.payload is None):
+                    self.diag["outcome"] = "timeout"
+
+        def controller(stage, platform, arg):
+            if stage == "probe":
+                return {"platform": "cpu"} if platform == "cpu" else None
+            if platform == "cpu":
+                return cpu_payload(arg)
+            return None
+
+        ScriptedChild.calls = []
+        ScriptedChild.controller = staticmethod(controller)
+        monkeypatch.setattr(bench, "_Child", TimeoutChild)
+        with pytest.raises(SystemExit):
+            bench.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        tpu_probes = [c for c in TimeoutChild.calls
+                      if c[0] == "probe" and c[1] is None]
+        assert len(tpu_probes) == 1, "a timed-out probe must not be retried"
+        assert out["platform"] == "cpu"
+        assert "fail-fast" in out.get("note", "")
+
     def test_late_probe_success_yields_tpu_number(
             self, bench, monkeypatch, capsys):
         """The tunnel comes back after several dead probe windows: the next

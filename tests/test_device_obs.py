@@ -1,0 +1,451 @@
+"""Device-side observability (engine/device_obs.py + the admin/profiler
+surface): the XLA compile ledger attributes compiles, flags unexpected
+recompiles after warm-up, exports HBM gauges only where the backend reports
+memory stats, and the on-demand profiler capture is concurrency-guarded and
+disk-bounded.
+
+The Service-level class is the acceptance path: a real jax_scorer detector
+warms up on CPU, an injected dispatch on an unwarmed bucket triggers a REAL
+XLA compile, and the flag propagates end to end — counter, structured event
+on /admin/events, xla_recompile_storm degradation on /admin/health?deep=1,
+and a ledger entry on /admin/xla.
+"""
+import io
+import json
+import urllib.error
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+from prometheus_client import REGISTRY
+
+from detectmateservice_tpu.core import Service
+from detectmateservice_tpu.engine import device_obs
+from detectmateservice_tpu.engine.device_obs import (
+    CompileLedger,
+    RecompileStormCheck,
+)
+from detectmateservice_tpu.engine.health import EventLog, HealthMonitor
+from detectmateservice_tpu.settings import ServiceSettings
+
+LABELS = {"component_type": "test_obs", "component_id": "obs-1"}
+
+
+def http_json(port, path, method="GET"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=b"" if method == "POST" else None)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def http_raw(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def make_monitor(events=None):
+    return HealthMonitor(dict(LABELS), events=events)
+
+
+# ---------------------------------------------------------------------------
+# ledger unit behavior (injected records — no jax compiles needed)
+# ---------------------------------------------------------------------------
+class TestCompileLedger:
+    def test_warmup_compiles_are_recorded_but_never_flagged(self):
+        ledger = CompileLedger()
+        ledger.bind(labels=LABELS)
+        event = ledger.record_compile(0.5, bucket=8, backend="cpu",
+                                      where="warmup", expected=True)
+        assert event["phase"] == "warmup"
+        assert event["unexpected"] is False
+        snap = ledger.snapshot()
+        assert snap["warmup_complete"] is False
+        assert snap["totals"] == {"compiles": 1, "seconds": 0.5,
+                                  "unexpected": 0}
+        assert snap["compiles"][0]["bucket"] == "8"
+
+    def test_dispatch_compile_after_warmup_is_flagged_and_emitted(self):
+        events = EventLog()
+        monitor = make_monitor(events)
+        ledger = CompileLedger()
+        ledger.bind(labels=LABELS, monitor=monitor)
+        ledger.mark_warmup_complete()
+        before = REGISTRY.get_sample_value(
+            "scorer_xla_recompiles_unexpected_total", LABELS) or 0.0
+        event = ledger.record_compile(1.25, bucket=64, backend="cpu",
+                                      where="dispatch", expected=False)
+        assert event["unexpected"] is True and event["phase"] == "runtime"
+        after = REGISTRY.get_sample_value(
+            "scorer_xla_recompiles_unexpected_total", LABELS)
+        assert after == before + 1
+        ring = events.snapshot()["events"]
+        recompiles = [e for e in ring if e.get("kind") == "unexpected_recompile"]
+        assert recompiles and recompiles[-1]["bucket"] == "64"
+        # the bound monitor's storm check degrades while the event is recent
+        status, detail = RecompileStormCheck(ledger, monitor).evaluate(0.0)
+        assert status == "degraded" and "unexpected XLA recompile" in detail
+
+    def test_external_compiles_are_recorded_but_not_flagged(self):
+        """A compile with no ledger context (another library jitting in the
+        same process) lands in the ring as 'external' and can never trip
+        the storm detector — no co-tenant false alarms."""
+        ledger = CompileLedger()
+        ledger.bind(labels=LABELS)
+        ledger.mark_warmup_complete()
+        event = ledger.record_compile(0.2)
+        assert event["where"] == "external"
+        assert event["unexpected"] is False
+        assert ledger.unexpected_in_window() == 0
+
+    def test_expected_flag_is_inherited_through_nested_contexts(self):
+        """The sharded scorer's inner context must not launder the dispatch
+        path's expected=False back to the default."""
+        ledger = CompileLedger()
+        ledger.bind(labels=LABELS)
+        ledger.mark_warmup_complete()
+        with ledger.context(bucket=32, where="dispatch", expected=False):
+            with ledger.context(bucket=64, backend="mesh", where="sharded"):
+                event = ledger.record_compile(0.1)
+        assert event["unexpected"] is True
+        assert event["bucket"] == "64" and event["where"] == "sharded"
+        # and an expected outer context stays expected through nesting
+        with ledger.context(where="fit", expected=True):
+            with ledger.context(bucket=16, where="sharded"):
+                event = ledger.record_compile(0.1)
+        assert event["unexpected"] is False
+
+    def test_ring_and_span_log_are_bounded(self):
+        ledger = CompileLedger(max_events=4, max_spans=3)
+        ledger.bind(labels=LABELS)
+        for i in range(10):
+            ledger.record_compile(0.01, bucket=i, backend="cpu",
+                                  where="warmup")
+            ledger.record_span(8, 5, "device", 0.0, 0.01)
+        snap = ledger.snapshot()
+        assert len(snap["compiles"]) == 4
+        assert len(snap["batches"]) == 3
+        assert snap["totals"]["compiles"] == 10  # totals keep counting
+        assert snap["compiles"][-1]["bucket"] == "9"
+
+    def test_storm_check_passes_for_a_no_longer_bound_monitor(self):
+        """Tests/processes build several Services; a storm can only be
+        blamed on the service the ledger is currently bound to."""
+        ledger = CompileLedger()
+        old_monitor = make_monitor()
+        ledger.bind(labels=LABELS, monitor=old_monitor)
+        old_check = RecompileStormCheck(ledger, old_monitor)
+        ledger.mark_warmup_complete()
+        ledger.record_compile(1.0, bucket=8, where="dispatch", expected=False)
+        assert old_check.evaluate(0.0)[0] == "degraded"
+        new_monitor = make_monitor()
+        ledger.bind(monitor=new_monitor)
+        assert old_check.evaluate(0.0)[0] == "pass"
+        # re-binding clears the storm window: a storm that predates the new
+        # service's binding is not blamed on it (the ring keeps the history)
+        new_check = RecompileStormCheck(ledger, new_monitor)
+        assert new_check.evaluate(0.0)[0] == "pass"
+        ledger.record_compile(1.0, bucket=8, where="dispatch", expected=False)
+        assert new_check.evaluate(0.0)[0] == "degraded"
+
+    def test_emit_events_off_still_counts_but_stays_silent(self):
+        events = EventLog()
+        monitor = make_monitor(events)
+        ledger = CompileLedger()
+        ledger.bind(labels=LABELS, monitor=monitor, emit_events=False)
+        ledger.mark_warmup_complete()
+        event = ledger.record_compile(0.3, bucket=8, where="dispatch",
+                                      expected=False)
+        assert event["unexpected"] is True
+        assert not [e for e in events.snapshot()["events"]
+                    if e.get("kind") == "unexpected_recompile"]
+
+
+# ---------------------------------------------------------------------------
+# the jax.monitoring listener with REAL compiles (CPU)
+# ---------------------------------------------------------------------------
+class TestListenerWithRealCompiles:
+    def test_real_jit_compiles_attribute_through_contexts(self):
+        import jax
+        import jax.numpy as jnp
+
+        ledger = CompileLedger()
+        ledger.bind(labels=LABELS)
+        assert device_obs.install_listener()
+        previous = device_obs.activate(ledger)
+        try:
+            fn = jax.jit(lambda x: x * 3 + 1)
+            with ledger.context(bucket=8, backend="cpu", where="warmup",
+                                expected=True):
+                fn(jnp.ones((8, 4))).block_until_ready()
+            snap = ledger.snapshot()
+            assert snap["totals"]["compiles"] >= 1
+            assert any(e["bucket"] == "8" and e["where"] == "warmup"
+                       and e["seconds"] > 0 for e in snap["compiles"])
+            ledger.mark_warmup_complete()
+            with ledger.context(bucket=16, backend="cpu", where="dispatch",
+                                expected=False):
+                fn(jnp.ones((16, 4))).block_until_ready()  # new shape: compiles
+            snap = ledger.snapshot()
+            flagged = [e for e in snap["compiles"] if e["unexpected"]]
+            assert flagged and flagged[-1]["bucket"] == "16"
+            assert ledger.unexpected_in_window() >= 1
+        finally:
+            device_obs.activate(previous)
+
+
+# ---------------------------------------------------------------------------
+# HBM gauges
+# ---------------------------------------------------------------------------
+class TestHbmGauges:
+    def test_cpu_backend_exports_nothing(self):
+        """CPU devices return memory_stats() None — the guarded path — so no
+        device_hbm_bytes child may appear."""
+        labels = {"component_type": "hbm_cpu", "component_id": "none"}
+        assert device_obs.export_hbm_gauges(labels) == 0
+        assert REGISTRY.get_sample_value(
+            "device_hbm_bytes",
+            dict(labels, device="TFRT_CPU_0", kind="in_use")) is None
+
+    def test_stats_backed_device_exports_scrape_time_gauges(self, monkeypatch):
+        import jax
+
+        stats = {"bytes_in_use": 1024, "bytes_limit": 4096}
+
+        class FakeDevice:
+            def memory_stats(self):
+                return dict(stats)
+
+            def __str__(self):
+                return "FAKE_TPU_0"
+
+        monkeypatch.setattr(jax, "local_devices", lambda: [FakeDevice()])
+        labels = {"component_type": "hbm_fake", "component_id": "fake-1"}
+        assert device_obs.export_hbm_gauges(labels) == 1
+        in_use = REGISTRY.get_sample_value(
+            "device_hbm_bytes", dict(labels, device="FAKE_TPU_0", kind="in_use"))
+        limit = REGISTRY.get_sample_value(
+            "device_hbm_bytes", dict(labels, device="FAKE_TPU_0", kind="limit"))
+        assert (in_use, limit) == (1024.0, 4096.0)
+        stats["bytes_in_use"] = 2048  # refreshed at scrape time, not export time
+        assert REGISTRY.get_sample_value(
+            "device_hbm_bytes",
+            dict(labels, device="FAKE_TPU_0", kind="in_use")) == 2048.0
+
+
+# ---------------------------------------------------------------------------
+# batch telemetry math (no jax needed)
+# ---------------------------------------------------------------------------
+class TestBatchTelemetry:
+    def _detector(self):
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        return JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False,
+            "vocab_size": 256, "seq_len": 8, "dim": 8}}})
+
+    def test_occupancy_math_on_ragged_batches(self):
+        from detectmateservice_tpu.library.detectors.jax_scorer import (
+            _InflightSlot,
+        )
+
+        det = self._detector()
+        labels = dict(det._obs_labels(), path="device")
+
+        def sample(name):
+            return REGISTRY.get_sample_value(name, labels) or 0.0
+
+        occ_sum0, occ_cnt0 = (sample("detector_batch_occupancy_sum"),
+                              sample("detector_batch_occupancy_count"))
+        for real, bucket in ((5, 8), (8, 8), (1, 16)):
+            slot = _InflightSlot([], real, bucket=bucket, path="device")
+            slot.t_start = slot.t_enqueue + 0.25
+            det._observe_batch(slot, device_s=0.5)
+        assert sample("detector_batch_occupancy_count") == occ_cnt0 + 3
+        assert sample("detector_batch_occupancy_sum") == pytest.approx(
+            occ_sum0 + 5 / 8 + 1.0 + 1 / 16)
+        # queue wait observed the enqueue→start gap
+        assert (REGISTRY.get_sample_value(
+            "detector_queue_wait_seconds_sum", labels) or 0.0) >= 0.75 - 1e-6
+        # bucket selection counted per (bucket, path)
+        assert REGISTRY.get_sample_value(
+            "detector_bucket_selected_total",
+            dict(det._obs_labels(), bucket="8", path="device")) >= 2
+
+    def test_span_records_trace_link_fields(self):
+        ledger = CompileLedger()
+        ledger.record_span(16, 9, "device", 0.001, 0.02, trace_id="abcd" * 4)
+        span = ledger.snapshot()["batches"][-1]
+        assert span["occupancy"] == pytest.approx(9 / 16)
+        assert span["trace_id"] == "abcd" * 4
+        assert span["path"] == "device"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance path: a real scorer service on CPU, end to end
+# ---------------------------------------------------------------------------
+class TestScorerServiceEndToEnd:
+    @pytest.fixture()
+    def service(self, run_service, inproc_factory):
+        svc = Service(
+            ServiceSettings(component_type="core", component_name="devobs",
+                            engine_addr="inproc://devobs", http_port=0,
+                            log_to_file=False, log_to_console=False,
+                            watchdog_enabled=False),
+            socket_factory=inproc_factory)
+        return run_service(svc)
+
+    def test_warmup_then_injected_recompile_end_to_end(self, service):
+        """warm-up → injected recompile → RecompileStorm-eligible health
+        event → /admin/xla ledger entry, all in-process on CPU."""
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        # the ledger is process-wide: clear residue from earlier tests in
+        # this pytest session so the ring/warm state below is THIS test's
+        device_obs.get_ledger().reset()
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False,
+            "model": "mlp", "vocab_size": 256, "seq_len": 8, "dim": 8,
+            "data_use_training": 8, "train_batch_size": 8, "max_batch": 16,
+            "host_score_max_batch": 0,  # all dispatches ride the device path
+        }}})
+        det.health_monitor = service.health
+        det.setup_io()
+        ledger = device_obs.get_ledger()
+        assert ledger.warmup_complete
+        snap = ledger.snapshot()
+        assert snap["totals"]["compiles"] >= 2  # warm set compiled for real
+        assert all(not e["unexpected"] for e in snap["compiles"])
+
+        # injected recompile: bucket 4 is NOT in the warm set {1, 8, 16} —
+        # this dispatch pays a real XLA compile on the dispatch path
+        unexpected_before = snap["totals"]["unexpected"]
+        tokens = np.zeros((3, 8), np.int32)
+        det._dispatch(tokens, [b"a", b"b", b"c"])
+        det.flush()
+
+        snap = ledger.snapshot()
+        assert snap["totals"]["unexpected"] == unexpected_before + 1
+        flagged = [e for e in snap["compiles"] if e["unexpected"]]
+        assert flagged and flagged[-1]["bucket"] == "4"
+        assert flagged[-1]["where"] in ("dispatch", "sharded")
+
+        port = service.web_server.port
+        # 1. the ledger entry on GET /admin/xla
+        code, body = http_json(port, "/admin/xla")
+        assert code == 200 and body["warmup_complete"] is True
+        assert [e for e in body["compiles"] if e["unexpected"]]
+        assert body["batches"], "device-batch spans must be recorded"
+        span = body["batches"][-1]
+        assert span["bucket"] == 4 and span["real"] == 3
+        assert span["occupancy"] == pytest.approx(0.75)
+
+        # 2. the structured health event on GET /admin/events
+        code, events = http_json(port, "/admin/events")
+        assert code == 200
+        recompiles = [e for e in events["events"]
+                      if e.get("kind") == "unexpected_recompile"]
+        assert recompiles and recompiles[-1]["bucket"] == "4"
+
+        # 3. the RecompileStorm-eligible state on deep health
+        code, health = http_json(port, "/admin/health?deep=1")
+        assert code == 503 and health["state"] == "degraded"
+        failing = {c["name"]: c["status"] for c in health["checks"]
+                   if c["status"] != "pass"}
+        assert failing == {"xla_recompile_storm": "degraded"}
+
+        # 4. the batch telemetry moved for the device path
+        labels = dict(det._obs_labels(), path="device")
+        assert REGISTRY.get_sample_value(
+            "detector_batch_occupancy_count", labels) >= 1
+
+
+# ---------------------------------------------------------------------------
+# on-demand profiler capture via the admin plane
+# ---------------------------------------------------------------------------
+class TestProfileAdmin:
+    @pytest.fixture()
+    def service(self, run_service, inproc_factory, tmp_path):
+        svc = Service(
+            ServiceSettings(component_type="core", component_name="prof",
+                            engine_addr="inproc://prof", http_port=0,
+                            log_to_file=False, log_to_console=False,
+                            watchdog_enabled=False,
+                            profile_dir=str(tmp_path / "profiles"),
+                            profile_max_captures=2),
+            socket_factory=inproc_factory)
+        return run_service(svc)
+
+    def test_capture_happy_path_second_rejected_and_bounded(self, service,
+                                                            tmp_path):
+        from detectmateservice_tpu.utils.profiling import PROFILER
+
+        port = service.web_server.port
+        code, body = http_raw(port, "/admin/profile/latest")
+        assert code == 404  # nothing captured yet
+
+        code, body = http_json(port, "/admin/profile?seconds=0.2",
+                               method="POST")
+        assert code == 200 and body["detail"] == "capture started"
+        # concurrency guard: one capture per process
+        code2, body2 = http_json(port, "/admin/profile?seconds=0.2",
+                                 method="POST")
+        assert code2 == 409 and "already running" in body2["detail"]
+        assert PROFILER.wait(30)
+
+        code, status = http_json(port, "/admin/profile")
+        assert code == 200 and status["running"] is False
+        assert status["last"]["state"] == "done"
+
+        code, data = http_raw(port, "/admin/profile/latest")
+        assert code == 200
+        archive = zipfile.ZipFile(io.BytesIO(data))
+        assert archive.namelist(), "capture artifact must not be empty"
+
+        # artifact bound: profile_max_captures=2 keeps only the newest two
+        for _ in range(2):
+            code, _body = http_json(port, "/admin/profile?seconds=0.1",
+                                    method="POST")
+            assert code == 200
+            assert PROFILER.wait(30)
+        capture_dirs = sorted(
+            p.name for p in (tmp_path / "profiles").iterdir()
+            if p.name.startswith("capture-"))
+        assert capture_dirs == ["capture-0002", "capture-0003"]
+
+    def test_invalid_seconds_is_a_client_error(self, service):
+        port = service.web_server.port
+        code, body = http_json(port, "/admin/profile?seconds=0", method="POST")
+        assert code == 400 and "seconds" in body["detail"]
+        code, body = http_json(port, "/admin/profile?seconds=bogus",
+                               method="POST")
+        assert code == 400
+
+    def test_client_profile_subcommand_downloads_artifact(self, service,
+                                                          tmp_path):
+        from detectmateservice_tpu.client import main as client_main
+
+        out = tmp_path / "artifact.zip"
+        rc = client_main([
+            "--url", f"http://127.0.0.1:{service.web_server.port}",
+            "profile", "--seconds", "0.2", "--wait", "-o", str(out)])
+        assert rc == 0
+        assert zipfile.ZipFile(out).namelist()
+
+    def test_client_xla_subcommand(self, service, capsys):
+        from detectmateservice_tpu.client import main as client_main
+
+        rc = client_main([
+            "--url", f"http://127.0.0.1:{service.web_server.port}",
+            "xla", "--limit", "5"])
+        assert rc == 0
+        body = json.loads(capsys.readouterr().out)
+        assert {"warmup_complete", "totals", "compiles", "batches"} <= set(body)

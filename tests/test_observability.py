@@ -111,6 +111,7 @@ class TestAlertRules:
         names = [name for name, _ in alert_exprs()]
         for required in ("StageScrapeDown", "EngineLoopStalled", "StageUnhealthy",
                          "OutputBackpressureSustained", "MessageDropRateHigh",
+                         "RecompileStorm", "DeviceHbmPressure",
                          "PipelineLatencyBudgetBurnFast",
                          "PipelineLatencyBudgetBurnSlow"):
             assert required in names, f"missing alert rule {required}"

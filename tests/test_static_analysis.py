@@ -203,6 +203,37 @@ class Loop:
         assert len(found) == 1
         assert "slwo" in found[0].message
 
+    def test_undocumented_route_fires_once(self, tmp_path):
+        self._make_routes_repo(
+            tmp_path,
+            routes='Route("GET", "/admin/demo", None, "demo"),\n'
+                   'Route("POST", "/admin/secret", None, "undocumented"),',
+            usage="| `GET /admin/demo` | demo |\n")
+        found = [f for f in contracts.check_routes_contract(tmp_path)
+                 if f.rule == "DM-C007"]
+        assert len(found) == 1
+        assert "POST /admin/secret" in found[0].message
+
+    def test_phantom_documented_route_fires_once(self, tmp_path):
+        self._make_routes_repo(
+            tmp_path,
+            routes='Route("GET", "/admin/demo", None, "demo"),',
+            usage="| `GET /admin/demo` | demo |\n"
+                  "| `POST /admin/ghost` | never declared |\n")
+        found = [f for f in contracts.check_routes_contract(tmp_path)
+                 if f.rule == "DM-C008"]
+        assert len(found) == 1
+        assert "POST /admin/ghost" in found[0].message
+
+    @staticmethod
+    def _make_routes_repo(tmp_path, routes: str, usage: str):
+        web = tmp_path / "detectmateservice_tpu" / "web"
+        web.mkdir(parents=True)
+        (web / "router.py").write_text(f"ROUTES = (\n{routes}\n)\n")
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "usage.md").write_text(usage)
+
     @staticmethod
     def _make_contract_repo(tmp_path, alerts_extra="", settings_extra=""):
         """Minimal artifact tree the contract checker can traverse."""
@@ -406,6 +437,16 @@ class TestRealTree:
         parsed = contracts.settings_fields(
             REPO / "detectmateservice_tpu" / "settings.py")
         assert set(parsed) == set(ServiceSettings.model_fields)
+
+    def test_declared_routes_match_runtime_table(self):
+        """The route checker's AST-parsed table must equal the runtime
+        ROUTES declarations — if the declaration idiom in web/router.py
+        changes shape, the checker must break loudly, not skip silently."""
+        from detectmateservice_tpu.web.router import ROUTES
+
+        parsed = contracts.declared_routes(
+            REPO / "detectmateservice_tpu" / "web" / "router.py")
+        assert set(parsed) == {f"{r.method} {r.path}" for r in ROUTES}
 
     def test_marker_lint_sees_registered_markers(self):
         regs = markers.registered_markers(REPO / "pyproject.toml")
