@@ -74,6 +74,21 @@ MAX_TPU_RUN_FAILURES = 4
 # env var read by child processes; "cpu" => jax.config.update before any op
 PLATFORM_ENV_VAR = "DETECTMATE_BENCH_PLATFORM"
 
+# Open-loop arrival mode (ROADMAP item 1 / the adaptive-batching PR): after
+# the closed-loop number, each run child replays production-shaped load —
+# bursts arriving on a wall-clock schedule at a configured rate, independent
+# of how fast the detector drains them — against the deadline-aware
+# coalescer, and reports occupancy / queue-wait / release-reason counters
+# into the BENCH_*.json record. Closed-loop max throughput cannot see any of
+# that: it always hands the detector full buckets.
+OPENLOOP_ENABLED = os.environ.get("DETECTMATE_BENCH_OPENLOOP", "1") != "0"
+# arrival rate in lines/s; 0 = auto (~60% of the measured closed-loop rate —
+# heavy but sustainable, the regime the occupancy target is defined for)
+OPENLOOP_RATE = float(os.environ.get("DETECTMATE_BENCH_ARRIVAL_RATE", "0"))
+OPENLOOP_BURST = int(os.environ.get("DETECTMATE_BENCH_ARRIVAL_BURST", "256"))
+OPENLOOP_SECONDS = float(os.environ.get("DETECTMATE_BENCH_OPENLOOP_SECONDS", "6"))
+OPENLOOP_DEADLINE_MS = float(os.environ.get("DETECTMATE_BENCH_DEADLINE_MS", "25"))
+
 # CPU-fallback regression net (r4 weak #5: a wedged-tunnel round's CPU number
 # could not distinguish "environment got small" from "code got slow"). Floor
 # methodology follows tests/test_perf.py: a RATE floor pinned far below any
@@ -228,9 +243,75 @@ def child_run(n_bench: int) -> None:
         "elapsed_s": round(elapsed, 3),
         "platform": platform,
     }
+    if OPENLOOP_ENABLED:
+        try:
+            payload["open_loop"] = run_open_loop(det, lines_per_s)
+        except Exception as exc:  # the headline number must survive
+            payload["open_loop"] = {"error": repr(exc)}
     if platform == "cpu":
         payload["cpu_cores"] = os.cpu_count() or 1
     _child_exit(payload)
+
+
+def run_open_loop(det, closed_loop_lps: float) -> dict:
+    """Open-loop phase: bursts arrive on a wall-clock schedule, whether or
+    not the detector kept up — queueing and padding become visible instead
+    of being absorbed by the caller's pacing. The adaptive coalescer is
+    enabled for this phase only (the closed-loop headline stays on the
+    legacy dispatch path), and its scheduler counters are the result."""
+    rate = OPENLOOP_RATE or max(1000.0, 0.6 * closed_loop_lps)
+    burst = max(1, OPENLOOP_BURST)
+    total = max(burst, int(min(rate * OPENLOOP_SECONDS, 2_000_000)))
+    msgs = make_messages(min(total, 65536), anomaly_rate=0.01, seed=3)
+
+    det.config.batch_deadline_ms = OPENLOOP_DEADLINE_MS
+    det.config.batch_target_occupancy = 0.9
+    before = det.batching_stats()
+    tick_s = max(0.0005, (det.drain_poll_ms or 5) / 1000.0)
+    interval = burst / rate
+    alerts = sent = 0
+    t0 = time.perf_counter()
+    next_t = t0
+    try:
+        while sent < total:
+            now = time.perf_counter()
+            if now < next_t:
+                # the engine's short-poll tick stand-in: deadline releases
+                # and ready readbacks drain between arrivals
+                alerts += sum(o is not None for o in det.drain_ready())
+                time.sleep(min(next_t - now, tick_s))
+                continue
+            base = sent % len(msgs)
+            chunk = msgs[base:base + burst]
+            if len(chunk) < burst:
+                chunk = chunk + msgs[:burst - len(chunk)]
+            alerts += sum(o is not None for o in det.process_batch(chunk))
+            sent += burst
+            next_t += interval
+            if now - next_t > 2.0:
+                next_t = now  # hopelessly behind: open loop, not a death spiral
+        alerts += sum(o is not None for o in det.flush())
+        elapsed = time.perf_counter() - t0
+        after = det.batching_stats()
+    finally:
+        det.config.batch_deadline_ms = 0.0  # leave the detector as found
+    d_n = after["dispatches"] - before["dispatches"]
+    d_occ = after["occupancy_sum"] - before["occupancy_sum"]
+    return {
+        "arrival_rate_lines_per_s": round(rate, 1),
+        "burst": burst,
+        "deadline_ms": OPENLOOP_DEADLINE_MS,
+        "n": sent,
+        "elapsed_s": round(elapsed, 3),
+        "achieved_lines_per_s": round(sent / max(elapsed, 1e-9), 1),
+        "occupancy_mean": round(d_occ / d_n, 4) if d_n else None,
+        "dispatches": d_n,
+        "releases": after["releases"],
+        "queue_wait_max_s": after["max_wait_s"],
+        "queue_wait_mean_s": after["mean_wait_s"],
+        "warm_buckets": after["warm_buckets"],
+        "alerts": alerts,
+    }
 
 
 # ----------------------------------------------------------------------
@@ -520,6 +601,10 @@ def main() -> None:
             "p50_ms": best.get("p50_ms"),
             "n": best.get("n"),
         }
+        if best.get("open_loop"):
+            # the scheduler counters ride into the BENCH_*.json record: the
+            # occupancy/queue-wait story under production-shaped load
+            out["open_loop"] = best["open_loop"]
         if best.get("platform") == "cpu":
             cores = best.get("cpu_cores") or os.cpu_count() or 1
             per_core = best["lines_per_s"] / cores

@@ -50,6 +50,7 @@ ALERT_COVERED_SERIES = (
     "pipeline_e2e_latency_seconds",
     "scorer_xla_recompiles_unexpected_total",
     "device_hbm_bytes",
+    "detector_batch_occupancy",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

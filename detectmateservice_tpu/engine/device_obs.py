@@ -87,6 +87,10 @@ class CompileLedger:
         self._totals = {"compiles": 0, "seconds": 0.0, "unexpected": 0}
         self._last_unexpected_mono: Optional[float] = None
         self._recent_unexpected: deque = deque(maxlen=64)  # monotonic stamps
+        # bucket-state provider (the scorer's adaptive batcher): lets
+        # GET /admin/xla report the LIVE warm/retired compile-bucket sets
+        # next to the compile history they explain
+        self._bucket_state_fn = None
 
     # -- wiring ----------------------------------------------------------
     def bind(self, labels: Optional[Dict[str, str]] = None, monitor=None,
@@ -111,6 +115,14 @@ class CompileLedger:
             monitor.remove_check(RecompileStormCheck.name)
             monitor.add_check(RecompileStormCheck(self, monitor,
                                                   self._storm_window_s))
+
+    def set_bucket_state_provider(self, fn) -> None:
+        """Attach a callable returning the scorer's live compile-bucket
+        state (warm / retired sets); surfaced under ``buckets`` in
+        :meth:`snapshot`. Last registration wins — the ledger is
+        per-process, like the metric registry."""
+        with self._lock:
+            self._bucket_state_fn = fn
 
     # -- attribution contexts -------------------------------------------
     @contextlib.contextmanager
@@ -165,6 +177,7 @@ class CompileLedger:
             self._totals = {"compiles": 0, "seconds": 0.0, "unexpected": 0}
             self._last_unexpected_mono = None
             self._recent_unexpected.clear()
+            self._bucket_state_fn = None  # bound to a dead scorer otherwise
 
     # -- recording -------------------------------------------------------
     def _compile_counters(self, bucket: str, backend: str) -> tuple:
@@ -244,9 +257,12 @@ class CompileLedger:
 
     def record_span(self, bucket: int, real: int, path: str,
                     queue_wait_s: float, device_s: float,
-                    trace_id: Optional[str] = None) -> None:
+                    trace_id: Optional[str] = None,
+                    release: Optional[str] = None) -> None:
         """One drained device batch: the span the flight recorder's trace id
-        links back to (PR-1 `/admin/trace` ↔ this batch)."""
+        links back to (PR-1 `/admin/trace` ↔ this batch). ``release`` names
+        why the coalescer let the batch go (full/deadline/flush); None for
+        uncoalesced dispatches."""
         with self._lock:
             self._span_seq += 1
             self._spans.append({
@@ -259,6 +275,7 @@ class CompileLedger:
                 "queue_wait_s": round(float(queue_wait_s), 6),
                 "device_s": round(float(device_s), 6),
                 "trace_id": trace_id,
+                "release": release,
             })
 
     # -- reads -----------------------------------------------------------
@@ -278,15 +295,22 @@ class CompileLedger:
             totals = dict(self._totals)
             totals["seconds"] = round(totals["seconds"], 6)
             warmed = self._warmed
+            bucket_fn = self._bucket_state_fn
         if limit is not None and limit >= 0:
             events = events[-limit:]
             spans = spans[-limit:]
-        return {
+        doc = {
             "warmup_complete": warmed,
             "totals": totals,
             "compiles": events,
             "batches": spans,
         }
+        if bucket_fn is not None:
+            try:
+                doc["buckets"] = bucket_fn()
+            except Exception:  # noqa: BLE001 — a racing scorer must not kill the read
+                pass
+        return doc
 
 
 class RecompileStormCheck:

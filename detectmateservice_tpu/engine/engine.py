@@ -76,9 +76,22 @@ class BatchProcessor(Protocol):
 
     ``process_batch`` returns the in-order outputs that are *ready* — a
     pipelined processor may defer a batch's results to a later call to
-    overlap device compute/readback with host-side work; ordering across
-    calls must be preserved. ``flush()`` (optional) drains anything pending
-    and is called by the engine when the input goes idle and at stop.
+    overlap device compute/readback with host-side work, and a COALESCING
+    processor (the scorer's deadline-aware batcher) may additionally hold
+    input rows across calls, releasing them as device batches later;
+    ordering across calls must be preserved either way. ``flush()``
+    (optional) drains anything pending — including held rows — and is
+    called by the engine when the input goes idle and at stop.
+
+    Optional poll plumbing the engine honors when present:
+
+    * ``pending_count()`` — in-flight results plus held rows; while > 0 the
+      engine polls with a short recv timeout and calls ``drain_ready()`` on
+      each timeout tick so deferred results (and deadline releases) land
+      within one tick, not at the idle lull;
+    * ``drain_poll_ms`` — the short-poll width a deadline-aware processor
+      needs (e.g. ``batch_deadline_ms / 4``); without it the engine ticks
+      at 5 ms.
     """
 
     def process_batch(self, data: List[bytes]) -> List[Optional[bytes]]: ...
@@ -483,7 +496,17 @@ class Engine:
         # device readback while new traffic queues in the socket buffer
         drain_fn = getattr(self.processor, "drain_ready", None)
         base_timeout = self.settings.engine_recv_timeout
-        short_timeout = min(5, base_timeout)
+        # deadline-aware processors (the scorer's coalescer) export a drain
+        # poll hint — tick at ~deadline/4 so a held row's release lands
+        # within one tick of its budget without hard-coding 5 ms polling
+        # onto second-scale budgets; 5 ms stays the default for plain
+        # pipelined processors
+        try:
+            hint = int(getattr(self.processor, "drain_poll_ms", 0) or 0)
+        except (TypeError, ValueError):
+            hint = 0
+        short_timeout = (min(base_timeout, max(1, hint)) if hint > 0
+                         else min(5, base_timeout))
         current_timeout = base_timeout
         # dmlint: hot-loop
         while self._running and not self._stop_event.is_set():

@@ -249,3 +249,25 @@ BUCKET_SELECTED = _series(
     "accelerator)",
     BUCKET_LABELS,
 )
+
+# adaptive continuous batching (library/detectors/jax_scorer.py coalescer):
+# rows held across process_batch calls toward the best-fitting warm bucket
+# under a latency budget. Depth is the current hold; releases count why
+# each coalesced batch left — full (target occupancy reached), deadline
+# (oldest row's batch_deadline_ms budget spent), flush (idle/teardown
+# drain). A deadline-dominated mix with low occupancy means the budget is
+# too small for the arrival rate (ops/alerts.yml BatchOccupancyLow).
+COALESCE_DEPTH = _series(
+    Gauge,
+    "detector_coalesce_depth",
+    "Rows currently held by the adaptive batch coalescer, waiting for a "
+    "bucket to fill or for the oldest row's deadline",
+)
+RELEASE_LABELS = ("component_type", "component_id", "reason")
+DEADLINE_RELEASES = _series(
+    Counter,
+    "detector_deadline_releases_total",
+    "Coalesced micro-batch releases by reason: full (target occupancy "
+    "reached), deadline (latency budget spent), flush (idle/teardown)",
+    RELEASE_LABELS,
+)

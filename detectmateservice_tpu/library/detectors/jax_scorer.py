@@ -20,6 +20,7 @@ Phases:
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Dict, List, Optional
 
@@ -75,6 +76,34 @@ class JaxScorerDetectorConfig(CoreDetectorConfig):
     # back to the host; hides device→host readback latency behind the next
     # batch's CPU featurization (jax dispatch is async)
     pipeline_depth: int = 8
+    # -- adaptive continuous batching (the coalescer) --------------------
+    # > 0 enables deadline-aware micro-batch coalescing on the fitted
+    # dispatch path: rows accumulate ACROSS process_batch/process_frames
+    # calls toward the best-fitting warm compile bucket instead of
+    # dispatching whatever one engine recv delivered, releasing when the
+    # largest warm bucket fills to batch_target_occupancy ("full"), when
+    # the oldest held row's wait approaches this budget ("deadline"), or
+    # at engine idle/teardown ("flush"). The oldest-row wait is bounded by
+    # batch_deadline_ms + one engine drain tick (the detector exports
+    # drain_poll_ms = deadline/4 as the engine's short-poll hint). 0 = off:
+    # every call dispatches what it got — the legacy behavior.
+    batch_deadline_ms: float = 0.0
+    # early-release threshold: dispatch as soon as the held rows fill this
+    # fraction of the LARGEST active warm bucket — waiting longer cannot
+    # raise occupancy (the next rows start a new batch), only latency
+    batch_target_occupancy: float = 0.9
+    # bucket retirement (coalescing only): every interval, active warm
+    # device buckets that saw fewer than bucket_retire_min_dispatches
+    # dispatches in the window are retired — their rows pad up to the next
+    # warm bucket — shrinking the live compile set the XLA ledger tracks
+    # (fewer shapes to keep warm across refits/param swaps). A retired
+    # bucket that keeps winning best-fit anyway is resurrected via an
+    # EXPECTED pre-warm compile before its first dispatch use, so
+    # retirement can never page as an unexpected recompile. 0 = never
+    # retire. The largest warm bucket is the pad-up backstop and is never
+    # retired.
+    bucket_retire_interval_s: float = 0.0
+    bucket_retire_min_dispatches: int = 2
     # overlap host→device upload + jit dispatch with the engine thread's
     # featurize/drain work: >0 moves the _score_dev call for each batch onto
     # N background dispatch workers. On a tunneled TPU every device_put /
@@ -134,15 +163,21 @@ class _InflightSlot:
     dispatch order regardless of which thread ran the jax calls.
 
     Telemetry fields (engine/device_obs.py batch spans): ``t_enqueue`` is
-    dispatch-call time, ``t_start`` when the scoring call actually began
-    (worker pickup), ``trace_id`` the flight recorder's last completed
-    trace at dispatch — the link from a device batch back to PR-1 traces."""
+    dispatch-call time (for a coalesced release, the OLDEST held row's
+    arrival — so queue-wait telemetry includes the coalescer hold),
+    ``t_start`` when the scoring call actually began (worker pickup),
+    ``trace_id`` the flight recorder's last completed trace at dispatch —
+    the link from a device batch back to PR-1 traces — and ``release`` why
+    the coalescer let the batch go (full/deadline/flush; None
+    uncoalesced)."""
 
     __slots__ = ("scores", "raws", "real", "error", "done",
-                 "t_enqueue", "t_start", "bucket", "path", "trace_id")
+                 "t_enqueue", "t_start", "bucket", "path", "trace_id",
+                 "release")
 
     def __init__(self, raws, real: int, bucket: int = 0,
-                 path: str = "device", trace_id: Optional[str] = None):
+                 path: str = "device", trace_id: Optional[str] = None,
+                 release: Optional[str] = None):
         import threading
 
         self.scores = None
@@ -155,6 +190,130 @@ class _InflightSlot:
         self.bucket = bucket
         self.path = path
         self.trace_id = trace_id
+        self.release = release
+
+
+class _ChainRaws:
+    """Lazy concatenation of per-segment raw-message sequences (lists or
+    native ``SpanRaws``): a coalesced release merges rows from several
+    ``process_batch``/``process_frames`` calls into one dispatch without
+    materializing a bytes object per row — only the ~1% anomalous rows are
+    sliced out at alert-construction time (`_drain_one`)."""
+
+    __slots__ = ("_segs", "_len")
+
+    def __init__(self, segs):
+        self._segs = segs
+        self._len = sum(len(s) for s in segs)
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            # the dispatch/chunking path slices (contiguous, step 1): keep
+            # the result lazy too
+            start, stop, step = i.indices(self._len)
+            if step != 1:
+                return [self[j] for j in range(start, stop, step)]
+            out, pos = [], 0
+            for seg in self._segs:
+                n = len(seg)
+                lo, hi = max(start - pos, 0), min(stop - pos, n)
+                if lo < hi:
+                    out.append(seg[lo:hi])
+                pos += n
+                if pos >= stop:
+                    break
+            return _ChainRaws(out)
+        if i < 0:
+            i += self._len
+        for seg in self._segs:
+            if i < len(seg):
+                return seg[i]
+            i -= len(seg)
+        raise IndexError("row index out of range")
+
+
+class _BatchCoalescer:
+    """Deadline-aware row accumulator between the engine and the device.
+
+    Pure host-side FIFO bookkeeping, single-owner (only the engine thread
+    touches it, like the rest of the dispatch path — no lock). Rows arrive
+    as (tokens, raws) segments stamped with their arrival time; ``take``
+    pops the oldest ``n`` rows across segment boundaries, preserving both
+    order and each remainder segment's original arrival stamp (the
+    deadline is per-ROW age, not per-call). The release POLICY — target
+    occupancy, warm-bucket choice, retirement — lives in the detector,
+    which owns the warm set and the XLA ledger."""
+
+    __slots__ = ("deadline_s", "target_occupancy", "releases", "rows_in",
+                 "max_wait_s", "wait_sum_s", "wait_n", "retired_total",
+                 "_segs", "_total")
+
+    def __init__(self, deadline_s: float, target_occupancy: float) -> None:
+        from collections import deque
+
+        self.deadline_s = deadline_s
+        self.target_occupancy = target_occupancy
+        self.releases = {"full": 0, "deadline": 0, "flush": 0}
+        self.rows_in = 0
+        self.max_wait_s = 0.0
+        self.wait_sum_s = 0.0
+        self.wait_n = 0
+        self.retired_total = 0
+        self._segs: Any = deque()   # (t_arrival, tokens [k, S], raws)
+        self._total = 0
+
+    def __len__(self) -> int:
+        return self._total
+
+    def add(self, tokens: np.ndarray, raws, now: float) -> None:
+        if not len(tokens):
+            return
+        self._segs.append((now, tokens, raws))
+        self._total += len(tokens)
+        self.rows_in += len(tokens)
+
+    def oldest_age(self, now: float) -> float:
+        return 0.0 if not self._segs else max(0.0, now - self._segs[0][0])
+
+    def due(self, now: float) -> bool:
+        """True once the oldest row's wait APPROACHES the deadline: release
+        one drain tick (deadline/4, the exported engine poll hint) early,
+        so the wait lands at ~the budget instead of one tick past it."""
+        if not self._segs:
+            return False
+        return self.oldest_age(now) >= self.deadline_s * 0.75
+
+    def take(self, n: int):
+        """Pop the ``n`` oldest rows → (tokens [n, S], raws, t_oldest)."""
+        t_oldest = self._segs[0][0]
+        parts, raw_segs, got = [], [], 0
+        while got < n:
+            t, tok, raws = self._segs.popleft()
+            want = n - got
+            if want < len(tok):
+                parts.append(tok[:want])
+                raw_segs.append(raws[:want])
+                # the remainder keeps ITS arrival stamp — splitting a call's
+                # rows across releases must not reset their deadline clock
+                self._segs.appendleft((t, tok[want:], raws[want:]))
+                got = n
+            else:
+                parts.append(tok)
+                raw_segs.append(raws)
+                got += len(tok)
+        self._total -= n
+        tokens = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        raws = raw_segs[0] if len(raw_segs) == 1 else _ChainRaws(raw_segs)
+        return tokens, raws, t_oldest
+
+    def note_release(self, reason: str, wait_s: float) -> None:
+        self.releases[reason] = self.releases.get(reason, 0) + 1
+        self.max_wait_s = max(self.max_wait_s, wait_s)
+        self.wait_sum_s += max(0.0, wait_s)
+        self.wait_n += 1
 
 
 class JaxScorerDetector(CoreDetector):
@@ -212,6 +371,21 @@ class JaxScorerDetector(CoreDetector):
         self._obs_backend = "unknown"
         self._batch_obs: Dict[str, tuple] = {}
         self._bucket_children: Dict[tuple, Any] = {}
+        # adaptive continuous batching (batch_deadline_ms > 0): the
+        # coalescer holds rows across calls; the warm/retired sets drive
+        # its bucket choice (engine-thread-owned, like _inflight). Every
+        # bucket enters _device_warm through an EXPECTED compile (setup_io
+        # warm-up or _warm_device_bucket), so coalesced dispatch can never
+        # page as an unexpected recompile.
+        self._coalescer: Optional[_BatchCoalescer] = None
+        self._device_warm: set = set()        # pre-warmed device buckets
+        self._retired_buckets: set = set()    # retired from the active set
+        self._retired_hits: Dict[int, int] = {}   # best-fit pressure window
+        self._bucket_usage: Dict[int, int] = {}   # dispatches since sweep
+        self._retire_last_sweep: Optional[float] = None
+        self._coalesce_gauge = None
+        self._release_children: Dict[str, Any] = {}
+        self._occ_stats = (0, 0.0)            # (dispatches, occupancy sum)
         if self.config.featurize_threads > 0:
             kern = self._matchkern()
             if kern is not None:
@@ -255,6 +429,17 @@ class JaxScorerDetector(CoreDetector):
             raise LibraryError(
                 f"unknown head_impl {cfg.head_impl!r}; expected 'auto', "
                 "'einsum', or 'pallas'")
+        if cfg.batch_deadline_ms < 0:
+            raise LibraryError(
+                f"batch_deadline_ms must be >= 0 (got {cfg.batch_deadline_ms})")
+        if not 0.0 < cfg.batch_target_occupancy <= 1.0:
+            raise LibraryError(
+                "batch_target_occupancy must be in (0, 1] "
+                f"(got {cfg.batch_target_occupancy})")
+        if cfg.bucket_retire_interval_s < 0:
+            raise LibraryError(
+                "bucket_retire_interval_s must be >= 0 "
+                f"(got {cfg.bucket_retire_interval_s})")
 
     # -- lifecycle ------------------------------------------------------
     def setup_io(self) -> None:
@@ -282,6 +467,7 @@ class JaxScorerDetector(CoreDetector):
             for b in (*small, self.config.train_batch_size, self.config.max_batch):
                 bucket = _bucket(b, self.config.max_batch)
                 tokens = np.zeros((bucket, self.config.seq_len), np.int32)
+                self._device_warm.add(bucket)  # the coalescer's seed warm set
                 with self._ledger.context(bucket=bucket):
                     if position:
                         self._norm_mu, self._norm_sigma = (
@@ -319,6 +505,10 @@ class JaxScorerDetector(CoreDetector):
 
         self._ledger = device_obs.get_ledger()
         device_obs.install_listener()
+        # GET /admin/xla reports the live warm/retired bucket sets next to
+        # the compile history they explain (bucket retirement shrinks the
+        # compile set the ledger tracks — make that observable)
+        self._ledger.set_bucket_state_provider(self._bucket_state)
         cfg = self.config
         self._validate_static_config()
         import jax.numpy as jnp
@@ -804,8 +994,18 @@ class JaxScorerDetector(CoreDetector):
         ready: List[Optional[bytes]] = []  # outputs from drained older batches
         if detect_idx:
             n = len(detect_idx)
-            self._dispatch(tokens[detect_idx], [batch[i] for i in detect_idx])
+            coalescer = self._get_coalescer()
+            if coalescer is not None:
+                # continuous batching: hold the rows toward a warm bucket;
+                # _coalesce_pump below decides what (if anything) dispatches
+                coalescer.add(tokens[detect_idx],
+                              [batch[i] for i in detect_idx],
+                              time.monotonic())
+            else:
+                self._dispatch(tokens[detect_idx],
+                               [batch[i] for i in detect_idx])
             self._count_device_lines(n)
+        self._coalesce_pump()
         # event-driven drain: anything whose readback already landed goes out
         # NOW (bounded latency even under a steady stream that never lulls);
         # the depth gate stays as the backstop that also bounds memory
@@ -891,8 +1091,15 @@ class JaxScorerDetector(CoreDetector):
             raws = matchkern.SpanRaws(fb.blob, fb.spans[idx])
             n_ok = len(idx)
         if n_ok:
-            self._dispatch(tokens, raws)
+            coalescer = self._get_coalescer()
+            if coalescer is not None:
+                # SpanRaws segments stay lazy inside the coalescer — no
+                # per-message bytes objects until alert construction
+                coalescer.add(tokens, raws, time.monotonic())
+            else:
+                self._dispatch(tokens, raws)
             self._count_device_lines(n_ok)
+        self._coalesce_pump()
         while self._inflight and self._head_ready():
             ready.extend(self._drain_one())
         while len(self._inflight) > self.config.pipeline_depth:
@@ -933,10 +1140,24 @@ class JaxScorerDetector(CoreDetector):
         return False  # cannot tell: leave it to the depth gate / flush
 
     def pending_count(self) -> int:
-        """In-flight scored batches not yet drained (engine poll hint: while
-        results are pending the engine shortens its recv timeout so a drain
-        happens within milliseconds of readiness, not at the 100 ms lull)."""
-        return len(self._inflight)
+        """In-flight scored batches not yet drained, plus one while the
+        coalescer holds rows (engine poll hint: while results are pending —
+        or a held row's deadline is ticking — the engine shortens its recv
+        timeout so a drain/release happens within one tick of readiness,
+        not at the 100 ms lull)."""
+        held = self._coalescer is not None and len(self._coalescer) > 0
+        return len(self._inflight) + (1 if held else 0)
+
+    @property
+    def drain_poll_ms(self) -> Optional[int]:
+        """Engine short-poll hint (engine.py): while the coalescer may hold
+        rows, the engine must tick often enough to honor batch_deadline_ms.
+        A quarter of the budget bounds the oldest-row overshoot to one tick
+        (the coalescer also releases one tick EARLY — _BatchCoalescer.due),
+        without hard-coding 5 ms polling onto second-scale budgets."""
+        if self.config.batch_deadline_ms <= 0:
+            return None
+        return max(1, int(self.config.batch_deadline_ms / 4))
 
     def drained_total(self) -> int:
         """Monotonic count of drained in-flight batches — the progress
@@ -951,6 +1172,7 @@ class JaxScorerDetector(CoreDetector):
         flush (otherwise nothing would ever drain on short ticks)."""
         out: List[Optional[bytes]] = []
         self._finish_fit(wait=False)
+        self._coalesce_pump()  # deadline releases ride the short-poll tick
         while self._inflight and self._head_ready():
             out.extend(self._drain_one())
         if self._inflight and self._ready_supported is False:
@@ -1012,17 +1234,32 @@ class JaxScorerDetector(CoreDetector):
                 tokens = np.stack([t for t, _ in self._pending])
                 raws = [r for _, r in self._pending]
                 self._pending = []
-                self._dispatch(tokens, raws)
+                coalescer = self._get_coalescer()
+                if coalescer is not None:
+                    # the backlog's size is whatever the fit's duration made
+                    # it — bucketing it through the coalescer (released by
+                    # the caller's pump) keeps it on warm compile shapes
+                    coalescer.add(tokens, raws, time.monotonic())
+                else:
+                    self._dispatch(tokens, raws)
                 self._count_device_lines(len(raws))
 
-    def _dispatch(self, tokens: np.ndarray, msgs: List[Any]) -> None:
+    def _dispatch(self, tokens: np.ndarray, msgs: List[Any],
+                  t_enqueue: Optional[float] = None,
+                  release: Optional[str] = None) -> None:
         """Asynchronously score [n, S] tokens, padded to a compile bucket.
 
         Small batches (≤ ``host_score_max_batch``) score synchronously on the
         CPU twin instead: on a remote/tunneled accelerator a lone message
         would otherwise pay two ~70 ms transfer round-trips for ~µs of MXU
         work. The host result enters the same in-flight queue (as a ready
-        numpy array) so ordering with accelerator batches is preserved."""
+        numpy array) so ordering with accelerator batches is preserved.
+
+        A coalesced release (``release`` set) backdates ``t_enqueue`` to the
+        oldest held row's arrival — queue-wait telemetry then includes the
+        coalescer hold — and buckets against the ACTIVE warm set
+        (``_pick_device_bucket``) instead of the raw power-of-two rule, so
+        every coalesced dispatch rides a pre-warmed compile shape."""
         self._ensure_scorer()
         n = len(tokens)
         cap = self.config.host_score_max_batch
@@ -1041,7 +1278,10 @@ class JaxScorerDetector(CoreDetector):
                         [tokens, np.zeros((bucket - n, tokens.shape[1]), np.int32)])
                 slot = _InflightSlot(list(msgs), n, bucket=bucket,
                                      path="host",
-                                     trace_id=self._current_trace_id())
+                                     trace_id=self._current_trace_id(),
+                                     release=release)
+                if t_enqueue is not None:
+                    slot.t_enqueue = t_enqueue
                 slot.t_start = time.monotonic()
                 # only warmed host buckets reach here, so a compile in this
                 # context IS an unexpected recompile (a warm-set bug)
@@ -1054,7 +1294,11 @@ class JaxScorerDetector(CoreDetector):
                 self._observe_batch(slot, time.monotonic() - slot.t_start)
                 self._inflight.append(slot)
                 return
-        bucket = _bucket(n, self.config.max_batch)
+        if release is not None:
+            bucket = self._pick_device_bucket(n)
+            self._bucket_usage[bucket] = self._bucket_usage.get(bucket, 0) + 1
+        else:
+            bucket = _bucket(n, self.config.max_batch)
         use_workers = self.config.upload_workers > 0
         if use_workers:
             self._ensure_upload_workers()
@@ -1067,7 +1311,10 @@ class JaxScorerDetector(CoreDetector):
                 )
             slot = _InflightSlot(msgs[start:start + real], real,
                                  bucket=bucket, path="device",
-                                 trace_id=self._current_trace_id())
+                                 trace_id=self._current_trace_id(),
+                                 release=release)
+            if t_enqueue is not None:
+                slot.t_enqueue = t_enqueue
             self._inflight.append(slot)
             if use_workers:
                 self._upload_queue.put((slot, chunk))
@@ -1084,6 +1331,194 @@ class JaxScorerDetector(CoreDetector):
                     except AttributeError:
                         pass
                 slot.done.set()
+
+    # -- adaptive continuous batching (the coalescer) --------------------
+    def _get_coalescer(self) -> Optional["_BatchCoalescer"]:
+        if self.config.batch_deadline_ms <= 0:
+            return None
+        if self._coalescer is None:
+            self._coalescer = _BatchCoalescer(
+                self.config.batch_deadline_ms / 1000.0,
+                self.config.batch_target_occupancy)
+        return self._coalescer
+
+    def _coalesce_pump(self, force: bool = False) -> None:
+        """Release due coalesced batches. Three reasons, in priority order:
+
+        * ``full`` — the held rows fill the largest active warm bucket to
+          ``batch_target_occupancy``; waiting longer cannot raise occupancy;
+        * ``deadline`` — the oldest held row's wait approaches
+          ``batch_deadline_ms`` (everything held goes, smaller buckets);
+        * ``flush`` — the engine's idle/teardown drain (``force``), or the
+          knob was turned off at runtime with rows still held.
+
+        Single-owner like the rest of the dispatch path: only the engine
+        thread pumps."""
+        co = self._coalescer
+        if co is None:
+            return
+        if not len(co):
+            self._observe_coalesce_depth(0)
+            return
+        if self.config.batch_deadline_ms <= 0:
+            force = True  # disabled at runtime with rows still held
+        now = time.monotonic()
+        largest = self._largest_active_bucket()
+        target = max(1, math.ceil(co.target_occupancy * largest))
+        while len(co) >= target:
+            self._release_coalesced(min(len(co), largest), "full", now)
+        if force:
+            while len(co):
+                self._release_coalesced(min(len(co), largest), "flush", now)
+        elif co.due(now):
+            while len(co):
+                self._release_coalesced(min(len(co), largest), "deadline",
+                                        now)
+        self._maybe_retire_buckets(now)
+        self._observe_coalesce_depth(len(co))
+
+    def _release_coalesced(self, n: int, reason: str, now: float) -> None:
+        tokens, raws, t_oldest = self._coalescer.take(n)
+        self._coalescer.note_release(reason, now - t_oldest)
+        self._count_release(reason)
+        self._dispatch(tokens, raws, t_enqueue=t_oldest, release=reason)
+
+    def _active_buckets(self) -> List[int]:
+        """The warm set minus retirements, sorted ascending."""
+        return sorted(self._device_warm - self._retired_buckets)
+
+    def _largest_active_bucket(self) -> int:
+        active = self._active_buckets()
+        return active[-1] if active else _bucket(self.config.max_batch,
+                                                 self.config.max_batch)
+
+    def _pick_device_bucket(self, n: int) -> int:
+        """Warm-set bucket choice for a coalesced release: the natural
+        power-of-two bucket when active (pre-warming it — an expected
+        compile — on first use), the next active bucket up when the natural
+        one is retired (padding is cheaper than resurrecting a shape the
+        usage window judged underused), resurrection once the retired
+        bucket keeps winning best-fit anyway (persistent pressure means the
+        traffic shape changed back)."""
+        cap = self.config.max_batch
+        natural = _bucket(n, cap)
+        if natural in self._device_warm and natural not in self._retired_buckets:
+            return natural
+        if natural in self._retired_buckets:
+            hits = self._retired_hits.get(natural, 0) + 1
+            self._retired_hits[natural] = hits
+            if hits <= max(1, self.config.bucket_retire_min_dispatches):
+                # pad up: the largest bucket is never retired, so an active
+                # bucket >= natural always exists
+                for b in self._active_buckets():
+                    if b >= natural:
+                        return b
+            self._retired_buckets.discard(natural)
+        self._warm_device_bucket(natural)
+        return natural
+
+    def _warm_device_bucket(self, bucket: int) -> None:
+        """Compile a device bucket BEFORE the dispatch path uses it — an
+        EXPECTED compile (where="bucket_warm"): neither adaptive warm-set
+        growth nor post-retirement resurrection may page as a recompile
+        storm. The compile stalls this one release (like any planned warm),
+        and every later dispatch on the bucket is cache-hot."""
+        self._ensure_scorer()
+        import jax
+
+        tokens = np.zeros((bucket, self.config.seq_len), np.int32)
+        with self._ledger.context(bucket=bucket, backend=self._obs_backend,
+                                  where="bucket_warm", expected=True):
+            if self._sharded is not None:
+                self._sharded.warm_bucket(tokens)
+            else:
+                jax.block_until_ready(self._score_dev(tokens))
+        self._device_warm.add(bucket)
+
+    def _maybe_retire_buckets(self, now: float) -> None:
+        interval = self.config.bucket_retire_interval_s
+        if interval <= 0 or self._coalescer is None:
+            return
+        if self._retire_last_sweep is None:
+            self._retire_last_sweep = now
+            return
+        if now - self._retire_last_sweep >= interval:
+            self._retire_sweep(now)
+
+    def _retire_sweep(self, now: float) -> None:
+        """One retirement pass over the usage window: active buckets that
+        saw fewer than ``bucket_retire_min_dispatches`` dispatches since
+        the last sweep leave the active set (their future rows pad up),
+        shrinking the compile set the XLA ledger tracks. The largest bucket
+        is the pad-up backstop and always stays."""
+        floor = max(1, self.config.bucket_retire_min_dispatches)
+        active = self._active_buckets()
+        largest = active[-1] if active else 0
+        retired = [b for b in active
+                   if b != largest and self._bucket_usage.get(b, 0) < floor]
+        for b in retired:
+            self._retired_buckets.add(b)
+        if retired:
+            self._coalescer.retired_total += len(retired)
+            import logging
+
+            logging.getLogger(__name__).info(
+                "batch coalescer retired underused bucket(s) %s "
+                "(< %d dispatches in %.1fs); active warm set now %s",
+                retired, floor, self.config.bucket_retire_interval_s,
+                self._active_buckets())
+        self._bucket_usage.clear()
+        self._retired_hits.clear()
+        self._retire_last_sweep = now
+
+    def _bucket_state(self) -> Dict[str, Any]:
+        """The ledger's bucket-state provider (GET /admin/xla)."""
+        return {
+            "coalescing": self.config.batch_deadline_ms > 0,
+            "warm": self._active_buckets(),
+            "retired": sorted(self._retired_buckets),
+        }
+
+    def batching_stats(self) -> Dict[str, Any]:
+        """Scheduler counters for the bench / smoke harnesses: releases by
+        reason, achieved occupancy, held depth, release waits, and the
+        warm/retired bucket sets (also on ``GET /admin/xla`` via the
+        ledger's bucket state)."""
+        co = self._coalescer
+        occ_n, occ_sum = self._occ_stats
+        return {
+            "enabled": self.config.batch_deadline_ms > 0,
+            "held_rows": 0 if co is None else len(co),
+            "rows_coalesced": 0 if co is None else co.rows_in,
+            "releases": dict(co.releases) if co is not None else {},
+            "max_wait_s": 0.0 if co is None else round(co.max_wait_s, 6),
+            "mean_wait_s": (round(co.wait_sum_s / co.wait_n, 6)
+                            if co is not None and co.wait_n else 0.0),
+            "buckets_retired_total": 0 if co is None else co.retired_total,
+            "dispatches": occ_n,
+            "occupancy_sum": round(occ_sum, 4),
+            "occupancy_mean": round(occ_sum / occ_n, 4) if occ_n else None,
+            "warm_buckets": self._active_buckets(),
+            "retired_buckets": sorted(self._retired_buckets),
+        }
+
+    def _observe_coalesce_depth(self, depth: int) -> None:
+        if self._coalesce_gauge is None:
+            from ...engine import metrics as m
+
+            self._coalesce_gauge = m.COALESCE_DEPTH().labels(
+                **self._obs_labels())
+        self._coalesce_gauge.set(depth)
+
+    def _count_release(self, reason: str) -> None:
+        child = self._release_children.get(reason)
+        if child is None:
+            from ...engine import metrics as m
+
+            child = m.DEADLINE_RELEASES().labels(reason=reason,
+                                                 **self._obs_labels())
+            self._release_children[reason] = child
+        child.inc()
 
     def _ensure_upload_workers(self) -> None:
         if self._upload_threads and all(t.is_alive() for t in self._upload_threads):
@@ -1177,8 +1612,11 @@ class JaxScorerDetector(CoreDetector):
         a 100 ms lull does not mean the input stays idle, so waiting out a
         running boundary fit here would stall the engine loop and drop
         messages at the socket HWM (the failure async_fit exists to prevent).
-        A finished fit's backlog is dispatched; a running fit is left alone."""
+        A finished fit's backlog is dispatched; a running fit is left alone.
+        Coalesced rows release unconditionally (reason "flush"): an idle
+        lull or teardown must never strand held rows."""
         self._finish_fit(wait=False)
+        self._coalesce_pump(force=True)
         out: List[Optional[bytes]] = []
         while self._inflight:
             out.extend(self._drain_one())
@@ -1315,6 +1753,10 @@ class JaxScorerDetector(CoreDetector):
         occ_h.observe(slot.real / bucket)
         wait_h.observe(queue_wait_s)
         dev_h.observe(max(0.0, device_s))
+        # running (dispatches, occupancy-sum) pair: the bench/smoke
+        # harnesses read deltas of it per load phase (batching_stats)
+        occ_n, occ_sum = self._occ_stats
+        self._occ_stats = (occ_n + 1, occ_sum + slot.real / bucket)
         bucket_child = self._bucket_children.get((bucket, path))
         if bucket_child is None:
             bucket_child = m.BUCKET_SELECTED().labels(
@@ -1323,7 +1765,8 @@ class JaxScorerDetector(CoreDetector):
         bucket_child.inc()
         if self._ledger is not None:
             self._ledger.record_span(bucket, slot.real, path, queue_wait_s,
-                                     max(0.0, device_s), slot.trace_id)
+                                     max(0.0, device_s), slot.trace_id,
+                                     release=slot.release)
 
     # -- runtime reconfigure (POST /admin/reconfigure end-to-end) --------
     def validate_reconfigure(self, new_config) -> None:
@@ -1352,6 +1795,12 @@ class JaxScorerDetector(CoreDetector):
             kern = self._matchkern()
             if kern is not None:
                 kern.set_featurize_threads(self.config.featurize_threads)
+        # batching knobs apply live: an existing coalescer re-reads the
+        # budget/target (held rows keep their original arrival stamps); a
+        # deadline turned off drains on the next pump (reason "flush")
+        if self._coalescer is not None and self.config.batch_deadline_ms > 0:
+            self._coalescer.deadline_s = self.config.batch_deadline_ms / 1000.0
+            self._coalescer.target_occupancy = self.config.batch_target_occupancy
         if self.config.score_threshold is not None:
             self._threshold = float(self.config.score_threshold)
         elif self._calib_stats is not None:

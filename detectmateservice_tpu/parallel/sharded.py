@@ -153,6 +153,18 @@ class ShardedScorer:
         return np.asarray(self._traced(self._score, self.params, tokens,
                                        bucket=len(tokens)))[:n]
 
+    def warm_bucket(self, tokens: np.ndarray) -> None:
+        """Pre-compile the sharded score path for this batch shape and block
+        until the executable exists. The detector's adaptive batcher warms
+        buckets BEFORE their first dispatch use (adaptive warm-set growth,
+        post-retirement resurrection), so the compile attributes as an
+        expected ``bucket_warm`` — never an unexpected-recompile page."""
+        with device_obs.get_ledger().context(bucket=len(tokens),
+                                             backend="mesh",
+                                             where="bucket_warm",
+                                             expected=True):
+            jax.block_until_ready(self.score_device(tokens))
+
     def score_device(self, tokens: np.ndarray) -> jax.Array:
         """Asynchronous scoring: dispatch and return the device array without
         forcing a host readback (rows beyond the caller's real batch are

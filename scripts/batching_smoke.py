@@ -1,0 +1,162 @@
+"""CI smoke for the adaptive batching scheduler: coalesce → deadline
+release → bucket retirement → flush, end to end on CPU, with the
+unexpected-recompile gate read off ``GET /admin/xla`` exactly as an
+operator would.
+
+Boots a real Service for the admin plane, trains a small jax_scorer with
+the coalescer enabled, and drives the three release reasons plus a
+retirement sweep. Exit 0 only when:
+
+* rows held across ``process_batch`` calls came back IN ORDER through a
+  deadline release, a target-occupancy (full) release, and a flush;
+* the deadline release's oldest-row wait stayed inside
+  ``batch_deadline_ms`` + one drain tick (+ CI scheduler slack);
+* bucket retirement removed an underused bucket, later rows padded up, and
+  ``/admin/xla`` reports the live warm/retired sets;
+* ``/admin/xla`` reports ZERO unexpected recompiles across all of it (the
+  few-compiled-shapes contract survives coalescing, early release,
+  retirement, and resurrection);
+* ``/metrics`` exports ``detector_deadline_releases_total`` for all three
+  reasons and a ``detector_coalesce_depth`` gauge.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+
+def http_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def http_text(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.read().decode()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+    from detectmateservice_tpu.core import Service
+    from detectmateservice_tpu.engine import device_obs
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+    from detectmateservice_tpu.library.detectors import JaxScorerDetector
+    from detectmateservice_tpu.schemas import ParserSchema, schemas_pb2 as pb
+    from detectmateservice_tpu.settings import ServiceSettings
+
+    def msg(i: int) -> bytes:
+        return ParserSchema(
+            EventID=1, template="user <*> logged in from <*>",
+            variables=[f"u{i % 8}", f"10.0.0.{i % 16}"], logID=str(i),
+            logFormatVariables={"Time": "1700000000"}).serialize()
+
+    def alert_ids(outs) -> list:
+        ids = []
+        for o in outs:
+            if o is not None:
+                d = pb.DetectorSchema()
+                d.ParseFromString(o)
+                ids.append(int(d.logIDs[0]))
+        return ids
+
+    device_obs.get_ledger().reset()
+    service = Service(
+        ServiceSettings(component_type="core", component_name="batchsmoke",
+                        engine_addr="inproc://batching-smoke",
+                        engine_autostart=False, http_port=0,
+                        log_to_file=False, watchdog_enabled=False),
+        socket_factory=InprocQueueSocketFactory())
+    service.web_server.start()
+    try:
+        port = service.web_server.port
+        deadline_ms = 80.0
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+            "data_use_training": 32, "train_epochs": 1, "min_train_steps": 5,
+            "seq_len": 16, "dim": 32, "max_batch": 32, "async_fit": False,
+            "host_score_max_batch": 0, "score_threshold": -1e9,
+            "batch_deadline_ms": deadline_ms, "batch_target_occupancy": 0.9,
+            "bucket_retire_interval_s": 3600.0,
+            "bucket_retire_min_dispatches": 2}}})
+        det.health_monitor = service.health
+        det.setup_io()
+        assert det.process_batch([msg(i) for i in range(32)]) == []
+        det.flush_final()
+        print(f"trained; warm buckets: {det.batching_stats()['warm_buckets']}")
+
+        # 1. coalesce → deadline release, in order
+        assert det.process_batch([msg(100), msg(101)]) == []
+        assert det.process_batch([msg(102)]) == []
+        assert det.pending_count() == 1, "held rows must short-poll the engine"
+        outs, t0 = [], time.monotonic()
+        tick_s = det.drain_poll_ms / 1000.0
+        while len(det._coalescer) and time.monotonic() - t0 < 5:
+            outs.extend(det.drain_ready())   # the engine's short-poll tick
+            time.sleep(tick_s)
+        outs.extend(det.flush())
+        assert alert_ids(outs) == [100, 101, 102], alert_ids(outs)
+        stats = det.batching_stats()
+        assert stats["releases"]["deadline"] == 1, stats
+        bound = deadline_ms / 1000.0 + tick_s + 0.25
+        assert stats["max_wait_s"] <= bound, (stats["max_wait_s"], bound)
+        print(f"deadline release ok: wait {stats['max_wait_s'] * 1000:.1f} ms "
+              f"<= {deadline_ms} ms budget + one tick")
+
+        # 2. target-occupancy (full) release
+        outs = det.process_batch([msg(200 + i) for i in range(30)])
+        stats = det.batching_stats()
+        assert stats["releases"]["full"] >= 1, stats
+        outs += det.flush()
+        assert alert_ids(outs) == list(range(200, 230))
+        print(f"full release ok: occupancy mean "
+              f"{det.batching_stats()['occupancy_mean']}")
+
+        # 3. retirement: the 4-bucket saw one dispatch, the floor is 2
+        det._retire_sweep(time.monotonic())
+        stats = det.batching_stats()
+        assert stats["retired_buckets"], "sweep retired nothing"
+        det.process_batch([msg(300), msg(301), msg(302)])
+        outs = det.flush()   # pads up past the retired best-fit bucket
+        assert alert_ids(outs) == [300, 301, 302]
+        assert det.batching_stats()["releases"]["flush"] >= 1
+        print(f"retirement ok: retired {stats['retired_buckets']}, "
+              f"active {stats['warm_buckets']}")
+
+        # 4. the operator view: /admin/xla gates the whole run
+        xla = http_json(port, "/admin/xla")
+        assert xla["warmup_complete"] is True
+        assert xla["totals"]["unexpected"] == 0, (
+            f"unexpected recompiles during coalescing/retirement: "
+            f"{xla['totals']}")
+        assert xla["buckets"]["coalescing"] is True
+        assert xla["buckets"]["retired"], xla["buckets"]
+        flagged = [e for e in xla["compiles"] if e["unexpected"]]
+        assert not flagged, flagged
+        print(f"/admin/xla ok: {xla['totals']['compiles']} compiles, "
+              f"0 unexpected, buckets {xla['buckets']}")
+
+        # 5. the scheduler series are exported
+        metrics = http_text(port, "/metrics")
+        for reason in ("full", "deadline", "flush"):
+            needle = f'reason="{reason}"'
+            assert ("detector_deadline_releases_total" in metrics
+                    and needle in metrics), f"missing release counter {reason}"
+        assert "detector_coalesce_depth" in metrics
+        print("metrics ok: release counters for all three reasons + depth gauge")
+        print("BATCHING SMOKE PASSED")
+        return 0
+    finally:
+        service.web_server.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -205,6 +205,64 @@ class TestDetectorThroughput:
         # magnitude collapses; recompiles are caught exactly, above
         assert best > 500, f"CPU scorer path collapsed to {best:,.0f} lines/s"
 
+    def test_coalesced_dispatch_occupancy_and_no_recompiles(self):
+        """Heavy-load acceptance for the adaptive coalescer: RAGGED calls
+        (sizes the fixed power-of-two dispatch would pad badly) coalesce
+        across process_batch boundaries into warm buckets at >= 0.9 mean
+        occupancy, with zero new XLA compilations in the steady-state loop
+        — the same deterministic recompile guard as the classic path."""
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        batch = 1024
+        det = JaxScorerDetector(config={"detectors": {"JaxScorerDetector": {
+            "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+            "data_use_training": 512, "train_epochs": 1, "min_train_steps": 10,
+            "seq_len": 32, "dim": 64, "max_batch": batch,
+            "threshold_sigma": 8.0, "async_fit": False,
+            "host_score_max_batch": 0,
+            # a deliberately huge budget: THIS test pins the full/flush
+            # regime deterministically (release sizes must repeat exactly
+            # for the zero-new-compiles assertion); the deadline bound has
+            # its own wall-clock tests in tests/test_batching.py
+            "batch_deadline_ms": 10_000.0, "batch_target_occupancy": 0.9}}})
+        det.process_batch(make_parsed(512))
+        det.flush_final()
+        msgs = make_parsed(300)  # a mid-bucket ragged call size
+
+        def one_cycle():
+            for _ in range(14):  # 4200 rows: 4 full 1024-chunks + tail
+                det.process_batch(msgs)
+            det.flush()
+
+        one_cycle()  # warm cycle: compiles every bucket the pattern uses
+
+        def cache_sizes():
+            sizes = {}
+            for fn_name in ("_score", "_train", "_token_nlls", "_normscore"):
+                fn = getattr(det._scorer, fn_name, None)
+                cache_size = getattr(fn, "_cache_size", None)
+                if callable(cache_size):
+                    sizes[fn_name] = cache_size()
+            return sizes
+
+        warmed = cache_sizes()
+        before = det.batching_stats()
+        for _ in range(3):
+            one_cycle()
+        assert cache_sizes() == warmed, (
+            f"coalesced steady state recompiled: {warmed} -> {cache_sizes()}")
+        after = det.batching_stats()
+        d_n = after["dispatches"] - before["dispatches"]
+        d_occ = after["occupancy_sum"] - before["occupancy_sum"]
+        assert d_n > 0
+        occupancy = d_occ / d_n
+        assert occupancy >= 0.9, (
+            f"coalesced occupancy {occupancy:.3f} below the 0.9 target "
+            f"(releases: {after['releases']})")
+        # heavy load must coalesce, not deadline out
+        full_delta = after["releases"]["full"] - before["releases"]["full"]
+        assert full_delta >= 3 * 4
+
 
 class TestTemplateMatchThroughput:
     def test_matcher_parser_rate(self):
