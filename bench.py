@@ -155,6 +155,118 @@ def _child_exit(payload: dict) -> None:
     os._exit(0)
 
 
+def child_host() -> None:
+    """Host-path micro-bench (no device, no jax): per-stage seconds for the
+    zero-copy host path — LogSchema parse (native decode + template match +
+    native ParserSchema serialize), featurize (native tokenizer), and
+    transit (shm publish/resolve round-trip) — plus the per-core rate vs
+    the recorded pre-PR CPU floor. The ≥10× multiple is the PR-7 acceptance
+    bar (ROADMAP open item 3), machine-checkable from the BENCH record."""
+    import tempfile
+
+    from detectmateservice_tpu.engine.framing import pack_batch
+    from detectmateservice_tpu.library.parsers.template_matcher import (
+        MatcherParser,
+    )
+    from detectmateservice_tpu.schemas import LogSchema
+    from detectmateservice_tpu.utils import matchkern
+
+    n = int(os.environ.get("DETECTMATE_BENCH_HOST_N", "65536"))
+    comms = ["cron", "sshd", "systemd", "bash"]
+    payloads = [
+        LogSchema(logID=str(i),
+                  log=f"type=SYSCALL msg=audit(17000{i % 7}.{i % 997}): "
+                      f"arch=c000003e syscall={i % 30} pid={300 + i % 900} "
+                      f"uid={i % 4} comm=\"{comms[i % 4]}\"").serialize()
+        for i in range(n)]
+    frame_n = 512
+    frames = [pack_batch(payloads[i:i + frame_n])
+              for i in range(0, n, frame_n)]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tf = os.path.join(tmp, "templates.txt")
+        with open(tf, "w", encoding="utf-8") as fh:
+            fh.write("arch=<*> syscall=<*> pid=<*> uid=<*> comm=<*>\n")
+        parser = MatcherParser(config={"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": "type=<Type> msg=audit(<Time>): <Content>",
+            "params": {"path_templates": tf}}}})
+        native_parse = parser._parse_native is not None
+
+        # stage 1: parse — raw wire frames in, ParserSchema bytes out
+        t0 = time.perf_counter()
+        outs = []
+        for start in range(0, len(frames), 16):
+            out, _n_msgs, _n_lines = parser.process_frames(
+                frames[start:start + 16])
+            outs.extend(out)
+        parse_s = time.perf_counter() - t0
+        good = [o for o in outs if o is not None]
+
+        # stage 2: featurize — ParserSchema bytes → token rows (the
+        # detector's CPU side, native row-parallel kernel)
+        t0 = time.perf_counter()
+        _tokens, ok = matchkern.featurize_batch(good, 32, 50000)
+        featurize_s = time.perf_counter() - t0
+
+        # stage 3: transit — parser→detector hop as shm publish/resolve
+        # (zero_copy_framing); plain pack/unpack when the kernel is absent
+        out_frames = [pack_batch(good[i:i + frame_n])
+                      for i in range(0, len(good), frame_n)]
+        shm_mode = False
+        try:
+            from detectmateservice_tpu.engine.shm import (
+                ShmReader, ShmWriter, shm_available,
+            )
+
+            shm_mode = shm_available()
+        except ImportError:
+            shm_mode = False
+        if shm_mode:
+            writer = ShmWriter(slots=8, slot_bytes=1 << 20)
+            reader = ShmReader()
+            t0 = time.perf_counter()
+            for frame in out_frames:
+                ref = writer.publish(frame, refs=1)
+                moved = (reader.resolve_release(ref) if ref is not None
+                         else frame)
+                assert len(moved) == len(frame)
+            transit_s = time.perf_counter() - t0
+            reader.close()
+            writer.close()
+        else:
+            from detectmateservice_tpu.engine.framing import unpack_batch
+
+            t0 = time.perf_counter()
+            for frame in out_frames:
+                unpack_batch(frame)
+            transit_s = time.perf_counter() - t0
+
+    total_s = parse_s + featurize_s + transit_s
+    lines_per_s = n / total_s
+    cores = os.cpu_count() or 1
+    per_core = lines_per_s / cores
+    multiple = per_core / CPU_FLOOR_LINES_PER_S_PER_CORE
+    _child_exit({
+        "n": n,
+        "parse_s": round(parse_s, 4),
+        "featurize_s": round(featurize_s, 4),
+        "transit_s": round(transit_s, 4),
+        "transit_mode": "shm_zero_copy" if shm_mode else "copy",
+        "native_parse": native_parse,
+        "native_featurize_ok": int(ok.sum()),
+        "lines_per_s": round(lines_per_s, 1),
+        "cpu_cores": cores,
+        "lines_per_s_per_core": round(per_core, 1),
+        # before: the recorded pre-PR per-core CPU insurance floor;
+        # after: the measured host-path per-core rate above
+        "cpu_floor_lines_per_s_per_core": CPU_FLOOR_LINES_PER_S_PER_CORE,
+        "floor_multiple": round(multiple, 2),
+        "floor_multiple_target": 10.0,
+        "floor_10x_ok": multiple >= 10.0,
+    })
+
+
 def child_probe() -> None:
     """Initialize the jax backend and report the platform (hang/crash guard
     runs in the parent)."""
@@ -469,6 +581,13 @@ def main() -> None:
     cpu_retried = False
     cpu_result: dict | None = None
 
+    # ---- host-path plane (parse/featurize/transit breakdown) -------------
+    # no platform pin: this stage never imports jax, so it cannot touch a
+    # wedged tunnel, and the unpinned child keeps the stage distinguishable
+    # from CPU run children for the orchestration's scripted stubs
+    host_child: _Child | None = _Child("host", RUN_TIMEOUT_S)
+    host_result: dict | None = None
+
     # ---- TPU acquisition plane ------------------------------------------
     tpu_probe: _Child | None = _Child("probe", PROBE_TIMEOUT_S)
     last_probe_start = time.monotonic()
@@ -513,6 +632,9 @@ def main() -> None:
                 cpu_retried = True       # one smoke retry, as before
                 cpu_run = _Child("run", RUN_TIMEOUT_S, platform="cpu",
                                  arg=str(SMOKE_N))
+        if host_child is not None and host_child.poll():
+            host_result = harvest(host_child)
+            host_child = None
         if cpu_run is not None and cpu_run.poll():
             res = harvest(cpu_run)
             cpu_run = None
@@ -579,12 +701,13 @@ def main() -> None:
         cpu_active = cpu_probe is not None or cpu_smoke is not None or cpu_run is not None
         tpu_abandoned = (tpu_run_failures >= MAX_TPU_RUN_FAILURES
                          or tpu_probe_timed_out)
-        if (not tpu_active and not cpu_active
+        if (not tpu_active and not cpu_active and host_child is None
                 and (tpu_result is not None or tpu_abandoned)):
             break
         time.sleep(0.5)
 
-    for child in (cpu_probe, cpu_smoke, cpu_run, tpu_probe, tpu_run):
+    for child in (cpu_probe, cpu_smoke, cpu_run, tpu_probe, tpu_run,
+                  host_child):
         if child is not None:
             child.cancel()
             diags.append(child.diag)
@@ -605,6 +728,11 @@ def main() -> None:
             # the scheduler counters ride into the BENCH_*.json record: the
             # occupancy/queue-wait story under production-shaped load
             out["open_loop"] = best["open_loop"]
+        if host_result is not None:
+            # per-stage host-path breakdown + the ≥10× per-core floor check
+            # (PR 7 acceptance): parse vs featurize vs transit seconds, and
+            # cpu_floor_lines_per_s_per_core before/after, machine-checkable
+            out["host_path"] = host_result
         if best.get("platform") == "cpu":
             cores = best.get("cpu_cores") or os.cpu_count() or 1
             per_core = best["lines_per_s"] / cores
@@ -628,14 +756,18 @@ def main() -> None:
               + json.dumps(diags), file=sys.stderr)
     else:
         # total failure: still ONE JSON line, still rc=0, with diagnostics
-        print(json.dumps({
+        # (the host-path breakdown rides along when ITS stage survived)
+        failure = {
             "metric": "audit_log_lines_per_sec_through_detector",
             "value": 0.0,
             "unit": "lines/s",
             "vs_baseline": 0.0,
             "error": "all benchmark stages failed",
             "diagnostics": diags,
-        }))
+        }
+        if host_result is not None:
+            failure["host_path"] = host_result
+        print(json.dumps(failure))
     sys.stdout.flush()
     sys.exit(0)
 
@@ -664,5 +796,7 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--run":
         apply_child_platform_pin()
         child_run(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--host":
+        child_host()    # no platform pin: this stage never imports jax
     else:
         main()

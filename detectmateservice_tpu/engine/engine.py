@@ -37,6 +37,7 @@ from typing import List, Optional, Protocol, runtime_checkable
 from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
 from . import metrics as m
 from .framing import (
+    MAGIC_SHM,
     MAGIC_V2,
     FramingError,
     Hop,
@@ -195,6 +196,18 @@ class Engine:
             self._pair_sock.close()
             raise
 
+        # zero-copy framing (engine/shm.py): sender-side slot pool when every
+        # output is colocated; the reader side is created lazily on the first
+        # reference frame received (auto-detected, like batch frames)
+        self._shm_writer = None
+        self._shm_reader = None
+        self._m_shm_zero = self._m_shm_copy = None
+        try:
+            self._setup_zero_copy()
+        except Exception:
+            self._close_all()
+            raise
+
     # ------------------------------------------------------------------
     def _create_ingress(self) -> EngineSocket:
         """Build the input side: one listener on ``engine_addr``, or — when
@@ -224,6 +237,56 @@ class Engine:
         merged = MergedIngressSocket(socks)
         merged.recv_timeout = self.settings.engine_recv_timeout
         return merged
+
+    def _setup_zero_copy(self) -> None:
+        """Arm the sender-side shm slot pool when ``zero_copy_framing`` is on
+        AND every output is a colocated scheme (ipc/inproc). Anything else —
+        a remote peer, the native kernel missing — logs once and stays in
+        plain copy mode: payloads are byte-identical either way."""
+        self._shm_writer = None
+        if not getattr(self.settings, "zero_copy_framing", False):
+            return
+        addrs = list(self.settings.out_addr)
+        schemes = {a.split("://", 1)[0] for a in addrs}
+        if not addrs or not schemes <= {"ipc", "inproc"}:
+            if addrs:
+                self.logger.warning(
+                    "zero_copy_framing: non-colocated output scheme(s) %s — "
+                    "staying in copy mode", sorted(schemes - {"ipc", "inproc"}))
+            return
+        from . import shm as shm_mod
+
+        if not shm_mod.shm_available():
+            self.logger.warning(
+                "zero_copy_framing: native shm kernel unavailable — staying "
+                "in copy mode")
+            return
+        self._shm_writer = shm_mod.ShmWriter(
+            slots=getattr(self.settings, "zero_copy_slots", 32),
+            slot_bytes=getattr(self.settings, "zero_copy_slot_bytes", 262144),
+            inproc=(schemes == {"inproc"}),
+            logger=self.logger)
+        self._m_shm_zero = m.SHM_FRAMES().labels(mode="zero_copy",
+                                                 **self._labels)
+        self._m_shm_copy = m.SHM_FRAMES().labels(mode="copy", **self._labels)
+        self.logger.info(
+            "zero-copy framing armed (%s mode, %d slots x %d bytes)",
+            "inproc" if schemes == {"inproc"} else "shm",
+            getattr(self.settings, "zero_copy_slots", 32),
+            getattr(self.settings, "zero_copy_slot_bytes", 262144))
+
+    def _resolve_shm(self, raw: bytes, err_c) -> Optional[bytes]:
+        """Reference frame → payload bytes via the (lazily created) reader;
+        None counts a framing error — the payload is unreachable, which is
+        the shm analog of a corrupt batch frame."""
+        if self._shm_reader is None:
+            from . import shm as shm_mod
+
+            self._shm_reader = shm_mod.ShmReader(self.logger)
+        payload = self._shm_reader.resolve_release(raw)
+        if payload is None:
+            err_c.inc()
+        return payload
 
     def _setup_output_sockets(self) -> None:
         for addr in self.settings.out_addr:
@@ -260,8 +323,9 @@ class Engine:
             self._out_socks = []
             try:
                 self._setup_output_sockets()
+                self._setup_zero_copy()
             except Exception:
-                self._pair_sock.close()
+                self._close_all()
                 raise
             self._sockets_closed = False
         self._stop_event.clear()
@@ -306,6 +370,12 @@ class Engine:
                 sock.close()
             except TransportError:
                 pass
+        if self._shm_writer is not None:
+            self._shm_writer.close()
+            self._shm_writer = None
+        if self._shm_reader is not None:
+            self._shm_reader.close()
+            self._shm_reader = None
 
     @property
     def running(self) -> bool:
@@ -380,12 +450,18 @@ class Engine:
         carrying non-protobuf payloads must set
         ``engine_frame_autodetect: false`` (settings.py) or a payload that
         happens to start with the magic would be mis-split. Read metrics
-        count wire bytes once per frame and lines per contained message
+        count PAYLOAD bytes once per frame (a resolved shm reference counts
+        its payload, not its ~40 wire bytes) and lines per contained message
         (the reference's newline rule)."""
-        read_b.inc(len(raw))
         if not getattr(self.settings, "engine_frame_autodetect", True):
+            read_b.inc(len(raw))
             read_l.inc(_count_lines(raw))
             return [raw]
+        if raw[0] == 0xD7 and raw.startswith(MAGIC_SHM):
+            raw = self._resolve_shm(raw, err_c)
+            if not raw:
+                return []
+        read_b.inc(len(raw))
         # first-byte probe before the slice compare: protobuf payloads never
         # start 0xD7, so the untraced common case pays one int compare here
         if self._trace_enabled or (raw[0] == 0xD7
@@ -549,22 +625,28 @@ class Engine:
                 # component's per-call batch cap holds to within one
                 # frame's overshoot — without it a sustained packed burst
                 # would hand the component millions of messages per call.
-                # v2 trace headers are stripped HERE, host-side, so the
-                # native expand path (dm_count_frame_msgs /
-                # dm_featurize_frames) only ever sees v1 wire units.
-                read_b.inc(len(raw))
-                raw = (self._ingest_trace(raw, err_c)
-                       if self._trace_enabled or raw.startswith(MAGIC_V2)
-                       else raw)
+                # v2 trace headers are stripped HERE, host-side — and shm
+                # reference frames resolved — so the native expand path
+                # (dm_count_frame_msgs / dm_featurize_frames) only ever
+                # sees v1 wire units.
+                def ingest_wire(nxt: bytes) -> Optional[bytes]:
+                    if nxt[0] == 0xD7 and nxt.startswith(MAGIC_SHM):
+                        nxt = self._resolve_shm(nxt, err_c)
+                        if not nxt:
+                            return None
+                    read_b.inc(len(nxt))
+                    if self._trace_enabled or nxt.startswith(MAGIC_V2):
+                        nxt = self._ingest_trace(nxt, err_c)
+                    return nxt or None
+
+                raw = ingest_wire(raw)
                 frames = [raw] if raw else []
                 est = [frame_msg_count(raw) if raw else 0]
 
                 def on_frame(nxt: bytes) -> None:
-                    read_b.inc(len(nxt))
-                    if self._trace_enabled or nxt.startswith(MAGIC_V2):
-                        nxt = self._ingest_trace(nxt, err_c)
-                        if not nxt:
-                            return
+                    nxt = ingest_wire(nxt)
+                    if nxt is None:
+                        return
                     frames.append(nxt)
                     est[0] += frame_msg_count(nxt)
 
@@ -693,6 +775,7 @@ class Engine:
                       and not self._trace_terminal
                       and self._trace_pending and pending)
         now_ns = time.time_ns() if attach else 0  # one clock read per call
+        built: List = []                 # (wire-unit, lines, origin)
         start = 0
         while start < len(pending):
             end = start + 1
@@ -716,8 +799,121 @@ class Engine:
                 if lines is None:
                     lines = _count_lines(data)
                 data = self._stamp_trace(data, now_ns)
-            self._send_to_outputs(data, lines=lines, origin=origin)
+            built.append((data, lines, origin))
             start = end
+        # batched fan-out (send_many): one GIL crossing per send_batch_max
+        # frames on the single-forwarding-output hot path; multi-output
+        # fan-outs, replies (origin routing), and send_many-less transports
+        # keep the per-frame path
+        sock = self._out_socks[0] if len(self._out_socks) == 1 else None
+        if (len(built) > 1 and sock is not None
+                and callable(getattr(sock, "send_many", None))
+                and getattr(self.settings, "send_batch_max", 1) > 1
+                and all(item[2] is None for item in built)):
+            self._send_to_outputs_many(built)
+            return
+        for data, lines, origin in built:
+            self._send_to_outputs(data, lines=lines, origin=origin)
+
+    def _drop_frame(self, meta, wire: bytes) -> None:
+        plen, lines, is_ref = meta
+        self._m_dropped_b.inc(plen)
+        self._m_dropped_l.inc(lines)
+        if is_ref:
+            # a reference no peer will ever resolve must release its slot
+            self._shm_writer.release_ref(wire)
+
+    def _send_to_outputs_many(self, built) -> None:
+        """Batched single-output fan-out: the whole result burst crosses the
+        transport in ``send_many`` chunks of ``send_batch_max`` frames — one
+        GIL crossing per chunk instead of per frame (the send-side twin of
+        the ingest ``recv_many``). Per-frame accounting (written/dropped
+        bytes+lines, shm slot refs) and the drop-retry / block-flow-control
+        semantics of ``_send_to_outputs`` are preserved; shm publication
+        happens per frame exactly as on the per-frame path."""
+        sock = self._out_socks[0]
+        writer = self._shm_writer
+        wires: List[bytes] = []
+        metas: List[tuple] = []          # (payload_len, lines, is_ref)
+        for data, lines, _ in built:
+            if lines is None:
+                lines = _count_lines(data)
+            wire = data
+            if writer is not None:
+                ref = writer.publish(data, refs=1)
+                if ref is not None:
+                    wire = ref
+                    self._m_shm_zero.inc()
+                else:
+                    self._m_shm_copy.inc()
+            wires.append(wire)
+            metas.append((len(data), lines, wire is not data))
+        batch_max = max(1, getattr(self.settings, "send_batch_max", 64))
+        block_mode = self.settings.out_backpressure == "block"
+        backlog_g = self._m_send_backlog
+        idx = 0
+        retries = 0
+        waited = False
+        # dmlint: hot-loop
+        while idx < len(wires):
+            hard = False
+            try:
+                n = sock.send_many(wires[idx:idx + batch_max], block=False)
+            except TransportAgain:
+                n = 0
+            except TransportError as exc:
+                self.logger.warning("output send failed hard: %s", exc)
+                hard = True
+                n = 0
+            if hard:
+                # hard transport failure: this frame is gone; the next may
+                # still make it once the socket recovers (reconnects ride
+                # the transport's background redial)
+                self._drop_frame(metas[idx], wires[idx])
+                idx += 1
+                retries = 0
+                continue
+            if n > 0:
+                for j in range(idx, idx + n):
+                    self._m_written_b.inc(metas[j][0])
+                    self._m_written_l.inc(metas[j][1])
+                idx += n
+                retries = 0
+                continue
+            # nothing left the process this pass: peer backpressure
+            if block_mode:
+                if not self._running or self._stop_event.is_set():
+                    if self._stop_drain_deadline is None:
+                        self._stop_drain_deadline = (
+                            time.monotonic()
+                            + self.settings.out_stop_drain_ms / 1000.0)
+                    if time.monotonic() >= self._stop_drain_deadline:
+                        break                    # drop the remainder below
+                backlog_g.set(1)
+                if not waited:
+                    self._hb_output.wait_begin()
+                else:
+                    self._hb_output.beat()
+                waited = True
+                # a raw blocking send would make the engine unstoppable:
+                # dmlint: ignore[DM-H004] the 1 ms poll IS flow control
+                time.sleep(0.001)
+                continue
+            retries += 1
+            if retries >= self.settings.engine_retry_count:
+                self._drop_frame(metas[idx], wires[idx])
+                idx += 1
+                retries = 0
+                continue
+            self._hb_output.beat()
+            # the reference-mandated 10 ms retry backoff between attempts:
+            # dmlint: ignore[DM-H004] bounded by engine_retry_count
+            time.sleep(_RETRY_SLEEP_S)
+        for j in range(idx, len(wires)):     # stop-drain expiry remainder
+            self._drop_frame(metas[j], wires[j])
+        if waited:
+            backlog_g.set(0)
+            self._hb_output.wait_end()
 
     def _send_to_outputs(self, data: bytes, lines: Optional[int] = None,
                          origin=None) -> bool:
@@ -727,6 +923,27 @@ class Engine:
         dropped_l = self._m_dropped_l
         if lines is None:
             lines = _count_lines(data)
+
+        # zero-copy framing: the payload moves into a refcounted shm slot
+        # and a ~40-byte reference goes on the wire instead. A reply (origin
+        # set) or a publish failure (no free slot / oversized) keeps the
+        # plain bytes — byte-identical payload, just copied. Metrics keep
+        # counting PAYLOAD bytes either way.
+        wire = data
+        if (self._shm_writer is not None and self._out_socks
+                and origin is None):
+            ref = self._shm_writer.publish(data, refs=len(self._out_socks))
+            if ref is not None:
+                wire = ref
+                self._m_shm_zero.inc()
+            else:
+                self._m_shm_copy.inc()
+
+        def drop_ref() -> None:
+            # a reference a peer will never resolve must release its slot
+            # sender-side or the pool leaks one slot per dropped frame
+            if wire is not data:
+                self._shm_writer.release_ref(wire)
 
         if not self._out_socks:
             # no outputs: reply on the input pair socket (reference:
@@ -799,7 +1016,7 @@ class Engine:
                 still: List[EngineSocket] = []
                 for sock in pending_socks:
                     try:
-                        sock.send(data, block=False)
+                        sock.send(wire, block=False)
                     except TransportAgain:
                         still.append(sock)
                         continue
@@ -807,6 +1024,7 @@ class Engine:
                         self.logger.warning("output send failed hard: %s", exc)
                         dropped_b.inc(len(data))
                         dropped_l.inc(lines)
+                        drop_ref()
                         continue
                     mark_sent()
                 if len(still) == len(pending_socks):
@@ -825,6 +1043,7 @@ class Engine:
             for _ in pending_socks:  # stop-drain deadline expired
                 dropped_b.inc(len(data))
                 dropped_l.inc(lines)
+                drop_ref()
             if waited:
                 backlog_g.set(0)
                 self._hb_output.wait_end()
@@ -836,7 +1055,7 @@ class Engine:
             # dmlint: hot-loop
             for _ in range(self.settings.engine_retry_count):
                 try:
-                    sock.send(data, block=False)
+                    sock.send(wire, block=False)
                     sent = True
                     break
                 except TransportAgain:
@@ -861,6 +1080,7 @@ class Engine:
             else:
                 dropped_b.inc(len(data))
                 dropped_l.inc(lines)
+                drop_ref()
         if waited:
             self._m_send_backlog.set(0)
         return any_ok

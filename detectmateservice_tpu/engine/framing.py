@@ -45,6 +45,15 @@ from typing import List, NamedTuple, Optional, Tuple
 
 MAGIC = b"\xd7DM\x01"
 MAGIC_V2 = b"\xd7DM\x02"
+# Zero-copy shm reference frame (v2 format family, PR 7): instead of payload
+# bytes, the frame carries a (segment name, slot, gen, offset, length)
+# reference into a shared-memory segment owned by the SENDING engine
+# (engine/shm.py). The referenced payload is a complete v1/v2 wire unit —
+# byte-identical to what a copy-mode sender would have put on the wire — so
+# resolving a shm frame and receiving a plain frame are indistinguishable
+# downstream. Senders only emit these on colocated links (ipc/inproc peers
+# with ``zero_copy_framing`` enabled) and copy-downgrade everywhere else.
+MAGIC_SHM = b"\xd7DM\x03"
 
 
 class FramingError(ValueError):
@@ -112,6 +121,60 @@ def frame_msg_count(data: bytes) -> int:
     except FramingError:
         return 0
     return count
+
+
+# -- shm reference frames (zero-copy framing) --------------------------------
+
+
+class ShmRef(NamedTuple):
+    """A shared-memory payload reference: which segment, which slot (and its
+    publish generation, so a stale ref is detected instead of reading a
+    recycled slot), and the payload's byte range within the segment."""
+
+    name: str        # segment path, or "@inproc:<pid>:<id>" for the
+                     # in-process object registry (true zero-copy)
+    slot: int
+    gen: int
+    offset: int
+    length: int
+
+
+def pack_shm_ref(ref: ShmRef) -> bytes:
+    """ShmRef → wire frame:
+    ``MAGIC_SHM | varint name_len | name | varint slot | varint gen
+    | varint offset | varint length``."""
+    out = bytearray(MAGIC_SHM)
+    name = ref.name.encode("utf-8")
+    _put_varint(out, len(name))
+    out += name
+    _put_varint(out, ref.slot)
+    _put_varint(out, ref.gen)
+    _put_varint(out, ref.offset)
+    _put_varint(out, ref.length)
+    return bytes(out)
+
+
+def unpack_shm_ref(data: bytes) -> ShmRef:
+    """Wire frame → ShmRef; raises FramingError on a garbled reference (the
+    payload itself is unreachable then — unlike a garbled v2 trace block,
+    there is nothing to salvage)."""
+    if not data.startswith(MAGIC_SHM):
+        raise FramingError("not a shm reference frame")
+    name_len, pos = _get_varint(data, len(MAGIC_SHM))
+    end = pos + name_len
+    if end > len(data):
+        raise FramingError("truncated segment name in shm reference")
+    try:
+        name = data[pos:end].decode("utf-8")
+    except UnicodeDecodeError as exc:
+        raise FramingError(f"non-UTF-8 segment name in shm reference: {exc}")
+    slot, pos = _get_varint(data, end)
+    gen, pos = _get_varint(data, pos)
+    offset, pos = _get_varint(data, pos)
+    length, pos = _get_varint(data, pos)
+    if pos != len(data):
+        raise FramingError("trailing bytes after shm reference")
+    return ShmRef(name, slot, gen, offset, length)
 
 
 # -- trace context (v2 frames) ----------------------------------------------

@@ -112,6 +112,31 @@ FEATURIZE_FALLBACK_ROWS = _series(
     Counter, "featurize_fallback_rows_total",
     "Rows featurized by the Python fallback path (kernel-flagged or kernel unavailable)")
 
+# zero-copy host path (PR 7): which path decoded + serialized each parser
+# row. native = the fused whole-row kernel OR the decode-span + native-emit
+# hybrid; fallback = rows that crossed into pb2 objects (kernel-flagged
+# strict failures, or the kernels unavailable / native_parse off). A
+# sustained fallback rise means parity-hostile payloads are eating the
+# parse budget — same reading as the featurize pair.
+PARSE_NATIVE_ROWS = _series(
+    Counter, "parse_native_rows_total",
+    "Parser rows decoded and serialized by the native (C) host path")
+PARSE_FALLBACK_ROWS = _series(
+    Counter, "parse_fallback_rows_total",
+    "Parser rows that fell back to the pb2 Python path (kernel-flagged or "
+    "kernel unavailable)")
+# shm zero-copy framing (engine/shm.py): frames the engine sent by
+# reference into a shared-memory slot (mode=zero_copy) vs frames that
+# copy-downgraded onto the wire (mode=copy — remote peer, oversized
+# payload, or no free slot because a receiver is slow/dead). A copy-mode
+# climb with zero_copy_framing on is the slow-receiver signal.
+SHM_LABELS = ("component_type", "component_id", "mode")
+SHM_FRAMES = _series(
+    Counter, "shm_frames_total",
+    "Frames sent through the zero-copy shm path (mode=zero_copy) or "
+    "copy-downgraded (mode=copy) while zero_copy_framing is enabled",
+    SHM_LABELS)
+
 # pipeline tracing series (engine_trace: true — engine.py hop stamping).
 # Stage dwell and transit are observed by every tracing stage; e2e only by
 # the terminal stage (no forwarding outputs), so its count is the pipeline's

@@ -44,7 +44,7 @@ _OK, _ETIMEOUT, _EAGAIN, _ECLOSED, _EERR, _ETOOBIG = 0, -1, -2, -3, -4, -5
 # A mismatch raises ImportError so "auto" backend selection falls back to
 # the Python transport LOUDLY instead of serving an older wire surface.
 # Bump in lockstep with the default in native/transport/dmtransport.cpp.
-DMT_FEATURE_VERSION = 2
+DMT_FEATURE_VERSION = 3
 
 _INITIAL_BUF = 16 * 1024 * 1024  # starting recv buffer; grows on demand —
                                  # oversized frames are stashed native-side
@@ -141,6 +141,10 @@ def _load() -> ctypes.CDLL:
     lib.dmt_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
                              ctypes.c_int]
     lib.dmt_send.restype = ctypes.c_int
+    lib.dmt_send_many.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_longlong, ctypes.c_int,
+                                  ctypes.c_int]
+    lib.dmt_send_many.restype = ctypes.c_int
     lib.dmt_close.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -235,6 +239,27 @@ class NativePairSocket:
         rc = _lib.dmt_send(self._handle, data, len(data), 1 if block else 0)
         if rc != _OK:
             _raise(int(rc), "send")
+
+    def send_many(self, frames: List[bytes], block: bool = False) -> int:
+        """Send a whole output micro-batch in ONE native call (the send-side
+        twin of ``recv_many``: one GIL crossing per batch, not per frame).
+        Returns how many leading frames were handed to the transport — the
+        caller retries the remainder (per-frame retry/drop accounting stays
+        exact). Raises the usual taxonomy only when not even the first frame
+        went out."""
+        if self._closed:
+            raise TransportClosed(f"send on closed socket {self._addr}")
+        if not frames:
+            return 0
+        buf = bytearray()
+        for frame in frames:
+            buf += len(frame).to_bytes(4, "little")
+            buf += frame
+        rc = _lib.dmt_send_many(self._handle, bytes(buf), len(buf),
+                                len(frames), 1 if block else 0)
+        if rc < 0:
+            _raise(int(rc), "send_many")
+        return int(rc)
 
     def close(self) -> None:
         with self._close_lock:

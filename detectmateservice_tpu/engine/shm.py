@@ -1,0 +1,288 @@
+"""Zero-copy shared-memory / inproc framing between colocated stages.
+
+The per-hop socket cost of a colocated link (parser and detector in one pod)
+is dominated by payload copies: the sender's zmq enqueue copy, the kernel
+round-trip, and the receiver's bytes materialization — three-plus copies of
+every frame that never leaves the host. This module moves the payload into a
+shared-memory segment owned by the sending engine and puts a ~40-byte
+reference frame (framing.MAGIC_SHM) on the wire instead:
+
+* **shm mode** (ipc peers): the sender memcpys the payload into a refcounted
+  segment slot once; the receiver slices it back out once. Two copies total,
+  constant-size wire frames, and the socket's high-water mark stops scaling
+  with payload size.
+* **inproc mode** (same-process peers): the slot stores the payload *object*
+  — the receiver gets the very same bytes object back. Zero copies.
+
+Reclamation is refcounted through the C11-atomic slot protocol in
+native/matchkern/dmkern.c (``dm_shm_acquire`` / ``publish`` / ``release``):
+a published slot's state counts outstanding readers; the release that
+reaches zero frees the slot for reuse, and a per-publish generation counter
+makes stale references detectable instead of dangerous. Python never touches
+the header region with plain writes — cross-process ordering (and TSan
+coverage) both demand the C entry points.
+
+Failure containment: everything degrades to copy mode, never to blocking or
+loss. No free slot (a slow or dead receiver still holds them all), an
+oversized payload, or a remote peer each make the sender put the plain bytes
+on the wire; a receiver that cannot resolve a reference (unknown segment,
+stale generation) counts a framing error and drops that frame exactly like a
+corrupt batch frame. Payloads are byte-identical in either mode — pinned by
+tests/test_shm.py.
+"""
+from __future__ import annotations
+
+import logging
+import mmap
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .framing import FramingError, ShmRef, pack_shm_ref, unpack_shm_ref
+
+try:
+    from ..utils import matchkern as _mk
+    _HAVE_KERNEL = _mk.has_shm_kernel()
+except ImportError:  # no compiler / stale .so: zero-copy framing disabled
+    _mk = None
+    _HAVE_KERNEL = False
+
+
+def shm_available() -> bool:
+    """True when the native slot-protocol kernel is loaded (zero_copy_framing
+    silently degrades to plain copy mode without it)."""
+    return _HAVE_KERNEL
+
+
+def _segment_dir() -> str:
+    # /dev/shm keeps the segment memory-backed; any tmpdir still works (the
+    # mmap is shared either way, the fallback just may touch disk)
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+# in-process writer registry for inproc (same-process) links: the reference
+# names the writer, the slot stores the payload OBJECT — the reader hands the
+# identical bytes object to the engine, zero copies. Guarded by a lock only
+# for registry mutation; slot state itself rides the C atomics.
+_INPROC_REGISTRY: Dict[str, "ShmWriter"] = {}
+_INPROC_LOCK = threading.Lock()
+_INPROC_SEQ = 0
+
+
+class ShmWriter:
+    """Sender side: a pool of refcounted payload slots in one shm segment
+    (or, for ``inproc=True``, an object-slab twin that skips the copy in).
+
+    ``publish`` is the only hot-path call: acquire a free slot, place the
+    payload, publish with the reader refcount, return the wire reference —
+    or None, which tells the engine to copy-downgrade this frame."""
+
+    def __init__(self, slots: int = 32, slot_bytes: int = 262144,
+                 inproc: bool = False,
+                 logger: Optional[logging.Logger] = None):
+        if not _HAVE_KERNEL:
+            raise RuntimeError("native shm kernel not available")
+        import numpy as np
+
+        self._slots = int(slots)
+        self._slot_bytes = int(slot_bytes)
+        self._inproc = bool(inproc)
+        self._logger = logger or logging.getLogger(__name__)
+        self._closed = False
+        header = _mk.shm_header_bytes(self._slots)
+        self._header_bytes = header
+        if inproc:
+            # header atomics on process-local memory; payload objects in a
+            # plain slot list (the C protocol still arbitrates ownership)
+            global _INPROC_SEQ
+            with _INPROC_LOCK:
+                _INPROC_SEQ += 1
+                self.name = f"@inproc:{os.getpid()}:{_INPROC_SEQ}"
+                _INPROC_REGISTRY[self.name] = self
+            self._hdr_arr = np.zeros(header, dtype=np.uint8)
+            self._addr = int(self._hdr_arr.ctypes.data)
+            self._mm = None
+            self._path = None
+            self._objs: List[Optional[bytes]] = [None] * self._slots
+        else:
+            size = header + self._slots * self._slot_bytes
+            fd, path = tempfile.mkstemp(prefix="dmshm-", suffix=".seg",
+                                        dir=_segment_dir())
+            try:
+                os.ftruncate(fd, size)
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self._path = path
+            self.name = path
+            self._hdr_arr = np.frombuffer(self._mm, dtype=np.uint8,
+                                          count=header)
+            self._addr = int(self._hdr_arr.ctypes.data)
+            self._objs = []
+        _mk.shm_init(self._addr, self._slots)
+
+    def publish(self, payload: bytes, refs: int) -> Optional[bytes]:
+        """Place ``payload`` into a free slot published for ``refs`` readers;
+        returns the wire reference frame, or None to copy-downgrade (no free
+        slot / oversized / closed). Never blocks."""
+        n = len(payload)
+        if (self._closed or refs <= 0
+                or (not self._inproc and n > self._slot_bytes)):
+            return None
+        slot = _mk.shm_acquire(self._addr, self._slots)
+        if slot < 0:
+            return None
+        if self._inproc:
+            offset = 0
+            self._objs[slot] = payload
+        else:
+            offset = self._header_bytes + slot * self._slot_bytes
+            self._mm[offset:offset + n] = payload
+        gen = _mk.shm_publish(self._addr, slot, refs)
+        return pack_shm_ref(ShmRef(self.name, slot, gen, offset, n))
+
+    def release_ref(self, ref_frame: bytes) -> None:
+        """Sender-side release of one reference it minted but could not
+        deliver (dropped/hard-failed send): the reader that will never come
+        must not leak the slot."""
+        try:
+            ref = unpack_shm_ref(ref_frame)
+        except FramingError:
+            return
+        self._release_slot(ref.slot, ref.gen)
+
+    def _release_slot(self, slot: int, gen: int) -> int:
+        if self._closed or not 0 <= slot < self._slots:
+            return -1
+        remaining = _mk.shm_release(self._addr, slot, gen)
+        if remaining == 0 and self._inproc:
+            self._objs[slot] = None          # let the payload object go
+        return remaining
+
+    def in_use(self) -> int:
+        """Slots currently not FREE (diagnostics/tests); 0 after close —
+        the header mapping is gone then, so there is nothing to read."""
+        if self._closed:
+            return 0
+        return sum(1 for i in range(self._slots)
+                   if _mk.shm_state(self._addr, i) != 0)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._inproc:
+            with _INPROC_LOCK:
+                _INPROC_REGISTRY.pop(self.name, None)
+            self._objs = [None] * self._slots
+            return
+        # drop the buffer export before closing the map; readers that
+        # already attached keep their own mapping (the inode lives until
+        # the last map goes), new attaches fail cleanly after the unlink
+        self._hdr_arr = None
+        try:
+            self._mm.close()
+        except BufferError:  # a live export (shouldn't happen post-close)
+            pass
+        if self._path is not None:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+class _Attached:
+    """One receiver-side segment attachment (mmap + header address)."""
+
+    __slots__ = ("mm", "addr", "size", "header_bytes", "_arr")
+
+    def __init__(self, path: str):
+        import numpy as np
+
+        with open(path, "rb+") as fh:
+            self.mm = mmap.mmap(fh.fileno(), 0)
+        self.size = len(self.mm)
+        # the header size is implied by the writer's slot count; slots are
+        # validated by range-checking offsets instead of trusting a count
+        self._arr = np.frombuffer(self.mm, dtype=np.uint8)
+        self.addr = int(self._arr.ctypes.data)
+
+    def close(self) -> None:
+        self._arr = None
+        try:
+            self.mm.close()
+        except BufferError:
+            pass
+
+
+class ShmReader:
+    """Receiver side: resolve reference frames back to payload bytes and
+    release the slot. Attachments are cached per segment path; inproc
+    references resolve through the process-local writer registry (returning
+    the identical payload object — zero copies)."""
+
+    def __init__(self, logger: Optional[logging.Logger] = None):
+        self._logger = logger or logging.getLogger(__name__)
+        self._segments: Dict[str, _Attached] = {}
+
+    def resolve_release(self, data: bytes) -> Optional[bytes]:
+        """Reference frame → payload bytes (None = unresolvable, count a
+        framing error). The payload is consumed and the slot reference
+        released before returning — the returned bytes are safe to hold
+        indefinitely."""
+        try:
+            ref = unpack_shm_ref(data)
+        except FramingError as exc:
+            self._logger.error("garbled shm reference dropped: %s", exc)
+            return None
+        if ref.name.startswith("@inproc:"):
+            return self._resolve_inproc(ref)
+        return self._resolve_segment(ref)
+
+    def _resolve_inproc(self, ref: ShmRef) -> Optional[bytes]:
+        with _INPROC_LOCK:
+            writer = _INPROC_REGISTRY.get(ref.name)
+        if writer is None or not 0 <= ref.slot < writer._slots:
+            self._logger.error("shm reference to unknown inproc slab %s",
+                               ref.name)
+            return None
+        payload = writer._objs[ref.slot]
+        # read the object BEFORE releasing: our outstanding ref pins the
+        # slot, so the writer cannot recycle it under us
+        if writer._release_slot(ref.slot, ref.gen) < 0 or payload is None:
+            self._logger.error("stale inproc shm reference (slot %d gen %d)",
+                               ref.slot, ref.gen)
+            return None
+        if len(payload) != ref.length:
+            return None
+        return payload
+
+    def _resolve_segment(self, ref: ShmRef) -> Optional[bytes]:
+        seg = self._segments.get(ref.name)
+        if seg is None:
+            try:
+                seg = _Attached(ref.name)
+            except (OSError, ValueError) as exc:
+                self._logger.error("cannot attach shm segment %s: %s",
+                                   ref.name, exc)
+                return None
+            self._segments[ref.name] = seg
+        if not (0 <= ref.offset and ref.offset + ref.length <= seg.size
+                and ref.slot >= 0
+                and (ref.slot + 1) * _mk.shm_header_bytes(1) <= ref.offset):
+            self._logger.error("out-of-range shm reference dropped")
+            return None
+        # copy out while our ref pins the slot, then release
+        payload = bytes(self._segments[ref.name].mm[
+            ref.offset:ref.offset + ref.length])
+        if _mk.shm_release(seg.addr, ref.slot, ref.gen) < 0:
+            self._logger.error("stale shm reference (slot %d gen %d)",
+                               ref.slot, ref.gen)
+            return None
+        return payload
+
+    def close(self) -> None:
+        for seg in self._segments.values():
+            seg.close()
+        self._segments.clear()

@@ -66,6 +66,12 @@ class MatcherParserConfig(CoreConfig):
     # false (default), non-LogSchema payloads raise — the reference's strict
     # contract, which the error-taxonomy tests pin.
     accept_raw_lines: bool = False
+    # Native host-path parsing (utils/matchkern): the fused whole-row kernel
+    # (dm_parse_batch/_frames) plus the decode-only LogSchema span kernel and
+    # the native ParserSchema emitter used by the batched fallback path.
+    # False forces every row through the pure-Python pb2 path — the parity
+    # reference the differential fuzzer compares against.
+    native_parse: bool = True
 
 
 def decode_ingest_payload(data: bytes, accept_raw: bool):
@@ -174,6 +180,7 @@ class MatcherParser(CoreComponent):
     def __init__(self, name: Optional[str] = None, config: Any = None) -> None:
         super().__init__(name=name, config=config)
         self.config: MatcherParserConfig
+        self._parse_counters = None
         self.apply_config()
 
     def apply_config(self) -> None:
@@ -194,6 +201,8 @@ class MatcherParser(CoreComponent):
             templates, template_res = self._read_templates(self.config.path_templates)
         native = None
         parse_native = None
+        logs_native = None
+        emitter = None
         try:  # optional C++ matching kernel
             from ...utils import matchkern
 
@@ -205,7 +214,8 @@ class MatcherParser(CoreComponent):
             # normalize + match + ParserSchema encode in one C pass.
             # time_format needs strptime/mktime with Python's exact quirks —
             # those configs stay on the Python path.
-            if matchkern.has_parse_kernel() and not self.config.time_format:
+            if (matchkern.has_parse_kernel() and not self.config.time_format
+                    and self.config.native_parse):
                 from ...schemas import SCHEMA_VERSION
 
                 flags = ((1 if self.config.remove_spaces else 0)
@@ -219,13 +229,28 @@ class MatcherParser(CoreComponent):
                     matcher=native, raw_templates=templates,
                     method_type=self.config.method_type,
                     parser_id=self.name, version=SCHEMA_VERSION)
+            # zero-copy host-path round: decode-only LogSchema span kernel +
+            # native ParserSchema emitter for the batched Python path (rows
+            # the fused kernel flags, and configs — e.g. time_format — the
+            # fused kernel cannot take at all): no pb2 object per row on
+            # either side of the Python middle
+            if matchkern.has_logs_kernel() and self.config.native_parse:
+                from ...schemas import SCHEMA_VERSION
+
+                logs_native = matchkern
+                emitter = matchkern.ParserEmitter(
+                    SCHEMA_VERSION, self.config.method_type, self.name)
         except Exception:
             native = native or None
             parse_native = None
+            logs_native = None
+            emitter = None
         self._format_re, self._format_names = format_re, format_names
         self._templates, self._template_res = templates, template_res
         self._native = native
         self._parse_native = parse_native
+        self._logs_native = logs_native
+        self._emitter = emitter
 
     def _read_templates(self, path: str):
         try:
@@ -341,39 +366,53 @@ class MatcherParser(CoreComponent):
         return self._assemble_native_outputs(status, ends, blob,
                                              batch.__getitem__)
 
+    def _count_parse_rows(self, native: int, fallback: int) -> None:
+        """parse_native_rows_total / parse_fallback_rows_total — which path
+        decoded+serialized how many rows (label children cached: this runs
+        once per micro-batch on the hot path)."""
+        if not native and not fallback:
+            return
+        if self._parse_counters is None:
+            from ...engine import metrics as m
+
+            self._parse_counters = (
+                m.PARSE_NATIVE_ROWS().labels(**self.metrics_labels),
+                m.PARSE_FALLBACK_ROWS().labels(**self.metrics_labels))
+        if native:
+            self._parse_counters[0].inc(native)
+        if fallback:
+            self._parse_counters[1].inc(fallback)
+
     def _assemble_native_outputs(self, status, ends, blob, raw_fn):
         """Shared status→outputs dispatch for the batch and frames kernels:
         1 = emitted bytes, 0 = filtered (None), -1 = re-run the row's raw
-        payload (``raw_fn(i)``) through the exact-semantics Python path,
-        counting its decode errors once per batch.
+        payload (``raw_fn(i)``) through the exact-semantics Python path.
 
-        When the kernel flags (almost) every row — the steady state for a
-        ``@type json`` ingest edge, where every payload starts with ``{`` —
-        the per-row ``parse_line`` fallback would serialize the whole batch
-        through the slowest path. Those batches re-run through the BATCHED
-        Python path instead (one native template scan for the batch, pb2
-        assembly loop), restoring pre-kernel batched throughput; identical
-        fields either way, pinned by test_native_kernels."""
+        Every flagged row — one stray JSON record or a whole ``@type json``
+        burst alike — rides ONE batched fallback sub-call
+        (``_process_batch_python``: native LogSchema span decode, one native
+        template scan, native ParserSchema emit), spliced back in order.
+        The old per-row ``parse_line`` fallback built two pb2 objects per
+        flagged row even when the batch was otherwise on the native path;
+        identical fields either way, pinned by test_native_kernels."""
         status_list = status.tolist()
         n = len(status_list)
-        flagged = status_list.count(-1)
-        if n > 1 and flagged >= n - n // 8:
+        flagged = [i for i, st in enumerate(status_list) if st == -1]
+        # flagged rows are counted by the fallback sub-call itself (its
+        # hybrid path may still decode+emit them natively) — counting them
+        # here too would double-book the partition
+        self._count_parse_rows(n - len(flagged), 0)
+        if len(flagged) == n:
             return self._process_batch_python([raw_fn(i) for i in range(n)])
-        outs: List[Optional[bytes]] = []
-        decode_errors = 0
+        outs: List[Optional[bytes]] = [None] * n
+        if flagged:
+            sub = self._process_batch_python([raw_fn(i) for i in flagged])
+            for j, i in enumerate(flagged):
+                outs[i] = sub[j]
         ends_list = ends.tolist()
         for i, st in enumerate(status_list):
             if st == 1:
-                outs.append(blob[ends_list[i]:ends_list[i + 1]])
-            elif st == 0:
-                outs.append(None)   # blank line: filtered
-            else:
-                out, err = self._parse_row_python(raw_fn(i))
-                decode_errors += err
-                outs.append(out)
-        if decode_errors:
-            self.count_processing_errors(decode_errors,
-                                         "undecodable LogSchema message(s)")
+                outs[i] = blob[ends_list[i]:ends_list[i + 1]]
         return outs
 
     def process_frames(self, frames: List[bytes]):
@@ -387,6 +426,18 @@ class MatcherParser(CoreComponent):
         in Python and delegate to ``process_batch``: same semantics,
         classic costs, never a dropped burst."""
         if self._parse_native is None or not self._parse_native.supports_frames:
+            if self._logs_native is not None:
+                # no fused kernel (e.g. time_format configured) but the
+                # decode kernel is here: frame expansion + LogSchema decode
+                # still run in one C pass, and only header extraction /
+                # time conversion / matching touch Python strings
+                view = self._logs_native.parse_logs_frames(
+                    frames, self.config.accept_raw_lines)
+                if view.n_corrupt_frames:
+                    self.count_processing_errors(view.n_corrupt_frames,
+                                                 "corrupt batch frame(s)")
+                return (self._outputs_from_view(view, view.raw),
+                        len(view), view.n_lines)
             from ...engine.framing import FramingError, unpack_batch
 
             msgs: List[bytes] = []
@@ -417,17 +468,138 @@ class MatcherParser(CoreComponent):
                                              pf.raw)
         return outs, len(pf.status), pf.n_lines
 
-    def _parse_row_python(self, data: bytes):
-        """Exact-semantics fallback for one kernel-flagged row: the batch
-        path's per-message behavior (decode error → counted + None)."""
-        try:
-            msg = decode_ingest_payload(data, self.config.accept_raw_lines)
-        except SchemaError:
-            return None, 1
-        parsed = self.parse_line(msg.log, log_id=msg.logID)
-        return (parsed.serialize() if parsed is not None else None), 0
-
     def _process_batch_python(self, batch: List[bytes]) -> List[Optional[bytes]]:
+        """Batched fallback path — the rows the fused kernel flags, plus
+        every row when it is unavailable (``time_format``, ``native_parse``
+        off, no compiler). With the decode/emit kernels built, the pb2
+        crossings disappear from this path too (``_process_batch_hybrid``);
+        the pure-pb2 body (``_process_batch_pb2``) remains the exact-parity
+        reference the differential fuzzer compares both native paths
+        against."""
+        if self._logs_native is not None and self._emitter is not None:
+            view = self._logs_native.parse_logs_batch(
+                batch, self.config.accept_raw_lines)
+            return self._outputs_from_view(view, batch.__getitem__)
+        return self._process_batch_pb2(batch)
+
+    def _decode_json_row(self, data: bytes) -> Tuple[str, str]:
+        """``decode_ingest_payload``'s JSON / bare-line shapes minus the
+        throwaway LogSchema pb2 carrier — only ``log`` / ``logID`` are ever
+        read by the parse path. Field mapping identical by construction."""
+        rec = None
+        if data[:1] == b"{":
+            try:
+                rec = json.loads(data)
+            except (ValueError, UnicodeDecodeError):
+                rec = None
+        if isinstance(rec, dict) and ("message" in rec or "log" in rec):
+            log = str(rec.get("message", rec.get("log", "")))
+            log_id = str(rec["logID"]) if rec.get("logID") else ""
+            return log, log_id
+        line = data.decode("utf-8", errors="replace")
+        if line.endswith("\n"):          # single_value's add_newline
+            line = line[:-1]
+        return line, ""
+
+    def _outputs_from_view(self, view, raw_fn) -> List[Optional[bytes]]:
+        """Assemble outputs from a native ``LogsView`` (decode-only kernel):
+        header extraction, time conversion, and template matching run on
+        Python strings sliced lazily from the wire blob; serialization goes
+        back through the native emitter's reusable arena. Statuses 1/2 never
+        touch a pb2 object; 0 (JSON) uses the dict mapping; -1 is the exact
+        per-row pb2 escape hatch (strict-mode decode failures, counted like
+        the reference path)."""
+        status = view.status.tolist()
+        n = len(status)
+        decode_errors = 0
+        native_rows = fallback_rows = 0
+        decoded: List[Any] = []          # (log, logID) | False (error)
+        for i, st in enumerate(status):
+            if st == 1 or st == 2:
+                decoded.append((view.log(i), view.log_id(i)))
+                native_rows += 1
+                continue
+            fallback_rows += 1
+            if st == 0:
+                decoded.append(self._decode_json_row(raw_fn(i)))
+                continue
+            try:                          # -1: strict parse failure et al.
+                msg = decode_ingest_payload(raw_fn(i),
+                                            self.config.accept_raw_lines)
+            except SchemaError:
+                decode_errors += 1
+                decoded.append(False)
+                continue
+            decoded.append((msg.log, msg.logID))
+        outs = self._assemble_decoded(decoded)
+        if decode_errors:
+            self.count_processing_errors(decode_errors,
+                                         "undecodable LogSchema message(s)")
+        self._count_parse_rows(native_rows, fallback_rows)
+        return outs
+
+    def _assemble_decoded(self, decoded) -> List[Optional[bytes]]:
+        """(log, logID) rows → serialized ParserSchema bytes via the native
+        emitter: identical field semantics to ``_process_batch_pb2``'s
+        assembly loop (pinned by the differential fuzzer), one C crossing
+        for the whole batch instead of a pb2 object + SerializeToString per
+        row."""
+        from os import urandom
+
+        outs: List[Optional[bytes]] = [None] * len(decoded)
+        emit_idx: List[int] = []
+        extracted_list = []
+        for i, item in enumerate(decoded):
+            if item is False:
+                continue
+            extracted = self._extract_header(item[0])
+            if extracted is None:
+                continue                 # blank line: filtered
+            emit_idx.append(i)
+            extracted_list.append(extracted)
+        if not emit_idx:
+            return outs
+        have_templates = bool(self._templates)
+        if have_templates and self._native is not None:
+            matches = self._native.match_batch(
+                [self._normalize(content) for _, content in extracted_list])
+        else:
+            matches = None
+        event_ids: List[int] = []
+        templates: List[bytes] = []
+        variables: List[List[bytes]] = []
+        log_ids: List[bytes] = []
+        kv_items: List[List[Tuple[bytes, bytes]]] = []
+        for j, i in enumerate(emit_idx):
+            header_vars, content = extracted_list[j]
+            if not have_templates:
+                event_id, template, caps = -1, "", []
+            elif matches is not None:
+                idx, caps = matches[j]
+                if idx >= 0:
+                    event_id, template = idx + 1, self._templates[idx]
+                else:
+                    event_id, template, caps = -1, "", []
+            else:
+                event_id, template, caps = self.match_templates(content)
+            event_ids.append(event_id)
+            templates.append(template.encode("utf-8"))
+            variables.append([v.encode("utf-8") for v in caps])
+            log_ids.append(decoded[i][1].encode("utf-8"))
+            kv_items.append([
+                (k.encode("utf-8"),
+                 (v if v is not None else "").encode("utf-8"))
+                for k, v in header_vars.items()])
+        now = int(time.time())
+        rand_hex = urandom(16 * len(emit_idx)).hex().encode()
+        arena, offs = self._emitter.emit(event_ids, templates, variables,
+                                         log_ids, kv_items, now, rand_hex)
+        offs_list = offs.tolist()
+        for j, i in enumerate(emit_idx):
+            outs[i] = arena[offs_list[j]:offs_list[j + 1]].tobytes()
+        return outs
+
+    def _process_batch_pb2(self, batch: List[bytes]) -> List[Optional[bytes]]:
         from os import urandom
 
         from ...schemas import SCHEMA_VERSION, schemas_pb2 as _pb
@@ -508,4 +680,5 @@ class MatcherParser(CoreComponent):
             # decode failures must be just as visible, in the SAME series
             self.count_processing_errors(decode_errors,
                                          "undecodable LogSchema message(s)")
+        self._count_parse_rows(0, len(batch))
         return outs

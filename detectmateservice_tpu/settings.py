@@ -201,6 +201,25 @@ class ServiceSettings(BaseModel):
     # all messages the final flush emits, so the le=1500 cap keeps it under
     # the engine's 2 s stop-join deadline.
     out_stop_drain_ms: float = Field(default=250.0, ge=0.0, le=1500.0)
+    # -- zero-copy host path (engine/shm.py, PR 7) ------------------------
+    # Colocated links only: when true AND every out_addr is ipc:// or
+    # inproc://, outgoing frames ride a refcounted shared-memory slot (the
+    # wire carries a ~40-byte reference; inproc peers get the identical
+    # payload object, zero copies). Anything else — a remote scheme in
+    # out_addr, an oversized payload, no free slot because a receiver is
+    # slow/dead — copy-downgrades that frame to plain bytes: byte-identical
+    # payload, just slower. Receivers auto-detect reference frames (rides
+    # engine_frame_autodetect, like batch frames).
+    zero_copy_framing: bool = False
+    # slot pool geometry: payloads larger than zero_copy_slot_bytes always
+    # copy-downgrade; all slots held by slow readers ⇒ copy-downgrade too
+    # (shm_frames_total{mode="copy"} is the signal)
+    zero_copy_slots: int = Field(default=32, ge=2, le=4096)
+    zero_copy_slot_bytes: int = Field(default=262144, ge=4096, le=67108864)
+    # output fan-out batching: up to this many wire frames per native
+    # send_many call (one GIL crossing per micro-batch on the output pump,
+    # the send-side twin of the ingest recv_many). 1 = per-frame sends.
+    send_batch_max: int = Field(default=64, ge=1, le=8192)
     # transport_backend selects the data-plane implementation: "native" is
     # the in-tree C++ transport (native/transport), "zmq" the Python pyzmq
     # backend; both are wire-compatible. "auto" prefers native when built.

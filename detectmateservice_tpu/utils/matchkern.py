@@ -27,7 +27,7 @@ _SRC_PATH = _PKG_DIR.parent / "native" / "matchkern" / "dmkern.c"
 # falls back to the pure-Python paths, so the failure is loud but safe.
 # Bump IN LOCKSTEP with the default in native/matchkern/dmkern.c whenever a
 # kernel's ABI or semantics change.
-DM_FEATURE_VERSION = 6
+DM_FEATURE_VERSION = 7
 
 
 def _stale() -> bool:
@@ -195,6 +195,53 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int8),
         ]
         lib.dm_parse_frames.restype = ctypes.c_int64
+    if hasattr(lib, "dm_parse_logs_batch"):
+        lib.dm_parse_logs_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int8),
+        ]
+        lib.dm_parse_logs_frames.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int8),
+        ]
+        lib.dm_parse_logs_frames.restype = ctypes.c_int64
+        lib.dm_emit_parser_rows.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.dm_emit_parser_rows.restype = ctypes.c_int64
+    if hasattr(lib, "dm_shm_acquire"):
+        lib.dm_shm_init.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dm_shm_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dm_shm_acquire.restype = ctypes.c_int
+        lib.dm_shm_publish.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int]
+        lib.dm_shm_publish.restype = ctypes.c_uint32
+        lib.dm_shm_release.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_uint32]
+        lib.dm_shm_release.restype = ctypes.c_int
+        lib.dm_shm_abandon.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dm_shm_state.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dm_shm_state.restype = ctypes.c_int
+        lib.dm_shm_gen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dm_shm_gen.restype = ctypes.c_uint32
     if hasattr(lib, "dm_nvd_scan"):
         lib.dm_nvd_build.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
@@ -696,6 +743,213 @@ class ParsedFrames:
     def raw(self, i: int) -> bytes:
         s, e = self.spans[i]
         return self.frames_blob[s:e]
+
+
+def has_logs_kernel() -> bool:
+    """True when the loaded library carries the native LogSchema decode and
+    ParserSchema emit entry points (the zero-copy host-path round)."""
+    return hasattr(_lib, "dm_parse_logs_batch")
+
+
+class LogsView:
+    """Lazy (log, logID) field views over a decoded ingest blob.
+
+    SpanRaws-style: nothing is sliced until a field is actually read, so the
+    batched parser path materializes exactly the strings it needs and never
+    a pb2 object. ``status`` semantics (dm_parse_logs_*): 1 = envelope,
+    2 = raw line, 0 = JSON record (Python's json path), -1 = Python decode
+    fallback (strict parse failure)."""
+
+    __slots__ = ("blob", "spans", "fspans", "status", "n_corrupt_frames",
+                 "n_lines")
+
+    def __init__(self, blob: bytes, spans, fspans, status,
+                 n_corrupt_frames: int = 0, n_lines: int = 0):
+        self.blob = blob
+        self.spans = spans            # [n, 2] payload byte spans
+        self.fspans = fspans          # [n, 4] log/logID field spans
+        self.status = status          # [n] int8
+        self.n_corrupt_frames = n_corrupt_frames
+        self.n_lines = n_lines
+
+    def __len__(self) -> int:
+        return len(self.status)
+
+    def raw(self, i: int) -> bytes:
+        s, e = self.spans[i]
+        return self.blob[s:e]
+
+    def raws(self) -> "SpanRaws":
+        return SpanRaws(self.blob, self.spans)
+
+    def log(self, i: int) -> str:
+        """The row's ``log`` field. Envelope spans were UTF-8-validated in
+        C; raw-line spans decode with errors="replace", exactly like
+        ``decode_ingest_payload``'s bare-line shape."""
+        row = self.fspans[i]
+        s, e = row[0], row[1]
+        if self.status[i] == 2:
+            return self.blob[s:e].decode("utf-8", errors="replace")
+        return self.blob[s:e].decode("utf-8")
+
+    def log_id(self, i: int) -> str:
+        row = self.fspans[i]
+        return self.blob[row[2]:row[3]].decode("utf-8")
+
+
+def parse_logs_batch(payloads: Sequence[bytes], accept_raw: bool) -> LogsView:
+    """Payload list → lazy (log, logID) field views, one C crossing."""
+    blob, offsets = _pack(payloads)
+    n = len(payloads)
+    fspans = np.zeros((n, 4), dtype=np.int64)
+    status = np.full(n, -1, dtype=np.int8)
+    if n:
+        _lib.dm_parse_logs_batch(
+            blob, offsets.ctypes.data_as(_I64P), n, 1 if accept_raw else 0,
+            fspans.ctypes.data_as(_I64P),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+    spans = np.stack([offsets[:-1], offsets[1:]], axis=1)
+    return LogsView(blob, spans, fspans, status)
+
+
+def parse_logs_frames(frames: Sequence[bytes], accept_raw: bool) -> LogsView:
+    """Wire frames → lazy per-message (log, logID) field views: frame
+    expansion and LogSchema decode in one C pass, no per-message Python
+    objects until a field is read."""
+    blob, offsets = _pack(frames)
+    n_frames = len(frames)
+    counts = np.zeros(n_frames, dtype=np.int32)
+    corrupt = np.zeros(n_frames, dtype=np.uint8)
+    lines = np.zeros(1, dtype=np.int64)
+    total = int(_lib.dm_count_frame_msgs(
+        blob, offsets.ctypes.data_as(_I64P), n_frames,
+        counts.ctypes.data_as(_I32P), corrupt.ctypes.data_as(_U8P),
+        lines.ctypes.data_as(_I64P)))
+    spans = np.zeros((total, 2), dtype=np.int64)
+    fspans = np.zeros((total, 4), dtype=np.int64)
+    status = np.full(total, -1, dtype=np.int8)
+    if total:
+        _lib.dm_parse_logs_frames(
+            blob, offsets.ctypes.data_as(_I64P), n_frames,
+            counts.ctypes.data_as(_I32P), corrupt.ctypes.data_as(_U8P),
+            1 if accept_raw else 0,
+            spans.ctypes.data_as(_I64P), fspans.ctypes.data_as(_I64P),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+    return LogsView(blob, spans, fspans, status,
+                    int(corrupt.sum()), int(lines[0]))
+
+
+class ParserEmitter:
+    """Native ParserSchema serializer over a REUSABLE output arena.
+
+    One C crossing serializes a whole batch of rows byte-identically to pb2
+    ``SerializeToString`` (same emitters as ``parse_one_row``, whose output
+    parity the differential fuzzer pins). The arena persists across calls —
+    no per-batch allocation, no whole-blob copy; callers slice the rows they
+    forward straight out of it."""
+
+    def __init__(self, version: str, method_type: str, parser_id: str):
+        self._consts = (version.encode(), method_type.encode(),
+                        parser_id.encode())
+        self._arena = np.empty(1 << 16, dtype=np.uint8)
+
+    def emit(self, event_ids, templates, variables, log_ids, kv_items,
+             now: int, rand_hex: bytes):
+        """Serialize ``n`` rows; returns ``(arena, offsets)`` — row i is
+        ``arena[offsets[i]:offsets[i+1]]``.
+
+        ``variables`` is a list of per-row lists of bytes; ``kv_items`` a
+        list of per-row lists of (key bytes, value bytes) pairs, already
+        deduplicated in dict insertion order; ``rand_hex`` carries 32 hex
+        chars per row (the parsedLogID pool)."""
+        n = len(event_ids)
+        eid = np.asarray(event_ids, dtype=np.int32)
+        tmpl_blob, tmpl_offs = _pack(templates)
+        var_flat = [v for row in variables for v in row]
+        var_counts = np.asarray([len(row) for row in variables],
+                                dtype=np.int32)
+        var_blob, var_offs = _pack(var_flat)
+        id_blob, id_offs = _pack(log_ids)
+        key_flat = [k for row in kv_items for k, _ in row]
+        val_flat = [v for row in kv_items for _, v in row]
+        kv_counts = np.asarray([len(row) for row in kv_items],
+                               dtype=np.int32)
+        key_blob, key_offs = _pack(key_flat)
+        val_blob, val_offs = _pack(val_flat)
+        ts = np.full(n, int(now), dtype=np.int64)
+        version, method_type, parser_id = self._consts
+        out_offsets = np.zeros(n + 1, dtype=np.int64)
+        while True:
+            used = int(_lib.dm_emit_parser_rows(
+                n, eid.ctypes.data_as(_I32P),
+                tmpl_blob, tmpl_offs.ctypes.data_as(_I64P),
+                var_blob, var_offs.ctypes.data_as(_I64P),
+                var_counts.ctypes.data_as(_I32P),
+                id_blob, id_offs.ctypes.data_as(_I64P),
+                key_blob, key_offs.ctypes.data_as(_I64P),
+                val_blob, val_offs.ctypes.data_as(_I64P),
+                kv_counts.ctypes.data_as(_I32P),
+                version, len(version), method_type, len(method_type),
+                parser_id, len(parser_id),
+                rand_hex,
+                ts.ctypes.data_as(_I64P), ts.ctypes.data_as(_I64P),
+                self._arena.ctypes.data_as(_U8P), len(self._arena),
+                out_offsets.ctypes.data_as(_I64P)))
+            if used >= 0:
+                return self._arena, out_offsets
+            # arena too small: grow geometrically and keep it (reusable)
+            need = (len(tmpl_blob) + len(var_blob) + len(id_blob)
+                    + len(key_blob) + len(val_blob) + 256 * n + 1024)
+            self._arena = np.empty(max(len(self._arena) * 2, need),
+                                   dtype=np.uint8)
+
+
+# -- shm slot refcounts (dm_shm_*) -------------------------------------------
+# Thin pass-throughs over the C11-atomic slot protocol (see dmkern.c): the
+# zero-copy framing's sender/receiver sides both operate on a mapped header
+# region through these, never through plain Python writes. `addr` is the
+# base address of the header region (e.g. np.frombuffer(mmap).ctypes.data).
+
+SHM_SLOT_STRIDE = 16
+
+
+def has_shm_kernel() -> bool:
+    return hasattr(_lib, "dm_shm_acquire")
+
+
+def shm_header_bytes(n_slots: int) -> int:
+    return n_slots * SHM_SLOT_STRIDE
+
+
+def shm_init(addr: int, n_slots: int) -> None:
+    _lib.dm_shm_init(addr, n_slots)
+
+
+def shm_acquire(addr: int, n_slots: int) -> int:
+    """Claim a FREE slot for writing; -1 when none (copy-downgrade)."""
+    return int(_lib.dm_shm_acquire(addr, n_slots))
+
+
+def shm_publish(addr: int, slot: int, refs: int) -> int:
+    """Publish an acquired slot with `refs` readers; returns the gen."""
+    return int(_lib.dm_shm_publish(addr, slot, refs))
+
+
+def shm_release(addr: int, slot: int, gen: int) -> int:
+    """Drop one reference; returns remaining refs, -1 for a stale ref."""
+    return int(_lib.dm_shm_release(addr, slot, gen))
+
+
+def shm_abandon(addr: int, slot: int) -> None:
+    _lib.dm_shm_abandon(addr, slot)
+
+
+def shm_state(addr: int, slot: int) -> int:
+    return int(_lib.dm_shm_state(addr, slot))
+
+
+def shm_gen(addr: int, slot: int) -> int:
+    return int(_lib.dm_shm_gen(addr, slot))
 
 
 def has_nvd_kernel() -> bool:

@@ -40,7 +40,7 @@
  * kernels. native/build.sh stamps the value from the bindings; the default
  * here must match for bare `cc dmkern.c` builds. */
 #ifndef DM_FEATURE_VERSION
-#define DM_FEATURE_VERSION 6
+#define DM_FEATURE_VERSION 7
 #endif
 
 int dm_feature_version(void) { return DM_FEATURE_VERSION; }
@@ -1464,4 +1464,331 @@ void dm_nvd_scan(
         }
         if (all_seen) verdict[i] = 0;
     }
+}
+
+/* ---------------- native LogSchema decode (dm_parse_logs_*) ----------------
+ *
+ * Decode-ONLY twin of parse_one_row's step 1: resolve each ingest payload
+ * to its (log, logID) field byte spans without constructing a pb2 object —
+ * the host path's remaining per-row Python protobuf crossing. The spans are
+ * handed to Python as SpanRaws-style lazy views (utils/matchkern.LogsView):
+ * MatcherParser's batched path slices a str per field straight out of the
+ * wire blob only when it actually needs one, and the rest of the row
+ * (header extraction, time conversion, template match) proceeds on those
+ * strings while serialization goes back through dm_emit_parser_rows.
+ *
+ * Status codes (one-sided contract, same philosophy as dm_parse_batch):
+ *   1  envelope — the payload parses as a LogSchema protobuf (strict mode:
+ *      any parse; accept_raw: parse AND field presence) and every declared
+ *      string field is valid UTF-8; spans point at the log / logID fields
+ *      (empty spans when absent, like proto3 defaults).
+ *   2  raw line (accept_raw only) — not an envelope, not JSON; the log span
+ *      is the payload minus ONE trailing newline (single_value add_newline),
+ *      logID empty. Python decodes the span with errors="replace", exactly
+ *      like decode_ingest_payload's bare-line shape.
+ *   0  JSON record (accept_raw, payload starts with '{') — Python applies
+ *      json.loads + the field mapping; no pb2 object is needed there either.
+ *  -1  Python fallback — strict-mode parse failure (Python raises/counts
+ *      the exact error) or any row this walk cannot classify with parity.
+ */
+
+static int8_t decode_one_log(const uint8_t *pay, int pay_len, int accept_raw,
+                             int64_t *log_s, int64_t *log_e,
+                             int64_t *id_s, int64_t *id_e) {
+    const uint8_t *log = NULL; int log_len = 0;
+    const uint8_t *log_id = NULL; int log_id_len = 0;
+    int presence = 0, parse_ok = 1;
+    cursor_t c = { pay, pay + pay_len };
+    *log_s = *log_e = *id_s = *id_e = 0;
+    while (c.p < c.end) {
+        uint64_t tag;
+        if (!read_varint(&c, &tag)) { parse_ok = 0; break; }
+        uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+        if (field == 0) { parse_ok = 0; break; }
+        if (wt == 2 && (field == 2 || field == 3)) {
+            uint64_t l;
+            if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { parse_ok = 0; break; }
+            /* upb validates UTF-8 on declared strings at parse time */
+            if (!utf8_valid(c.p, (int)l)) { parse_ok = 0; break; }
+            if (field == 2) { log_id = c.p; log_id_len = (int)l; }
+            else { log = c.p; log_len = (int)l; }
+            c.p += l;
+            presence = 1;
+        } else if (wt == 2 && field >= 1 && field <= 5) {
+            /* declared strings 1-5 all count for presence and all get the
+             * parse-time UTF-8 check (same discipline as parse_one_row) */
+            uint64_t l;
+            if (!read_varint(&c, &l) || (uint64_t)(c.end - c.p) < l) { parse_ok = 0; break; }
+            if (!utf8_valid(c.p, (int)l)) { parse_ok = 0; break; }
+            c.p += l;
+            presence = 1;
+        } else {
+            if (!skip_field(&c, wt)) { parse_ok = 0; break; }
+        }
+    }
+    if (parse_ok && (!accept_raw || presence)) {
+        if (log != NULL) { *log_s = log - pay; *log_e = *log_s + log_len; }
+        if (log_id != NULL) { *id_s = log_id - pay; *id_e = *id_s + log_id_len; }
+        return 1;
+    }
+    if (!accept_raw)
+        return -1;                /* strict parse failure: Python raises */
+    if (pay_len > 0 && pay[0] == '{')
+        return 0;                 /* JSON record: Python's json path */
+    *log_s = 0;
+    *log_e = pay_len;
+    if (pay_len > 0 && pay[pay_len - 1] == '\n')
+        *log_e = pay_len - 1;     /* single_value's add_newline */
+    return 2;
+}
+
+/* Batch variant over a packed payload blob: fspans[4i..4i+3] are ABSOLUTE
+ * [log_start, log_end, id_start, id_end) offsets into `payloads`. */
+void dm_parse_logs_batch(const uint8_t *payloads, const int64_t *offsets,
+                         int n, int accept_raw,
+                         int64_t *fspans, int8_t *status) {
+    for (int i = 0; i < n; i++) {
+        int64_t ls, le, is_, ie;
+        status[i] = decode_one_log(payloads + offsets[i],
+                                   (int)(offsets[i + 1] - offsets[i]),
+                                   accept_raw, &ls, &le, &is_, &ie);
+        fspans[4 * i + 0] = offsets[i] + ls;
+        fspans[4 * i + 1] = offsets[i] + le;
+        fspans[4 * i + 2] = offsets[i] + is_;
+        fspans[4 * i + 3] = offsets[i] + ie;
+    }
+}
+
+/* Frames variant: expand (pre-validated via dm_count_frame_msgs) wire
+ * frames and decode every contained message. spans[2m..] = payload byte
+ * spans, fspans[4m..] = field spans, both absolute into `frames`.
+ * Returns the message count written. */
+int64_t dm_parse_logs_frames(const uint8_t *frames, const int64_t *frame_offsets,
+                             int n_frames, const int32_t *counts,
+                             const uint8_t *corrupt, int accept_raw,
+                             int64_t *spans, int64_t *fspans, int8_t *status) {
+    int64_t m = 0;
+    for (int i = 0; i < n_frames; i++) {
+        const uint8_t *base = frames + frame_offsets[i];
+        int len = (int)(frame_offsets[i + 1] - frame_offsets[i]);
+        if (corrupt[i] || counts[i] == 0) continue;
+        if (!frame_is_batch(base, len)) {
+            int64_t ls, le, is_, ie;
+            spans[2 * m] = frame_offsets[i];
+            spans[2 * m + 1] = frame_offsets[i + 1];
+            status[m] = decode_one_log(base, len, accept_raw,
+                                       &ls, &le, &is_, &ie);
+            fspans[4 * m + 0] = frame_offsets[i] + ls;
+            fspans[4 * m + 1] = frame_offsets[i] + le;
+            fspans[4 * m + 2] = frame_offsets[i] + is_;
+            fspans[4 * m + 3] = frame_offsets[i] + ie;
+            m++;
+            continue;
+        }
+        cursor_t c = { base + 4, base + len };
+        uint64_t n_msgs;
+        read_varint(&c, &n_msgs);          /* pre-validated by the count pass */
+        for (uint64_t k = 0; k < n_msgs; k++) {
+            uint64_t mlen;
+            read_varint(&c, &mlen);
+            if (mlen > 0) {                /* packed empties: filtered */
+                int64_t ls, le, is_, ie;
+                int64_t pay_off = frame_offsets[i] + (c.p - base);
+                spans[2 * m] = pay_off;
+                spans[2 * m + 1] = pay_off + (int64_t)mlen;
+                status[m] = decode_one_log(c.p, (int)mlen, accept_raw,
+                                           &ls, &le, &is_, &ie);
+                fspans[4 * m + 0] = pay_off + ls;
+                fspans[4 * m + 1] = pay_off + le;
+                fspans[4 * m + 2] = pay_off + is_;
+                fspans[4 * m + 3] = pay_off + ie;
+                m++;
+            }
+            c.p += mlen;
+        }
+    }
+    return m;
+}
+
+/* ---------------- native ParserSchema emit (dm_emit_parser_rows) ----------
+ *
+ * Serialize n ParserSchema rows into the caller's reusable output arena,
+ * byte-identical to pb2 SerializeToString over the same fields — the SAME
+ * emit order and encoders as parse_one_row (whose output parity is pinned
+ * by the differential fuzzer), but driven by field data Python computed
+ * (header extraction / time conversion / template match), so the batched
+ * Python path stops paying a pb2 object + SerializeToString per row.
+ *
+ * Per-row inputs ride packed blobs with prefix-offset arrays; var_counts /
+ * kv_counts give each row's slice of the shared variables / map arrays
+ * (running index, no per-row offset table needed). Map entries arrive
+ * ALREADY deduplicated in dict insertion order — Python's dict semantics
+ * are the one home for last-wins there.
+ *
+ * Returns bytes used, or -1 when `cap` is insufficient (the binding grows
+ * the arena and retries — same contract as dm_parse_batch).
+ */
+int64_t dm_emit_parser_rows(
+    int n, const int32_t *event_ids,
+    const uint8_t *tmpl_blob, const int64_t *tmpl_offs,
+    const uint8_t *var_blob, const int64_t *var_offs, const int32_t *var_counts,
+    const uint8_t *id_blob, const int64_t *id_offs,
+    const uint8_t *key_blob, const int64_t *key_offs,
+    const uint8_t *val_blob, const int64_t *val_offs, const int32_t *kv_counts,
+    const uint8_t *version, int version_len,
+    const uint8_t *parser_type, int parser_type_len,
+    const uint8_t *parser_id, int parser_id_len,
+    const uint8_t *rand_hex, const int64_t *recv_ts, const int64_t *parsed_ts,
+    uint8_t *out, int64_t cap, int64_t *out_offsets)
+{
+    int64_t o = 0;
+    int64_t vi = 0, ki = 0;            /* running variable / map-entry index */
+    out_offsets[0] = 0;
+    for (int i = 0; i < n; i++) {
+        int nv = var_counts[i], nk = kv_counts[i];
+        int64_t tmpl_len = tmpl_offs[i + 1] - tmpl_offs[i];
+        int64_t id_len = id_offs[i + 1] - id_offs[i];
+        int64_t vars_len = var_offs[vi + nv] - var_offs[vi];
+        int64_t kv_len = (key_offs[ki + nk] - key_offs[ki])
+            + (val_offs[ki + nk] - val_offs[ki]);
+        int64_t bound = 64 + version_len + parser_type_len + 2 * parser_id_len
+            + tmpl_len + vars_len + 32 + id_len + kv_len
+            + 16LL * (nv + nk) + 20;
+        if (o + bound > cap) return -1;
+        o = emit_str(out, o, 1, version, version_len);
+        o = emit_str(out, o, 2, parser_type, parser_type_len);
+        o = emit_str(out, o, 3, parser_id, parser_id_len);
+        o = emit_i32(out, o, 4, event_ids[i]);
+        o = emit_str(out, o, 5, tmpl_blob + tmpl_offs[i], (int)tmpl_len);
+        for (int k = 0; k < nv; k++, vi++)
+            o = emit_str(out, o, 6, var_blob + var_offs[vi],
+                         (int)(var_offs[vi + 1] - var_offs[vi]));
+        o = emit_str(out, o, 7, rand_hex + (int64_t)i * 32, 32);
+        o = emit_str(out, o, 8, id_blob + id_offs[i], (int)id_len);
+        /* reference quirk: `log` carries the parser name, not the line */
+        o = emit_str(out, o, 9, parser_id, parser_id_len);
+        for (int k = 0; k < nk; k++, ki++) {
+            int key_len = (int)(key_offs[ki + 1] - key_offs[ki]);
+            int val_len = (int)(val_offs[ki + 1] - val_offs[ki]);
+            int64_t sub_len = 1 + varint_size((uint64_t)key_len) + key_len
+                + 1 + varint_size((uint64_t)val_len) + val_len;
+            o = emit_varint(out, o, (10u << 3) | 2);
+            o = emit_varint(out, o, (uint64_t)sub_len);
+            o = emit_str(out, o, 1, key_blob + key_offs[ki], key_len);
+            o = emit_str(out, o, 2, val_blob + val_offs[ki], val_len);
+        }
+        o = emit_i32(out, o, 11, (int32_t)recv_ts[i]);
+        o = emit_i32(out, o, 12, (int32_t)parsed_ts[i]);
+        out_offsets[i + 1] = o;
+    }
+    return o;
+}
+
+/* ---------------- shm slot refcounts (dm_shm_*) ----------------
+ *
+ * The zero-copy framing's reclamation protocol (engine/shm.py): a shared
+ * header region — one 16-byte record per payload slot — lives at the front
+ * of the shm segment, and BOTH sides mutate it through these C11-atomic
+ * entry points (Python-side plain writes would have no ordering guarantees
+ * across processes, and TSan could not see them).
+ *
+ * Slot record layout (16-byte stride keeps natural alignment):
+ *   [0..3]  _Atomic int32 state: 0 = FREE, -1 = WRITING (sender owns),
+ *           > 0 = published, value == refs still outstanding
+ *   [4..7]  _Atomic uint32 gen: bumped once per publish; a wire ref carries
+ *           the gen it was minted with, so a stale ref (slot since recycled)
+ *           is detected instead of releasing someone else's payload
+ *   [8..15] reserved
+ *
+ * Protocol: sender CAS-acquires a FREE slot (state 0 -> -1), memcpys the
+ * payload into the slot's data region, then publishes (gen++, state = refs,
+ * RELEASE order — the payload bytes happen-before any reader that ACQUIRE-
+ * loads the state). Each receiver consumes the payload and releases once
+ * (state fetch_sub 1, ACQUIRE-RELEASE); the release that reaches 0 makes
+ * the slot FREE again. Refs are counted exactly (one per shm-eligible
+ * output socket), so state cannot reach 0 while a legitimate reader is
+ * outstanding; the gen check guards buggy/stale refs, not the happy path.
+ */
+
+#define DM_SHM_STRIDE 16
+
+typedef struct {
+    _Atomic int32_t state;
+    _Atomic uint32_t gen;
+    uint64_t reserved;
+} dm_shm_slot_t;
+
+static dm_shm_slot_t *shm_slot(uint8_t *hdr, int slot) {
+    return (dm_shm_slot_t *)(hdr + (int64_t)slot * DM_SHM_STRIDE);
+}
+
+void dm_shm_init(uint8_t *hdr, int n_slots) {
+    for (int i = 0; i < n_slots; i++) {
+        atomic_store_explicit(&shm_slot(hdr, i)->state, 0,
+                              memory_order_relaxed);
+        atomic_store_explicit(&shm_slot(hdr, i)->gen, 0,
+                              memory_order_relaxed);
+        shm_slot(hdr, i)->reserved = 0;
+    }
+    atomic_thread_fence(memory_order_release);
+}
+
+/* Claim a FREE slot for writing. Returns the slot index, or -1 when every
+ * slot is still held by readers (the caller copy-downgrades — never blocks:
+ * a slow or dead receiver must degrade throughput, not wedge the sender). */
+int dm_shm_acquire(uint8_t *hdr, int n_slots) {
+    for (int i = 0; i < n_slots; i++) {
+        int32_t expected = 0;
+        if (atomic_compare_exchange_strong_explicit(
+                &shm_slot(hdr, i)->state, &expected, -1,
+                memory_order_acq_rel, memory_order_relaxed))
+            return i;
+    }
+    return -1;
+}
+
+/* Publish an acquired slot with `refs` outstanding readers. Returns the new
+ * generation to mint into the wire ref. RELEASE ordering: the payload bytes
+ * written between acquire and publish are visible to any reader that
+ * observes state > 0. */
+uint32_t dm_shm_publish(uint8_t *hdr, int slot, int refs) {
+    dm_shm_slot_t *s = shm_slot(hdr, slot);
+    uint32_t gen = atomic_fetch_add_explicit(&s->gen, 1,
+                                             memory_order_relaxed) + 1;
+    atomic_store_explicit(&s->state, refs, memory_order_release);
+    return gen;
+}
+
+/* Drop one reference from a published slot. Returns the remaining count
+ * (0 = slot is FREE again), or -1 for a stale/invalid ref (gen mismatch or
+ * the slot was not published) — the caller counts an error, nothing is
+ * corrupted. ACQUIRE on the load pairs with publish's RELEASE. */
+int dm_shm_release(uint8_t *hdr, int slot, uint32_t gen) {
+    dm_shm_slot_t *s = shm_slot(hdr, slot);
+    if (atomic_load_explicit(&s->gen, memory_order_acquire) != gen)
+        return -1;
+    int32_t prev = atomic_fetch_sub_explicit(&s->state, 1,
+                                             memory_order_acq_rel);
+    if (prev <= 0) {
+        /* double release / release of a writing slot: undo, report */
+        atomic_fetch_add_explicit(&s->state, 1, memory_order_relaxed);
+        return -1;
+    }
+    return prev - 1;
+}
+
+/* Abort an acquired-but-unpublished slot (sender-side error path). */
+void dm_shm_abandon(uint8_t *hdr, int slot) {
+    atomic_store_explicit(&shm_slot(hdr, slot)->state, 0,
+                          memory_order_release);
+}
+
+int dm_shm_state(uint8_t *hdr, int slot) {
+    return (int)atomic_load_explicit(&shm_slot(hdr, slot)->state,
+                                     memory_order_acquire);
+}
+
+uint32_t dm_shm_gen(uint8_t *hdr, int slot) {
+    return atomic_load_explicit(&shm_slot(hdr, slot)->gen,
+                                memory_order_acquire);
 }

@@ -123,7 +123,7 @@ extern "C" {
 // of silently serving an older wire surface. native/build.sh stamps the
 // value from the binding; the default must match for bare builds.
 #ifndef DMT_FEATURE_VERSION
-#define DMT_FEATURE_VERSION 2
+#define DMT_FEATURE_VERSION 3
 #endif
 
 int dmt_feature_version(void) { return DMT_FEATURE_VERSION; }
@@ -318,6 +318,42 @@ int dmt_send(void *handle, const unsigned char *data, long long len, int block) 
         return s->closed.load() ? DMT_ECLOSED : DMT_EERR;
     }
     return DMT_OK;
+}
+
+// Send up to n frames from one contiguous buffer laid out as
+// [u32le length][payload]... (the recv_many layout, mirrored). Returns the
+// number of frames fully handed to zmq (>= 0) — the caller retries the
+// REMAINDER on a short count — or a negative error code when not even the
+// first frame went out. block=0 maps every send to DONTWAIT; a full peer
+// queue stops the loop with the partial count instead of blocking mid-batch,
+// so the engine's retry/drop accounting stays per-frame exact. One call =
+// one GIL crossing for a whole output micro-batch (the send-side twin of
+// dmt_recv_many — the output pump's per-frame crossings were the residual
+// host cost after the ingest side was batched).
+int dmt_send_many(void *handle, const unsigned char *buf, long long len,
+                  int n, int block) {
+    DmtSocket *s = static_cast<DmtSocket *>(handle);
+    if (s == nullptr || s->closed.load()) return DMT_ECLOSED;
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (s->closed.load()) return DMT_ECLOSED;
+    long long off = 0;
+    int sent = 0;
+    for (int i = 0; i < n; ++i) {
+        if (off + 4 > len) return sent > 0 ? sent : DMT_EERR;
+        uint32_t flen;
+        std::memcpy(&flen, buf + off, 4);
+        if (off + 4 + (long long)flen > len) return sent > 0 ? sent : DMT_EERR;
+        int rc = zmq_send(s->zsock, buf + off + 4, (size_t)flen,
+                          block ? 0 : ZMQ_DONTWAIT);
+        if (rc < 0) {
+            if (sent > 0) return sent;           // partial: caller retries rest
+            if (zmq_errno() == EAGAIN) return DMT_EAGAIN;
+            return s->closed.load() ? DMT_ECLOSED : DMT_EERR;
+        }
+        off += 4 + (long long)flen;
+        ++sent;
+    }
+    return sent;
 }
 
 // --- teardown --------------------------------------------------------------

@@ -15,10 +15,14 @@
 #
 # Coverage notes:
 #  * ASan+UBSan: heap overflows / UAF / UB across the protobuf wire-format
-#    walk, tokenizer, frame packer, and the transport framing.
+#    walk (including the PR-7 LogSchema decode + ParserSchema emit entry
+#    points), tokenizer, frame packer, the transport framing (send_many/
+#    recv_many), and the shm slot header arithmetic.
 #  * TSan: the dmkern row-parallel pthread pool (tests/test_native_kernels.py
 #    drives multi-threaded featurize via DM_FEATURIZE_THREADS) — lock/cv
-#    handshakes and the atomic row cursor.
+#    handshakes and the atomic row cursor — plus the shm slot refcount
+#    protocol (tests/test_shm.py's threaded publish/release stress: the
+#    zero-copy reclamation path races are exactly what TSan exists for).
 #  * Leak detection is off: a long-lived CPython process is not leak-clean
 #    by design (interned objects, arenas), and the kernels' capacity buffers
 #    are deliberately persistent.
@@ -38,15 +42,16 @@ run_mode() {
             preload="$($CC_BIN -print-file-name=libtsan.so)"
             # second_deadlock_stack: report both stacks of a lock inversion
             env_extra="TSAN_OPTIONS=halt_on_error=1:second_deadlock_stack=1"
-            # the pthread pool is the TSan target: force a real multi-thread
-            # featurize even on small CI boxes
-            tests="tests/test_native_kernels.py"
+            # the pthread pool and the shm slot refcounts are the TSan
+            # targets: force a real multi-thread featurize even on small
+            # CI boxes, and run the threaded publish/release stress
+            tests="tests/test_native_kernels.py tests/test_shm.py"
             threads=4
             ;;
         *)
             preload="$($CC_BIN -print-file-name=libasan.so) $($CC_BIN -print-file-name=libubsan.so)"
             env_extra="ASAN_OPTIONS=detect_leaks=0:halt_on_error=1 UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1"
-            tests="tests/test_native_kernels.py tests/test_native_transport.py"
+            tests="tests/test_native_kernels.py tests/test_native_transport.py tests/test_shm.py"
             threads=2
             ;;
     esac

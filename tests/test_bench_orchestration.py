@@ -41,12 +41,19 @@ class ScriptedChild:
 
     calls: list = []
     controller = staticmethod(lambda stage, platform, arg: None)
+    host_controller = staticmethod(lambda arg: None)
 
     def __init__(self, stage, timeout_s, platform=None, arg=""):
         type(self).calls.append((stage, platform, arg))
         self.diag = {"stage": stage, "arg": arg,
                      "platform_pin": platform or "default"}
-        self.payload = type(self).controller(stage, platform, arg)
+        if stage == "host":
+            # the host-path plane (PR 7) is independent of the TPU/CPU
+            # acquisition logic under test; a scripted host payload rides
+            # through run_main's controller only when it handles the stage
+            self.payload = type(self).host_controller(arg)
+        else:
+            self.payload = type(self).controller(stage, platform, arg)
         self.diag["outcome"] = "ok" if self.payload is not None else "no_result"
 
     def poll(self):
@@ -59,9 +66,11 @@ class ScriptedChild:
         self.diag["outcome"] = "cancelled"
 
 
-def run_main(bench, monkeypatch, controller, capsys):
+def run_main(bench, monkeypatch, controller, capsys, host_controller=None):
     ScriptedChild.calls = []
     ScriptedChild.controller = staticmethod(controller)
+    ScriptedChild.host_controller = staticmethod(
+        host_controller or (lambda arg: None))
     monkeypatch.setattr(bench, "_Child", ScriptedChild)
     with pytest.raises(SystemExit):
         bench.main()
@@ -203,3 +212,34 @@ class TestAcquisitionLoop:
         out, _ = run_main(bench, monkeypatch, controller, capsys)
         assert out["value"] == 0.0
         assert out["error"]
+
+    def test_host_path_breakdown_rides_into_the_record(
+            self, bench, monkeypatch, capsys):
+        """The PR-7 host-path plane: its per-stage breakdown and ≥10× floor
+        check land in the record next to the headline — and survive even a
+        total headline failure (it is the machine-checkable acceptance
+        artifact)."""
+        host = {"n": 65536, "parse_s": 0.1, "featurize_s": 0.1,
+                "transit_s": 0.01, "lines_per_s": 312076.0, "cpu_cores": 4,
+                "lines_per_s_per_core": 78019.0,
+                "cpu_floor_lines_per_s_per_core": 230.0,
+                "floor_multiple": 339.2, "floor_multiple_target": 10.0,
+                "floor_10x_ok": True}
+
+        def controller(stage, platform, arg):
+            if stage == "probe":
+                return {"platform": "cpu"} if platform == "cpu" else None
+            return cpu_payload(arg) if platform == "cpu" else None
+
+        out, calls = run_main(bench, monkeypatch, controller, capsys,
+                              host_controller=lambda arg: dict(host))
+        assert out["host_path"] == host
+        assert out["host_path"]["floor_10x_ok"] is True
+        assert [c for c in calls if c[0] == "host"]
+
+        def none_controller(stage, platform, arg):
+            return None
+
+        out, _ = run_main(bench, monkeypatch, none_controller, capsys,
+                          host_controller=lambda arg: dict(host))
+        assert out["error"] and out["host_path"] == host

@@ -569,29 +569,38 @@ class TestParseBatchKernelParity:
              b' "hostname": "h"}\n' % (i, i)) for i in range(32)]
         ref = parser._process_batch_python(list(payloads))
         monkeypatch.setattr(
-            parser, "_parse_row_python",
-            lambda data: (_ for _ in ()).throw(
+            parser, "parse_line",
+            lambda *a, **kw: (_ for _ in ()).throw(
                 AssertionError("per-row fallback used for an all-JSON batch")))
         out = parser.process_batch(list(payloads))
         assert ([self._fields(a) for a in out]
                 == [self._fields(b) for b in ref])
 
-    def test_mostly_clean_batch_keeps_per_row_fallback(self, tmp_path,
-                                                       monkeypatch):
-        """A handful of flagged rows in a clean batch stays on the per-row
-        fallback (rerunning the WHOLE batch in Python would throw away the
-        kernel's work for 90%+ of the rows)."""
+    def test_flagged_rows_ride_one_batched_fallback(self, tmp_path,
+                                                    monkeypatch):
+        """A handful of flagged rows in a clean batch ride ONE batched
+        fallback sub-call (native decode spans + native emit), never the
+        per-row ``parse_line`` path that builds two throwaway pb2 objects
+        per row — the PR-7 host-path fix, regression-pinned here."""
         parser = self._parser(tmp_path, accept_raw_lines=True,
                               templates=["type=<*> msg=audit(<*>): <*>"])
         payloads = self.audit_payloads(30)
         payloads.insert(7, b'{"message": "type=J msg=audit(9.9): x=1"}\n')
+        payloads.insert(19, b'{"message": "type=J msg=audit(8.8): y=2"}\n')
         calls = []
-        orig = parser._parse_row_python
-        monkeypatch.setattr(parser, "_parse_row_python",
-                            lambda data: calls.append(1) or orig(data))
+        orig = parser._process_batch_python
+        monkeypatch.setattr(
+            parser, "_process_batch_python",
+            lambda batch: calls.append(len(batch)) or orig(batch))
+        monkeypatch.setattr(
+            parser, "parse_line",
+            lambda *a, **kw: (_ for _ in ()).throw(
+                AssertionError("flagged rows must not use per-row parse_line")))
         out = parser.process_batch(list(payloads))
-        assert len(calls) == 1          # only the JSON row re-ran in Python
+        assert calls == [2]          # the two JSON rows, one batched sub-call
         assert all(o is not None for o in out)
+        assert self._fields(out[7])["map"]["Time"] == "9.9"
+        assert self._fields(out[19])["map"]["Time"] == "8.8"
 
     def test_capacity_retry_policy_distinguishes_oom(self, tmp_path):
         """-1 (output buffer too small) grows and retries; -2 (C-side malloc
@@ -912,3 +921,284 @@ class TestNvdScanKernelParity:
         b = python.process_batch([bad])
         assert a == b == [None]
         assert counts["native"] == counts["python"] == 1
+
+
+class TestLogsDecodeEmitFuzz:
+    """Differential fuzz for the PR-7 zero-copy host path: randomized
+    LogSchema corpora (unicode, truncation, duplicate fields, raw lines,
+    invalid UTF-8 edge rows, JSON records, ragged headers) must decode
+    byte-exactly vs the pb2 path (dm_parse_logs_*), and the native
+    ParserSchema emitter must serialize byte-exactly vs pb2
+    SerializeToString — both as units and end-to-end through
+    MatcherParser's hybrid batch path vs the pure-pb2 reference."""
+
+    _TEXT_POOLS = (
+        "abcdefXYZ0189 =.:/",
+        "céäßøñ 日本語ログ",
+        "Ωπ𝔘🚀",
+        " \t\x1c",
+        "A" * 30,
+    )
+
+    def _rand_text(self, rng, max_len=40):
+        pool = rng.choice(self._TEXT_POOLS)
+        return "".join(rng.choice(pool) for _ in range(rng.randrange(max_len)))
+
+    def _corpus(self, rng, n):
+        from detectmateservice_tpu.schemas import LogSchema
+
+        payloads = []
+        for i in range(n):
+            kind = rng.random()
+            if kind < 0.45:        # valid envelope, random unicode fields
+                payloads.append(LogSchema(
+                    logID=self._rand_text(rng, 12),
+                    log=f"type=SYSCALL msg=audit(1700.{i}): pid={i} "
+                        + self._rand_text(rng),
+                    logSource=self._rand_text(rng, 10),
+                    hostname=self._rand_text(rng, 10)).serialize())
+            elif kind < 0.55:      # truncated envelope
+                raw = LogSchema(logID=str(i),
+                                log=self._rand_text(rng, 60)).serialize()
+                payloads.append(raw[:rng.randrange(1, max(2, len(raw)))])
+            elif kind < 0.62:      # duplicate wire fields: last-wins
+                a = LogSchema(log="first " + self._rand_text(rng, 10))
+                b = LogSchema(log="last " + self._rand_text(rng, 10),
+                              logID=str(i))
+                payloads.append(a.serialize() + b.serialize())
+            elif kind < 0.72:      # raw line (trailing-newline variants)
+                line = ("type=LOGIN msg=audit(9.%d): %s"
+                        % (i, self._rand_text(rng))).encode()
+                payloads.append(line + (b"\n" if rng.random() < 0.5 else b""))
+            elif kind < 0.78:      # invalid UTF-8 edge rows
+                payloads.append(b"\xff\xfe " + self._rand_text(rng).encode()
+                                + b" \x80\x81")
+            elif kind < 0.88:      # JSON records (valid / damaged)
+                if rng.random() < 0.8:
+                    payloads.append(
+                        ('{"message": "type=J msg=audit(7.%d): %s", '
+                         '"logID": "%d", "hostname": "h"}\n'
+                         % (i, self._rand_text(rng, 20).replace('"', "")
+                            .replace("\\", ""), i)).encode())
+                else:
+                    payloads.append(b'{"broken json' + str(i).encode())
+            elif kind < 0.94:      # blank-ish lines
+                payloads.append(rng.choice(
+                    [b" \t ", b"\n", b"\x1c\x1d", " ".encode()]))
+            else:                  # wrong-wire-type field numbers
+                payloads.append(b"\x10\x05" + self._rand_text(rng, 8).encode())
+        return [p for p in payloads if p]
+
+    @pytest.mark.parametrize("accept_raw", [False, True])
+    def test_fuzz_decode_matches_ingest_payload(self, accept_raw):
+        from detectmateservice_tpu.library.parsers.template_matcher import (
+            decode_ingest_payload,
+        )
+        from detectmateservice_tpu.schemas import SchemaError
+
+        rng = random.Random(0x10C5)
+        payloads = self._corpus(rng, 600)
+        view = matchkern.parse_logs_batch(payloads, accept_raw)
+        n_native = 0
+        for i, payload in enumerate(payloads):
+            st = int(view.status[i])
+            assert view.raw(i) == payload
+            if st in (1, 2):
+                msg = decode_ingest_payload(payload, accept_raw)
+                assert view.log(i) == msg.log, f"row {i} log diverged"
+                assert view.log_id(i) == msg.logID, f"row {i} logID diverged"
+                n_native += 1
+            elif st == 0:
+                # JSON-to-Python rows only exist in accept_raw mode and
+                # always start with '{'
+                assert accept_raw and payload[:1] == b"{"
+            else:
+                assert st == -1
+                if not accept_raw:
+                    # strict-mode flag: the pb2 path must also reject it
+                    with pytest.raises(SchemaError):
+                        decode_ingest_payload(payload, accept_raw)
+        assert n_native > len(payloads) // 2, "corpus must mostly ride native"
+
+    def test_fuzz_logs_frames_matches_batch(self):
+        from detectmateservice_tpu.engine.framing import pack_batch
+
+        rng = random.Random(0xF4A3)
+        payloads = self._corpus(rng, 300)
+        frames = []
+        expected = []
+        i = 0
+        while i < len(payloads):
+            take = rng.randrange(1, 9)
+            chunk = payloads[i:i + take]
+            i += take
+            if rng.random() < 0.3:
+                frames.append(chunk[0])            # plain single message
+                expected.extend(chunk[:1])
+            else:
+                frames.append(pack_batch(chunk))
+                expected.extend(chunk)
+        frames.insert(3, b"\xd7DM\x01\x7f\x01")    # corrupt batch frame
+        fview = matchkern.parse_logs_frames(frames, True)
+        bview = matchkern.parse_logs_batch(expected, True)
+        assert fview.n_corrupt_frames == 1
+        assert len(fview) == len(expected)
+        assert list(fview.status) == list(bview.status)
+        for i in range(len(expected)):
+            assert fview.raw(i) == expected[i]
+            if fview.status[i] in (1, 2):
+                assert fview.log(i) == bview.log(i)
+                assert fview.log_id(i) == bview.log_id(i)
+
+    def test_fuzz_emit_byte_exact_vs_pb2(self):
+        import os as _os
+
+        from detectmateservice_tpu.schemas import SCHEMA_VERSION
+        from detectmateservice_tpu.schemas import schemas_pb2 as pb
+
+        rng = random.Random(0xE317)
+        n = 300
+        emitter = matchkern.ParserEmitter(SCHEMA_VERSION, "matcher_parser",
+                                          "FuzzEmit")
+        event_ids, templates, variables, log_ids, kv_items = [], [], [], [], []
+        for i in range(n):
+            event_ids.append(rng.choice([-1, 0, 1, i, 2**31 - 1, -2**31]))
+            templates.append(self._rand_text(rng).encode())
+            variables.append([self._rand_text(rng, 20).encode()
+                              for _ in range(rng.randrange(6))])
+            log_ids.append(self._rand_text(rng, 12).encode())
+            seen = {}
+            for _ in range(rng.randrange(5)):
+                seen[self._rand_text(rng, 8)] = self._rand_text(rng, 12)
+            if rng.random() < 0.2:
+                seen[""] = ""                      # empty key AND value
+            kv_items.append([(k.encode(), v.encode())
+                             for k, v in seen.items()])
+        now = 1_754_300_000
+        rand_hex = _os.urandom(16 * n).hex().encode()
+        arena, offs = emitter.emit(event_ids, templates, variables, log_ids,
+                                   kv_items, now, rand_hex)
+        offs = offs.tolist()
+        n_byte_exact = 0
+        native_rows, pb2_rows = [], []
+        for i in range(n):
+            got = arena[offs[i]:offs[i + 1]].tobytes()
+            ref = pb.ParserSchema()
+            setattr(ref, "__version__", SCHEMA_VERSION)
+            ref.parserType = "matcher_parser"
+            ref.parserID = "FuzzEmit"
+            ref.EventID = event_ids[i]
+            ref.template = templates[i].decode()
+            if variables[i]:
+                ref.variables.extend(v.decode() for v in variables[i])
+            ref.parsedLogID = rand_hex[32 * i:32 * i + 32].decode()
+            ref.logID = log_ids[i].decode()
+            ref.log = "FuzzEmit"
+            for k, v in kv_items[i]:
+                ref.logFormatVariables[k.decode()] = v.decode()
+            ref.receivedTimestamp = now
+            ref.parsedTimestamp = now
+            want = ref.SerializeToString()
+            native_rows.append(got)
+            pb2_rows.append(want)
+            if len(kv_items[i]) <= 1:
+                # byte-exactness is only well-defined up to one map entry:
+                # upb serializes map entries in internal hash order (its own
+                # bytes are not canonical for multi-entry maps — the same
+                # reason the fused kernel's contract is field-level there)
+                assert got == want, f"row {i} diverged"
+                n_byte_exact += 1
+            back = pb.ParserSchema()
+            back.ParseFromString(got)
+            assert back == ref, f"row {i} field-diverged"
+        assert n_byte_exact > n // 4
+        # downstream featurization must be blind to map wire order: the
+        # token rows of the native bytes and the pb2 bytes are identical
+        nat_tok, nat_ok = matchkern.featurize_batch(native_rows, 24, 4096)
+        pb2_tok, pb2_ok = matchkern.featurize_batch(pb2_rows, 24, 4096)
+        np.testing.assert_array_equal(nat_ok, pb2_ok)
+        np.testing.assert_array_equal(nat_tok, pb2_tok)
+
+    @pytest.mark.parametrize("accept_raw", [False, True])
+    def test_fuzz_hybrid_batch_matches_pb2_reference(self, tmp_path,
+                                                     accept_raw):
+        """End-to-end: MatcherParser's hybrid batch path (native decode
+        spans + native emit) is field-identical to the pure-pb2 reference
+        over the whole fuzz corpus, errors counted identically."""
+        parser = TestParseBatchKernelParity()._parser(
+            tmp_path, accept_raw_lines=accept_raw,
+            templates=["type=<*> msg=audit(<*>): <*>", "pid=<*>"])
+        assert parser._logs_native is not None
+        rng = random.Random(0xAB12 + accept_raw)
+        payloads = self._corpus(rng, 500)
+        errors = []
+        parser.count_processing_errors = lambda n, what: errors.append(n)
+        hybrid = parser._process_batch_python(list(payloads))
+        n_err_hybrid = sum(errors)
+        errors.clear()
+        ref = parser._process_batch_pb2(list(payloads))
+        n_err_ref = sum(errors)
+        assert len(hybrid) == len(ref)
+        fields = TestParseBatchKernelParity._fields
+        for i, (a, b) in enumerate(zip(hybrid, ref)):
+            assert fields(a) == fields(b), f"row {i} diverged"
+        assert n_err_hybrid == n_err_ref
+
+    def test_time_format_config_uses_logs_kernel_frames(self, tmp_path):
+        """time_format keeps the fused kernel off, but frame expansion +
+        LogSchema decode + ParserSchema serialize still run natively; the
+        outputs stay field-identical to the pb2 reference."""
+        from detectmateservice_tpu.engine.framing import pack_batch
+        from detectmateservice_tpu.library.parsers.template_matcher import (
+            MatcherParser,
+        )
+
+        tf = tmp_path / "templates.txt"
+        tf.write_text("arch=<*> syscall=<*>\n")
+        parser = MatcherParser(config={"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": "type=<Type> msg=audit(<Time>): <Content>",
+            "time_format": "%s-ignored",
+            "params": {"path_templates": str(tf)}}}})
+        assert parser._parse_native is None      # fused kernel gated off
+        assert parser._logs_native is not None   # decode kernel still on
+        payloads = TestParseBatchKernelParity().audit_payloads(48)
+        frames = [pack_batch(payloads[:24]), pack_batch(payloads[24:])]
+        outs, n_msgs, _ = parser.process_frames(frames)
+        assert n_msgs == 48
+        ref = parser._process_batch_pb2(list(payloads))
+        fields = TestParseBatchKernelParity._fields
+        assert [fields(a) for a in outs] == [fields(b) for b in ref]
+
+    def test_native_parse_off_forces_pb2_path(self, tmp_path):
+        from detectmateservice_tpu.library.parsers.template_matcher import (
+            MatcherParser,
+        )
+
+        parser = MatcherParser(config={"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": "type=<Type> msg=audit(<Time>): <Content>",
+            "params": {"native_parse": False}}}})
+        assert parser._parse_native is None
+        assert parser._logs_native is None
+        payloads = TestParseBatchKernelParity().audit_payloads(8)
+        out = parser.process_batch(list(payloads))
+        ref = parser._process_batch_pb2(list(payloads))
+        fields = TestParseBatchKernelParity._fields
+        assert [fields(a) for a in out] == [fields(b) for b in ref]
+
+    def test_parse_row_counters_partition_the_batch(self, tmp_path):
+        from detectmateservice_tpu.engine import metrics as m
+
+        parser = TestParseBatchKernelParity()._parser(
+            tmp_path, accept_raw_lines=True,
+            templates=["type=<*> msg=audit(<*>): <*>"])
+        labels = parser.metrics_labels
+        native_c = m.PARSE_NATIVE_ROWS().labels(**labels)
+        fallback_c = m.PARSE_FALLBACK_ROWS().labels(**labels)
+        before = native_c._value.get() + fallback_c._value.get()
+        payloads = TestParseBatchKernelParity().audit_payloads(20)
+        payloads.append(b'{"message": "type=J msg=audit(1.1): x"}\n')
+        parser.process_batch(list(payloads))
+        after = native_c._value.get() + fallback_c._value.get()
+        assert after - before == len(payloads)

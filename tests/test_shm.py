@@ -1,0 +1,423 @@
+"""Zero-copy host-path tests: the shm slot refcount protocol (dm_shm_*),
+the ShmWriter/ShmReader framing round-trip (byte-identical vs copy mode),
+the MAGIC_SHM wire reference format, the engine's colocated zero-copy mode
+end-to-end, and the native transport's batched send_many.
+
+The threaded slot-protocol stress is the TSan target for the shm
+reclamation path (scripts/native_sanitize.sh runs this file under
+instrumented builds): publish/release races are exactly what the C11
+atomics exist to make impossible.
+"""
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from detectmateservice_tpu.engine.framing import (
+    MAGIC_SHM,
+    FramingError,
+    ShmRef,
+    pack_shm_ref,
+    unpack_shm_ref,
+)
+
+matchkern = pytest.importorskip(
+    "detectmateservice_tpu.utils.matchkern",
+    reason="native kernels not built and no compiler available",
+)
+if not matchkern.has_shm_kernel():
+    pytest.skip("shm slot kernel not in the loaded library",
+                allow_module_level=True)
+
+from detectmateservice_tpu.engine.shm import (  # noqa: E402
+    ShmReader,
+    ShmWriter,
+    shm_available,
+)
+
+
+class TestShmRefFraming:
+    def test_round_trip(self):
+        ref = ShmRef("/dev/shm/dmshm-abc.seg", 7, 123456, 8192, 65000)
+        packed = pack_shm_ref(ref)
+        assert packed.startswith(MAGIC_SHM)
+        assert unpack_shm_ref(packed) == ref
+
+    def test_inproc_name_round_trip(self):
+        ref = ShmRef(f"@inproc:{os.getpid()}:3", 0, 1, 0, 10)
+        assert unpack_shm_ref(pack_shm_ref(ref)) == ref
+
+    def test_garbled_reference_raises(self):
+        ref = pack_shm_ref(ShmRef("/x", 1, 2, 3, 4))
+        with pytest.raises(FramingError):
+            unpack_shm_ref(ref[:-1])          # truncated varint
+        with pytest.raises(FramingError):
+            unpack_shm_ref(ref + b"\x00")     # trailing bytes
+        with pytest.raises(FramingError):
+            unpack_shm_ref(b"not a ref")
+
+    def test_magic_is_not_a_batch_or_trace_frame(self):
+        from detectmateservice_tpu.engine.framing import MAGIC, MAGIC_V2
+
+        assert MAGIC_SHM not in (MAGIC, MAGIC_V2)
+        assert MAGIC_SHM[:3] == MAGIC[:3]     # same family, new kind byte
+
+
+class TestSlotProtocol:
+    """Unit-level coverage of the C11-atomic slot state machine."""
+
+    def _header(self, slots):
+        buf = np.zeros(matchkern.shm_header_bytes(slots), dtype=np.uint8)
+        addr = int(buf.ctypes.data)
+        matchkern.shm_init(addr, slots)
+        return buf, addr
+
+    def test_acquire_publish_release_cycle(self):
+        buf, addr = self._header(2)
+        slot = matchkern.shm_acquire(addr, 2)
+        assert slot == 0
+        assert matchkern.shm_state(addr, 0) == -1      # WRITING
+        gen = matchkern.shm_publish(addr, slot, 2)
+        assert matchkern.shm_state(addr, 0) == 2
+        assert matchkern.shm_release(addr, slot, gen) == 1
+        assert matchkern.shm_release(addr, slot, gen) == 0
+        assert matchkern.shm_state(addr, 0) == 0       # FREE again
+
+    def test_acquire_exhaustion_and_reuse(self):
+        buf, addr = self._header(2)
+        s0 = matchkern.shm_acquire(addr, 2)
+        s1 = matchkern.shm_acquire(addr, 2)
+        assert {s0, s1} == {0, 1}
+        assert matchkern.shm_acquire(addr, 2) == -1    # exhausted
+        g0 = matchkern.shm_publish(addr, s0, 1)
+        assert matchkern.shm_release(addr, s0, g0) == 0
+        assert matchkern.shm_acquire(addr, 2) == s0    # recycled
+
+    def test_stale_gen_release_rejected(self):
+        buf, addr = self._header(1)
+        slot = matchkern.shm_acquire(addr, 1)
+        gen = matchkern.shm_publish(addr, slot, 1)
+        assert matchkern.shm_release(addr, slot, gen) == 0
+        # recycle the slot: a new publish bumps the generation
+        slot2 = matchkern.shm_acquire(addr, 1)
+        gen2 = matchkern.shm_publish(addr, slot2, 1)
+        assert gen2 != gen
+        assert matchkern.shm_release(addr, slot2, gen) == -1   # stale ref
+        assert matchkern.shm_state(addr, slot2) == 1           # undisturbed
+        assert matchkern.shm_release(addr, slot2, gen2) == 0
+
+    def test_double_release_rejected(self):
+        buf, addr = self._header(1)
+        slot = matchkern.shm_acquire(addr, 1)
+        gen = matchkern.shm_publish(addr, slot, 1)
+        assert matchkern.shm_release(addr, slot, gen) == 0
+        # gen still matches but the slot is FREE: must not go negative
+        assert matchkern.shm_release(addr, slot, gen) == -1
+        assert matchkern.shm_state(addr, slot) == 0
+
+    def test_abandon_frees_writing_slot(self):
+        buf, addr = self._header(1)
+        slot = matchkern.shm_acquire(addr, 1)
+        matchkern.shm_abandon(addr, slot)
+        assert matchkern.shm_state(addr, slot) == 0
+        assert matchkern.shm_acquire(addr, 1) == slot
+
+    def test_threaded_publish_release_stress(self):
+        """The TSan target: one producer cycling slots, several consumers
+        releasing them concurrently. Every published ref is released exactly
+        once; the pool must end all-FREE with no lost or negative slots."""
+        slots = 4
+        buf, addr = self._header(slots)
+        n_msgs = 3000
+        refs: "queue.Queue" = queue.Queue()
+        released = [0]
+        stop = object()
+        n_consumers = 3
+
+        def consumer():
+            while True:
+                item = refs.get()
+                if item is stop:
+                    return
+                slot, gen = item
+                assert matchkern.shm_release(addr, slot, gen) >= 0
+                released[0] += 1          # GIL-atomic int bump
+
+        threads = [threading.Thread(target=consumer)
+                   for _ in range(n_consumers)]
+        for t in threads:
+            t.start()
+        produced = 0
+        while produced < n_msgs:
+            slot = matchkern.shm_acquire(addr, slots)
+            if slot < 0:                  # consumers behind: spin briefly
+                time.sleep(0)
+                continue
+            gen = matchkern.shm_publish(addr, slot, 1)
+            refs.put((slot, gen))
+            produced += 1
+        for _ in threads:
+            refs.put(stop)
+        for t in threads:
+            t.join(timeout=30)
+        assert released[0] == n_msgs
+        assert all(matchkern.shm_state(addr, i) == 0 for i in range(slots))
+
+
+class TestWriterReader:
+    @pytest.mark.parametrize("inproc", [False, True])
+    def test_round_trip_byte_identical(self, inproc):
+        writer = ShmWriter(slots=4, slot_bytes=4096, inproc=inproc)
+        reader = ShmReader()
+        try:
+            payloads = [os.urandom(n) for n in (1, 100, 4096)]
+            for payload in payloads:
+                ref = writer.publish(payload, refs=1)
+                assert ref is not None
+                out = reader.resolve_release(ref)
+                assert out == payload     # byte-identical vs copy mode
+                if inproc:
+                    assert out is payload  # true zero-copy: same object
+            assert writer.in_use() == 0
+        finally:
+            reader.close()
+            writer.close()
+
+    def test_oversized_payload_downgrades(self):
+        writer = ShmWriter(slots=2, slot_bytes=1024)
+        try:
+            assert writer.publish(os.urandom(1025), refs=1) is None
+        finally:
+            writer.close()
+
+    def test_exhausted_pool_downgrades_and_recovers(self):
+        writer = ShmWriter(slots=2, slot_bytes=1024)
+        reader = ShmReader()
+        try:
+            held = [writer.publish(b"x" * 10, refs=1) for _ in range(2)]
+            assert all(r is not None for r in held)
+            assert writer.publish(b"y", refs=1) is None   # all slots held
+            for ref in held:
+                assert reader.resolve_release(ref) == b"x" * 10
+            assert writer.publish(b"y", refs=1) is not None
+        finally:
+            reader.close()
+            writer.close()
+
+    def test_stale_and_unknown_references_fail_closed(self):
+        writer = ShmWriter(slots=2, slot_bytes=1024)
+        reader = ShmReader()
+        try:
+            ref = writer.publish(b"payload", refs=1)
+            assert reader.resolve_release(ref) == b"payload"
+            assert reader.resolve_release(ref) is None     # stale
+            ghost = pack_shm_ref(ShmRef("/dev/shm/dmshm-nope.seg", 0, 1, 64, 4))
+            assert reader.resolve_release(ghost) is None   # unknown segment
+            assert reader.resolve_release(
+                pack_shm_ref(ShmRef(f"@inproc:{os.getpid()}:999999",
+                                    0, 1, 0, 4))) is None  # unknown slab
+        finally:
+            reader.close()
+            writer.close()
+
+    def test_sender_side_release_on_failed_send(self):
+        writer = ShmWriter(slots=1, slot_bytes=1024)
+        try:
+            ref = writer.publish(b"undeliverable", refs=1)
+            assert writer.publish(b"next", refs=1) is None  # pool full
+            writer.release_ref(ref)                         # drop accounting
+            assert writer.publish(b"next", refs=1) is not None
+        finally:
+            writer.close()
+
+    def test_multi_ref_fanout(self):
+        writer = ShmWriter(slots=1, slot_bytes=1024)
+        readers = [ShmReader(), ShmReader()]
+        try:
+            ref = writer.publish(b"fan-out", refs=2)
+            assert readers[0].resolve_release(ref) == b"fan-out"
+            assert writer.in_use() == 1                    # one ref left
+            assert readers[1].resolve_release(ref) == b"fan-out"
+            assert writer.in_use() == 0
+        finally:
+            for r in readers:
+                r.close()
+            writer.close()
+
+
+class TestEngineZeroCopy:
+    """Colocated-mode engine E2E: payloads byte-identical shm vs copy,
+    shm_frames_total accounting, and the copy-downgrade for remote peers."""
+
+    def _pipeline(self, tmp_path, zero_copy, tag):
+        from detectmateservice_tpu.engine.engine import Engine
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        received = []
+
+        class Sink:
+            def process(self, data):
+                received.append(data)
+                return None
+
+        class Fwd:
+            def process(self, data):
+                return data
+
+        sink_addr = f"ipc://{tmp_path}/sink-{tag}.ipc"
+        fwd_addr = f"ipc://{tmp_path}/fwd-{tag}.ipc"
+        sink = Engine(ServiceSettings(
+            engine_addr=sink_addr, engine_recv_timeout=50,
+            component_type="zc_sink", component_name=f"sink-{tag}"), Sink())
+        fwd = Engine(ServiceSettings(
+            engine_addr=fwd_addr, out_addr=[sink_addr],
+            engine_recv_timeout=50, zero_copy_framing=zero_copy,
+            zero_copy_slots=8, zero_copy_slot_bytes=65536,
+            component_type="zc_fwd", component_name=f"fwd-{tag}"), Fwd())
+        sink.start()
+        fwd.start()
+        return fwd, sink, fwd_addr, received
+
+    def _drive(self, fwd, sink, addr, received, payloads, check=None):
+        import zmq
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.DEALER)
+        try:
+            sock.connect(addr)
+            for payload in payloads:
+                sock.send(payload)
+            deadline = time.time() + 15
+            while len(received) < len(payloads) and time.time() < deadline:
+                time.sleep(0.02)
+            if check is not None:
+                check()               # inspect live state before teardown
+        finally:
+            sock.close(0)
+            fwd.stop()
+            sink.stop()
+        return received
+
+    def test_shm_and_copy_modes_byte_identical(self, tmp_path):
+        from prometheus_client import REGISTRY
+
+        payloads = [b"msg-%03d-" % i + os.urandom(64) for i in range(24)]
+        results = {}
+        for zero_copy in (False, True):
+            tag = "zc" if zero_copy else "copy"
+            fwd, sink, addr, received = self._pipeline(
+                tmp_path, zero_copy, tag)
+            if zero_copy:
+                assert fwd._shm_writer is not None
+                labels = dict(component_type="zc_fwd",
+                              component_id=fwd.settings.component_id)
+            results[tag] = self._drive(fwd, sink, addr, received,
+                                       payloads)
+        assert results["copy"] == payloads
+        assert results["zc"] == payloads       # byte-identical either way
+        # the two modes partition the burst: most frames ride zero-copy,
+        # any the pool couldn't take (receiver lag) copy-downgraded cleanly
+        zc = REGISTRY.get_sample_value(
+            "shm_frames_total", dict(labels, mode="zero_copy")) or 0
+        copied = REGISTRY.get_sample_value(
+            "shm_frames_total", dict(labels, mode="copy")) or 0
+        assert zc + copied == len(payloads)
+        assert zc > 0
+
+    def test_remote_peer_stays_in_copy_mode(self, tmp_path, free_port):
+        from detectmateservice_tpu.engine.engine import Engine
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        eng = Engine(ServiceSettings(
+            engine_addr=f"ipc://{tmp_path}/remote-src.ipc",
+            out_addr=[f"tcp://127.0.0.1:{free_port}"],
+            zero_copy_framing=True, component_type="zc_remote"),
+            type("P", (), {"process": staticmethod(lambda d: d)})())
+        try:
+            assert eng._shm_writer is None     # copy-downgrade at setup
+        finally:
+            eng.stop()
+
+    def test_slots_reclaimed_under_sustained_traffic(self, tmp_path):
+        payloads = [os.urandom(256) for _ in range(64)]
+        fwd, sink, addr, received = self._pipeline(tmp_path, True, "sustain")
+        writer = fwd._shm_writer
+        seen = []
+
+        def check():
+            # all payloads resolved ⇒ every published ref was released;
+            # read the live pool BEFORE engine stop closes the mapping
+            seen.append(writer.in_use())
+
+        out = self._drive(fwd, sink, addr, received, payloads, check=check)
+        assert out == payloads
+        assert seen == [0]                     # every slot came back
+
+
+class TestSendMany:
+    def test_send_many_round_trip_and_partial(self, tmp_path):
+        native = pytest.importorskip(
+            "detectmateservice_tpu.engine.native_transport")
+        f = native.NativePairSocketFactory()
+        server = f.create(f"ipc://{tmp_path}/sm.ipc")
+        client = f.create_output(f"ipc://{tmp_path}/sm.ipc", buffer_size=256)
+        try:
+            time.sleep(0.2)                    # background connect
+            frames = [b"f%04d-" % i + os.urandom(i % 97) for i in range(300)]
+            sent = 0
+            deadline = time.time() + 10
+            while sent < len(frames) and time.time() < deadline:
+                try:
+                    sent += client.send_many(frames[sent:], block=False)
+                except native.TransportAgain:
+                    time.sleep(0.005)
+            assert sent == len(frames)
+            got = []
+            while len(got) < len(frames):
+                got.extend(server.recv_many(64, 2000))
+            assert got == frames               # order + bytes preserved
+        finally:
+            client.close()
+            server.close()
+
+    def test_engine_output_pump_uses_send_many(self, tmp_path):
+        """The engine's batched fan-out path delivers a whole burst through
+        send_many with per-frame accounting intact."""
+        from detectmateservice_tpu.engine.engine import Engine
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        native = pytest.importorskip(
+            "detectmateservice_tpu.engine.native_transport")
+        f = native.NativePairSocketFactory()
+        sink_addr = f"ipc://{tmp_path}/pump-sink.ipc"
+        sink_sock = f.create(sink_addr)
+        eng = Engine(ServiceSettings(
+            engine_addr=f"ipc://{tmp_path}/pump-src.ipc",
+            out_addr=[sink_addr], transport_backend="native",
+            send_batch_max=16, component_type="pump"),
+            type("P", (), {"process": staticmethod(lambda d: d)})())
+        try:
+            time.sleep(0.2)
+            calls = []
+            sock = eng._out_socks[0]
+            orig = sock.send_many
+
+            def counting(frames, block=False):
+                calls.append(len(frames))
+                return orig(frames, block=block)
+
+            sock.send_many = counting
+            outs = [b"out-%03d" % i for i in range(40)]
+            eng._send_results(list(outs))
+            got = []
+            while len(got) < len(outs):
+                got.extend(sink_sock.recv_many(64, 2000))
+            assert got == outs
+            assert calls and max(calls) <= 16  # chunked by send_batch_max
+            assert sum(calls) >= len(outs)
+        finally:
+            eng.stop()
+            sink_sock.close()
