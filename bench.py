@@ -371,6 +371,11 @@ def run_open_loop(det, closed_loop_lps: float) -> dict:
     of being absorbed by the caller's pacing. The adaptive coalescer is
     enabled for this phase only (the closed-loop headline stays on the
     legacy dispatch path), and its scheduler counters are the result."""
+    # the arrival machinery is the loadgen package's OpenLoopSchedule — the
+    # same immutable wall-clock schedule scripts/soak.py drives the full
+    # pipeline with, here replayed against the in-process detector
+    from detectmateservice_tpu.loadgen.generator import OpenLoopSchedule
+
     rate = OPENLOOP_RATE or max(1000.0, 0.6 * closed_loop_lps)
     burst = max(1, OPENLOOP_BURST)
     total = max(burst, int(min(rate * OPENLOOP_SECONDS, 2_000_000)))
@@ -380,18 +385,17 @@ def run_open_loop(det, closed_loop_lps: float) -> dict:
     det.config.batch_target_occupancy = 0.9
     before = det.batching_stats()
     tick_s = max(0.0005, (det.drain_poll_ms or 5) / 1000.0)
-    interval = burst / rate
-    alerts = sent = 0
-    t0 = time.perf_counter()
-    next_t = t0
+    alerts = sent = i = 0
+    sched = OpenLoopSchedule(rate, burst, clock=time.perf_counter)
+    t0 = sched.t0
     try:
         while sent < total:
             now = time.perf_counter()
-            if now < next_t:
+            if now < sched.deadline(i):
                 # the engine's short-poll tick stand-in: deadline releases
                 # and ready readbacks drain between arrivals
                 alerts += sum(o is not None for o in det.drain_ready())
-                time.sleep(min(next_t - now, tick_s))
+                time.sleep(min(sched.deadline(i) - now, tick_s))
                 continue
             base = sent % len(msgs)
             chunk = msgs[base:base + burst]
@@ -399,9 +403,12 @@ def run_open_loop(det, closed_loop_lps: float) -> dict:
                 chunk = chunk + msgs[:burst - len(chunk)]
             alerts += sum(o is not None for o in det.process_batch(chunk))
             sent += burst
-            next_t += interval
-            if now - next_t > 2.0:
-                next_t = now  # hopelessly behind: open loop, not a death spiral
+            i += 1
+            if sched.lag_s(i) > 2.0:
+                # hopelessly behind: skip ahead on the fixed schedule
+                # (open loop, not a death spiral — skipped bursts are
+                # offered-but-unsourced load, visible as achieved < offered)
+                i = int((sched.clock() - sched.t0) / sched.interval_s)
         alerts += sum(o is not None for o in det.flush())
         elapsed = time.perf_counter() - t0
         after = det.batching_stats()

@@ -21,6 +21,9 @@ YAMLs. These rules hold them in sync, in both directions:
            documented in docs/usage.md (the operator cannot find it)
   DM-C008  docs/usage.md documents a ``GET/POST /admin/...`` route the
            router never declares (the documented call 404s)
+  DM-C009  a chaos scenario declared in scripts/soak.py's SCENARIOS table
+           is not documented in docs/benchmarks.md (the soak-record reader
+           cannot interpret the verdict)
 
 Everything is parsed statically — the series registry and the settings
 fields are read from the AST, not by importing the package — so the checker
@@ -299,6 +302,45 @@ def check_routes_contract(repo: Path) -> List[Finding]:
     return findings
 
 
+def declared_soak_scenarios(soak_path: Path) -> Dict[str, int]:
+    """Parse ``scripts/soak.py`` for the ``SCENARIOS = {...}`` table →
+    {scenario name: line}. AST-only: no harness import (it pulls jax)."""
+    tree = ast.parse(soak_path.read_text(encoding="utf-8"))
+    scenarios: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "SCENARIOS" not in targets or not isinstance(node.value, ast.Dict):
+            continue
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                scenarios[key.value] = key.lineno
+    return scenarios
+
+
+def check_soak_contract(repo: Path) -> List[Finding]:
+    """DM-C009: every chaos scenario the soak harness implements is
+    documented in docs/benchmarks.md — a SOAK_*.json verdict names its
+    scenario, so an undocumented one leaves the record unreadable."""
+    findings: List[Finding] = []
+    soak_py = repo / "scripts" / "soak.py"
+    bench_doc = repo / "docs" / "benchmarks.md"
+    if not soak_py.exists() or not bench_doc.exists():
+        return findings
+    doc_text = bench_doc.read_text(encoding="utf-8")
+    for name, line in sorted(declared_soak_scenarios(soak_py).items()):
+        if not re.search(rf"`{re.escape(name)}`", doc_text):
+            findings.append(Finding(
+                "DM-C009", "scripts/soak.py", line,
+                f"soak scenario {name!r} is not documented in "
+                "docs/benchmarks.md",
+                hint="add a row to the soak-scenario table (format: "
+                     "| `name` | fault | expected alerts |)",
+                key=f"soak-doc:{name}"))
+    return findings
+
+
 def check_all(repo: Path) -> List[Finding]:
     return (check_metrics_contract(repo) + check_settings_contract(repo)
-            + check_routes_contract(repo))
+            + check_routes_contract(repo) + check_soak_contract(repo))

@@ -8,7 +8,10 @@ subcommands ``start`` / ``stop`` / ``status`` / ``metrics`` /
 fetches a Perfetto-loadable trace-event document), ``events`` (the
 structured-event ring), ``xla [--limit N]`` (the device-side XLA compile
 ledger + batch spans), ``profile [--seconds N] [--wait] [-o FILE]`` (start an
-on-demand jax.profiler capture and, with --wait, download the artifact zip)
+on-demand jax.profiler capture and, with --wait, download the artifact zip),
+``load start|status|stop`` (drive the open-loop load generator behind
+``/admin/load`` and read its live SLO scorecard; ``start --wait`` exits
+non-zero on client-visible loss)
 and ``health`` — which fans out across every stage of
 a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
 ``stages:`` mapping), prints a roll-up table, and exits non-zero when any
@@ -105,6 +108,20 @@ class DetectMateClient:
         (``GET /admin/xla``)."""
         suffix = f"?limit={int(limit)}" if limit is not None else ""
         return self._request("GET", "/admin/xla" + suffix)
+
+    def load_start(self, profile: dict) -> Any:
+        """Start an open-loop load run (``POST /admin/load``). HTTP 409
+        (another run active) is raised as urllib.error.HTTPError."""
+        return self._request("POST", "/admin/load",
+                             dict(profile, action="start"))
+
+    def load_stop(self) -> Any:
+        """Stop the active load run and return its final scorecard."""
+        return self._request("POST", "/admin/load", {"action": "stop"})
+
+    def load_status(self) -> Any:
+        """Live SLO scorecard of the load run (``GET /admin/load``)."""
+        return self._request("GET", "/admin/load")
 
     def profile_start(self, seconds: float = 1.0) -> Any:
         """Start an on-demand jax.profiler capture
@@ -217,6 +234,61 @@ def run_profile(client: DetectMateClient, seconds: float, wait: bool,
     return 0
 
 
+def _parse_mix(spec: str) -> dict:
+    """``anomaly=0.005,json=0.01,invalid_utf8=0.005`` → mix dict."""
+    mix = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        if "=" not in part:
+            raise ValueError(f"mix entry {part!r} is not key=fraction")
+        key, _, value = part.partition("=")
+        mix[key.strip()] = float(value)
+    return mix
+
+
+def run_load(client: DetectMateClient, args) -> int:
+    """``client.py load``: drive the open-loop load generator. ``start
+    --wait`` polls until the run's schedule (+ settle) completes, stops it,
+    and exits non-zero on client-visible loss — the scriptable smoke-soak."""
+    import time as _time
+
+    if args.action == "status":
+        print(json.dumps(client.load_status(), indent=2))
+        return 0
+    if args.action == "stop":
+        final = client.load_stop()
+        print(json.dumps(final, indent=2))
+        return 0
+    profile = {"target_addr": args.target, "rate": args.rate,
+               "burst": args.burst, "seconds": args.seconds,
+               "settle_s": args.settle, "seed": args.seed,
+               "warm_lines": args.warm_lines}
+    if args.listen:
+        profile["listen_addr"] = args.listen
+    if args.mix:
+        profile["mix"] = _parse_mix(args.mix)
+    try:
+        started = client.load_start(profile)
+    except urllib.error.HTTPError as exc:
+        print(f"load start rejected ({exc.code}): "
+              f"{exc.read().decode('utf-8', errors='replace')}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(started, indent=2))
+    if not args.wait:
+        return 0
+    deadline = _time.monotonic() + args.seconds + args.settle + 30.0
+    status = client.load_status()
+    while status.get("running") and _time.monotonic() < deadline:
+        _time.sleep(1.0)
+        status = client.load_status()
+    final = client.load_stop()
+    print(json.dumps(final, indent=2))
+    loss = (final.get("scorecard") or {}).get("loss")
+    return 0 if loss == 0 else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="detectmate-client", description="Admin client for DetectMate TPU services"
@@ -258,6 +330,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     profile_p.add_argument("-o", "--out", default="profile.zip",
                            help="artifact path for --wait (default "
                                 "profile.zip)")
+    load_p = sub.add_parser(
+        "load", help="drive the open-loop load generator (/admin/load)")
+    load_p.add_argument("action", choices=["start", "status", "stop"],
+                        help="start a run, read the live scorecard, or "
+                             "stop and print the final scorecard")
+    load_p.add_argument("--target", help="pipeline ingress address the "
+                                         "generator dials (required for "
+                                         "start)")
+    load_p.add_argument("--listen", help="sink address the scorecard "
+                                         "collector listens on (the "
+                                         "terminal stage dials it)")
+    load_p.add_argument("--rate", type=float, default=2000.0,
+                        help="offered arrival rate, lines/s (default 2000)")
+    load_p.add_argument("--burst", type=int, default=256,
+                        help="lines per traced frame (default 256)")
+    load_p.add_argument("--seconds", type=float, default=30.0,
+                        help="run length; 0 = until stopped (default 30)")
+    load_p.add_argument("--settle", type=float, default=5.0,
+                        help="post-send drain window before outstanding "
+                             "traces count as loss (default 5)")
+    load_p.add_argument("--warm-lines", type=int, default=0,
+                        help="untraced all-normal preamble lines (scorer "
+                             "training) before the measured phase")
+    load_p.add_argument("--mix", help="edge-row fractions, e.g. "
+                                      "anomaly=0.005,json=0.01,"
+                                      "invalid_utf8=0.005")
+    load_p.add_argument("--seed", type=int, default=7)
+    load_p.add_argument("--wait", action="store_true",
+                        help="block until the schedule+settle completes, "
+                             "stop the run, and exit non-zero on loss")
     trace = sub.add_parser(
         "trace", help="read the pipeline flight recorder (/admin/trace)")
     trace.add_argument("--chrome", action="store_true",
@@ -275,6 +377,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return health_rollup(args.url, args.targets, deep=args.deep)
         if args.command == "profile":
             return run_profile(client, args.seconds, args.wait, args.out)
+        if args.command == "load":
+            if args.action == "start" and not args.target:
+                print("error: load start requires --target", file=sys.stderr)
+                return 2
+            return run_load(client, args)
         if args.command == "events":
             result = client.events(limit=args.limit)
         elif args.command == "xla":

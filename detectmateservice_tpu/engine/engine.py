@@ -172,6 +172,8 @@ class Engine:
                              or settings.component_name
                              or settings.component_type)
         self._trace_terminal = getattr(settings, "trace_terminal", None)
+        self._trace_observe_e2e = bool(
+            getattr(settings, "trace_observe_e2e", False))
         # FIFO of (TraceContext, recv_ns) for frames of the burst being
         # dispatched; consumed by outgoing v2 frames, finalized at burst end
         self._trace_pending: deque = deque()
@@ -415,10 +417,19 @@ class Engine:
 
     def _stamp_trace(self, payload: bytes, now_ns: int) -> bytes:
         """Complete the oldest pending context's hop and wrap ``payload``
-        as a v2 frame for the downstream stage."""
+        as a v2 frame for the downstream stage. With ``trace_observe_e2e``
+        this egress is ALSO the pipeline's internal completion point — e2e
+        is observed and the flight recorder fed here, while the trace still
+        propagates (the downstream consumer keys on its id); the recorder
+        snapshots the context into a dict, so downstream hops appended
+        later never mutate the recorded view."""
         ctx, recv_ns = self._trace_pending.popleft()
         ctx.hops.append(Hop(self._trace_stage, recv_ns, now_ns))
         self._dwell_obs(max(0, now_ns - recv_ns) / 1e9)
+        if self._trace_observe_e2e:
+            e2e = max(0, now_ns - ctx.ingest_ns) / 1e9
+            self._e2e_obs(e2e)
+            self.trace_recorder.record(ctx, e2e)
         return wrap_trace(payload, ctx)
 
     def _finalize_traces(self) -> None:

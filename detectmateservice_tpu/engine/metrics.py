@@ -275,6 +275,47 @@ BUCKET_SELECTED = _series(
     BUCKET_LABELS,
 )
 
+# open-loop load generation (loadgen/): the CLIENT-side view of the
+# pipeline a load run drives. sent/received count the generator's traced
+# frames and their contained lines; lost counts trace ids that never
+# reached the collector after the settle window (loss, not filtering — the
+# soak profiles are configured so every row flows through); the e2e
+# histogram is client-observed latency measured from each frame's SCHEDULED
+# arrival time (coordinated-omission guard), the external twin of
+# pipeline_e2e_latency_seconds — their p99 gap is the ingress/egress blind
+# spot (docs/walkthrough.md "read the client skew").
+LOADGEN_SENT_FRAMES = _series(
+    Counter, "loadgen_sent_frames_total",
+    "Traced wire frames the open-loop load generator scheduled and sent")
+LOADGEN_SENT_LINES = _series(
+    Counter, "loadgen_sent_lines_total",
+    "Lines (corpus rows) the open-loop load generator sent")
+LOADGEN_RECEIVED_FRAMES = _series(
+    Counter, "loadgen_received_frames_total",
+    "Frames the load collector received at the pipeline sink")
+LOADGEN_RECEIVED_LINES = _series(
+    Counter, "loadgen_received_lines_total",
+    "Lines the load collector received at the pipeline sink")
+LOADGEN_LOST_TRACES = _series(
+    Counter, "loadgen_lost_traces_total",
+    "Sent trace ids never observed at the collector after the settle "
+    "window — client-visible loss, the soak harness's loss==0 gate")
+LOADGEN_E2E_LATENCY = _series(
+    Histogram, "loadgen_e2e_latency_seconds",
+    "Client-observed e2e latency: collector receive time minus the frame's "
+    "scheduled (open-loop) arrival time",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0),
+)
+LOADGEN_OFFERED_RATE = _series(
+    Gauge, "loadgen_offered_lines_per_s",
+    "Configured open-loop arrival rate of the active load run (0 = idle)")
+LOADGEN_SEND_LAG = _series(
+    Gauge, "loadgen_send_lag_seconds",
+    "How far the load sender is running behind its arrival schedule; "
+    "sustained growth means the generator itself cannot source the "
+    "offered rate (the scheduled stamps still keep latency honest)")
+
 # adaptive continuous batching (library/detectors/jax_scorer.py coalescer):
 # rows held across process_batch calls toward the best-fitting warm bucket
 # under a latency budget. Depth is the current hold; releases count why

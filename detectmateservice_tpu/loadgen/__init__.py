@@ -1,0 +1,32 @@
+"""Open-loop load generation + chaos soak instrumentation.
+
+ROADMAP open item 5: the repo only measured closed-loop micro-bench
+throughput, so every SLO claim (burn-rate alerts, occupancy targets,
+zero-copy floors) was cross-referenced but never *exercised* under
+production-shaped traffic. This package closes the loop:
+
+* :mod:`corpus` — the one payload source (audit templates, JSON ``@type``
+  reroute traffic, invalid-UTF-8 edge rows) shared by the load generator,
+  ``examples/gen_audit_log.py``, and the bench harness;
+* :mod:`scorecard` — the client-side SLO scorecard: log-bucketed
+  client-observed e2e latency keyed on PR-1 v2 trace ids, sent-vs-received
+  loss accounting, achieved-vs-offered goodput;
+* :mod:`generator` — the open-loop scheduler (arrival times fixed by
+  rate/burst, never delayed by a slow send — no coordinated omission), the
+  sender/collector threads, and the process-wide manager behind
+  ``POST/GET /admin/load``;
+* :mod:`alerteval` — a miniature evaluator for the PromQL subset
+  ``ops/alerts.yml`` uses, so a soak run can assert a rule *actually
+  transitions to firing* under its injected fault instead of trusting the
+  cross-artifact lint alone.
+"""
+from .corpus import PayloadMix, make_line, payload_bytes  # noqa: F401
+from .generator import (  # noqa: F401
+    LOADGEN,
+    LoadBusyError,
+    LoadGenerator,
+    LoadManager,
+    LoadProfile,
+    OpenLoopSchedule,
+)
+from .scorecard import LatencyHistogram, Scorecard  # noqa: F401

@@ -1,0 +1,591 @@
+"""Miniature Prometheus rule evaluator for live-testing ``ops/alerts.yml``.
+
+The cross-artifact lint (dmlint DM-C001/4) proves every alert rule
+*references* real series; it cannot prove a rule *fires* when its failure
+happens. This module closes that gap without a Prometheus server: the soak
+harness scrapes each stage's ``/metrics`` exposition into a
+:class:`SampleStore` on a fixed cadence and evaluates the actual rule
+expressions from ``ops/alerts.yml`` against it, tracking each rule through
+``inactive → pending → firing`` exactly like the real evaluator (including
+the ``for:`` hold).
+
+Scope: the PromQL **subset the rule file uses** — instant vector selectors
+with label matchers, ``rate``/``irate``/``increase`` and
+``min/max/avg_over_time`` over range selectors, ``sum|min|max|avg`` with
+``by (...)``, scalar arithmetic, comparison filters, ``and``/``or``/
+``unless``, and ``ignoring(...)`` vector matching for ``/``. A rule using
+anything else fails loudly at parse time — tests/test_loadgen.py parses
+every expression in ``ops/alerts.yml`` through this grammar, so a rule
+edit that drifts outside the subset breaks the build instead of silently
+un-testing itself.
+
+Compressed soaks: a 60 s CI run cannot hold a fault for a literal
+``for: 1m`` on top of 5m rate windows. ``time_scale`` divides every
+**duration** (``for:`` holds and range-selector windows) while leaving
+value thresholds untouched — the rule still demands the same signal
+magnitude, just over a proportionally shorter observation.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# -- sample store ------------------------------------------------------------
+
+# prometheus exposition line: name{labels} value  (timestamps unused)
+_EXPO_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+Labels = Tuple[Tuple[str, str], ...]
+
+
+def _freeze(labels: Dict[str, str]) -> Labels:
+    return tuple(sorted(labels.items()))
+
+
+class SampleStore:
+    """Append-only time series store: ``name → {labels → [(t, v), ...]}``.
+
+    ``t`` is seconds on whatever clock the caller scrapes with (monotonic
+    in the soak harness). Instant lookups apply Prometheus's 5-minute
+    staleness rule scaled by the caller.
+    """
+
+    def __init__(self, staleness_s: float = 300.0) -> None:
+        self._series: Dict[str, Dict[Labels, List[Tuple[float, float]]]] = {}
+        self.staleness_s = staleness_s
+
+    def add(self, name: str, labels: Dict[str, str], t: float,
+            value: float) -> None:
+        self._series.setdefault(name, {}).setdefault(
+            _freeze(labels), []).append((t, value))
+
+    def ingest_exposition(self, text: str, t: float,
+                          extra_labels: Optional[Dict[str, str]] = None) \
+            -> None:
+        """Parse one ``/metrics`` payload at scrape time ``t``. Histogram
+        ``_bucket``/``_sum``/``_count`` series land under their exposition
+        names, which is what the rule expressions reference."""
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            match = _EXPO_RE.match(line)
+            if not match:
+                continue
+            name, raw_labels, raw_value = match.groups()
+            try:
+                value = float(raw_value)
+            except ValueError:
+                continue
+            if math.isnan(value):
+                continue
+            labels = {k: v.replace(r"\"", '"')
+                      for k, v in _LABEL_RE.findall(raw_labels or "")}
+            if extra_labels:
+                labels.update(extra_labels)
+            self.add(name, labels, t, value)
+
+    # -- lookups ---------------------------------------------------------
+    def instant(self, name: str, matchers: Dict[str, str],
+                t: float) -> List[Tuple[Dict[str, str], float]]:
+        out = []
+        for labels, samples in self._series.get(name, {}).items():
+            label_dict = dict(labels)
+            if not _match(label_dict, matchers):
+                continue
+            last = None
+            for ts, v in reversed(samples):
+                if ts <= t:
+                    last = (ts, v)
+                    break
+            if last is not None and t - last[0] <= self.staleness_s:
+                out.append((label_dict, last[1]))
+        return out
+
+    def window(self, name: str, matchers: Dict[str, str], t: float,
+               range_s: float) \
+            -> List[Tuple[Dict[str, str], List[Tuple[float, float]]]]:
+        out = []
+        for labels, samples in self._series.get(name, {}).items():
+            label_dict = dict(labels)
+            if not _match(label_dict, matchers):
+                continue
+            within = [(ts, v) for ts, v in samples if t - range_s <= ts <= t]
+            if within:
+                out.append((label_dict, within))
+        return out
+
+
+def _match(labels: Dict[str, str], matchers: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in matchers.items())
+
+
+# -- expression AST ----------------------------------------------------------
+
+class PromQLError(ValueError):
+    """Expression uses syntax outside the supported subset."""
+
+
+_TOKEN_RE = re.compile(r"""
+    (?P<dur>\d+(?:\.\d+)?[smhdw](?![a-zA-Z_0-9]))
+  | (?P<num>\d+\.\d+|\.\d+|\d+)
+  | (?P<id>[a-zA-Z_:][a-zA-Z0-9_:]*)
+  | (?P<str>"(?:[^"\\]|\\.)*")
+  | (?P<op>==|!=|>=|<=|>|<|=|[+\-*/(){},\[\]])
+  | (?P<ws>\s+)
+""", re.X)
+
+_DUR_UNITS = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0, "w": 604800.0}
+_AGG_OPS = {"sum": sum, "min": min, "max": max,
+            "avg": lambda vs: sum(vs) / len(vs)}
+_RANGE_FNS = {"rate", "irate", "increase", "min_over_time",
+              "max_over_time", "avg_over_time"}
+
+
+def _tokenize(expr: str) -> List[Tuple[str, str]]:
+    tokens = []
+    pos = 0
+    while pos < len(expr):
+        match = _TOKEN_RE.match(expr, pos)
+        if match is None:
+            raise PromQLError(f"cannot tokenize at: {expr[pos:pos + 20]!r}")
+        pos = match.end()
+        kind = match.lastgroup
+        if kind == "ws":
+            continue
+        # durations only mean something inside [...]; "5m" outside would
+        # have been caught by the selector grammar anyway
+        tokens.append((kind, match.group()))
+    tokens.append(("end", ""))
+    return tokens
+
+
+class _Node:
+    def eval(self, store: SampleStore, t: float, scale: float):
+        raise NotImplementedError
+
+
+class _Number(_Node):
+    def __init__(self, value: float) -> None:
+        self.value = value
+
+    def eval(self, store, t, scale):
+        return self.value
+
+
+class _Selector(_Node):
+    def __init__(self, name: str, matchers: Dict[str, str],
+                 range_s: Optional[float] = None) -> None:
+        self.name = name
+        self.matchers = matchers
+        self.range_s = range_s
+
+    def eval(self, store, t, scale):
+        if self.range_s is not None:
+            raise PromQLError(f"range selector {self.name}[...] outside a "
+                              "range function")
+        return store.instant(self.name, self.matchers, t)
+
+
+class _RangeFn(_Node):
+    def __init__(self, fn: str, sel: _Selector) -> None:
+        if sel.range_s is None:
+            raise PromQLError(f"{fn}() needs a range selector")
+        self.fn = fn
+        self.sel = sel
+
+    def eval(self, store, t, scale):
+        window = max(1e-9, self.sel.range_s / scale)
+        out = []
+        for labels, samples in store.window(self.sel.name, self.sel.matchers,
+                                            t, window):
+            value = self._apply(samples, window)
+            if value is not None:
+                out.append((labels, value))
+        return out
+
+    def _apply(self, samples, window) -> Optional[float]:
+        if self.fn == "min_over_time":
+            return min(v for _, v in samples)
+        if self.fn == "max_over_time":
+            return max(v for _, v in samples)
+        if self.fn == "avg_over_time":
+            return sum(v for _, v in samples) / len(samples)
+        if len(samples) < 2:
+            return None  # rate/increase need two points, like Prometheus
+        if self.fn == "irate":
+            (t0, v0), (t1, v1) = samples[-2], samples[-1]
+            if t1 <= t0:
+                return None
+            return max(0.0, v1 - v0) / (t1 - t0)
+        # counter increase with reset handling
+        total = 0.0
+        prev = samples[0][1]
+        for _, v in samples[1:]:
+            total += v - prev if v >= prev else v
+            prev = v
+        elapsed = samples[-1][0] - samples[0][0]
+        if elapsed <= 0:
+            return None
+        if self.fn == "rate":
+            return total / elapsed
+        return total * (  # increase: extrapolate to the full window
+            min(window, elapsed * (len(samples) + 1) / len(samples))
+            / elapsed)
+
+
+class _Agg(_Node):
+    def __init__(self, op: str, by: Optional[Sequence[str]],
+                 arg: _Node) -> None:
+        self.op = _AGG_OPS[op]
+        self.by = tuple(by) if by is not None else None
+        self.arg = arg
+
+    def eval(self, store, t, scale):
+        vec = _as_vector(self.arg.eval(store, t, scale))
+        groups: Dict[Labels, List[float]] = {}
+        for labels, value in vec:
+            key = (_freeze({k: labels.get(k, "") for k in self.by})
+                   if self.by is not None else ())
+            groups.setdefault(key, []).append(value)
+        return [(dict(key), self.op(vs)) for key, vs in groups.items()]
+
+
+class _BinOp(_Node):
+    def __init__(self, op: str, left: _Node, right: _Node,
+                 ignoring: Sequence[str] = ()) -> None:
+        self.op = op
+        self.left = left
+        self.right = right
+        self.ignoring = tuple(ignoring)
+
+    _ARITH: Dict[str, Callable[[float, float], float]] = {
+        "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+        "*": lambda a, b: a * b,
+        "/": lambda a, b: a / b if b != 0 else math.nan,
+    }
+    _CMP: Dict[str, Callable[[float, float], bool]] = {
+        "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+        ">": lambda a, b: a > b, "<": lambda a, b: a < b,
+        ">=": lambda a, b: a >= b, "<=": lambda a, b: a <= b,
+    }
+
+    def eval(self, store, t, scale):
+        left = self.left.eval(store, t, scale)
+        right = self.right.eval(store, t, scale)
+        if self.op in ("and", "or", "unless"):
+            return self._set_op(_as_vector(left), _as_vector(right))
+        if isinstance(left, float) and isinstance(right, float):
+            value = (self._ARITH[self.op](left, right)
+                     if self.op in self._ARITH
+                     else float(self._CMP[self.op](left, right)))
+            return value
+        if self.op in self._CMP:
+            return self._compare(left, right)
+        return self._arith(left, right)
+
+    def _key(self, labels: Dict[str, str]) -> Labels:
+        return _freeze({k: v for k, v in labels.items()
+                        if k not in self.ignoring})
+
+    def _set_op(self, left, right):
+        right_keys = {self._key(labels) for labels, _ in right}
+        if self.op == "and":
+            return [(l, v) for l, v in left if self._key(l) in right_keys]
+        if self.op == "unless":
+            return [(l, v) for l, v in left if self._key(l) not in right_keys]
+        out = list(left)
+        left_keys = {self._key(labels) for labels, _ in left}
+        out.extend((l, v) for l, v in right
+                   if self._key(l) not in left_keys)
+        return out
+
+    def _compare(self, left, right):
+        # vector cmp scalar → filter; scalar cmp vector → filter on reversed
+        fn = self._CMP[self.op]
+        if isinstance(right, float):
+            return [(l, v) for l, v in _as_vector(left) if fn(v, right)]
+        if isinstance(left, float):
+            return [(l, v) for l, v in _as_vector(right) if fn(left, v)]
+        right_map = {self._key(l): v for l, v in right}
+        return [(l, v) for l, v in left
+                if self._key(l) in right_map and fn(v, right_map[self._key(l)])]
+
+    def _arith(self, left, right):
+        fn = self._ARITH[self.op]
+        if isinstance(right, float):
+            return [(l, fn(v, right)) for l, v in _as_vector(left)]
+        if isinstance(left, float):
+            return [(l, fn(left, v)) for l, v in _as_vector(right)]
+        right_map = {self._key(l): v for l, v in right}
+        out = []
+        for labels, value in left:
+            key = self._key(labels)
+            if key in right_map:
+                result = fn(value, right_map[key])
+                if not math.isnan(result):
+                    out.append((labels, result))
+        return out
+
+
+def _as_vector(value):
+    if isinstance(value, float):
+        # a bare scalar in vector position: empty-label singleton (the
+        # sum()-without-by result shape)
+        return [({}, value)]
+    return value
+
+
+# -- parser ------------------------------------------------------------------
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]) -> None:
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self) -> Tuple[str, str]:
+        return self.tokens[self.pos]
+
+    def next(self) -> Tuple[str, str]:
+        token = self.tokens[self.pos]
+        self.pos += 1
+        return token
+
+    def expect(self, text: str) -> None:
+        kind, value = self.next()
+        if value != text:
+            raise PromQLError(f"expected {text!r}, got {value!r}")
+
+    # precedence (loosest to tightest): or/unless < and < cmp < +- < */
+    def parse(self) -> _Node:
+        node = self.parse_or()
+        if self.peek()[0] != "end":
+            raise PromQLError(f"trailing input at {self.peek()[1]!r}")
+        return node
+
+    def parse_or(self) -> _Node:
+        node = self.parse_and()
+        while self.peek()[1] in ("or", "unless"):
+            op = self.next()[1]
+            node = _BinOp(op, node, self.parse_and())
+        return node
+
+    def parse_and(self) -> _Node:
+        node = self.parse_cmp()
+        while self.peek()[1] == "and":
+            self.next()
+            node = _BinOp("and", node, self.parse_cmp())
+        return node
+
+    def parse_cmp(self) -> _Node:
+        node = self.parse_add()
+        if self.peek()[1] in _BinOp._CMP:
+            op = self.next()[1]
+            node = _BinOp(op, node, self.parse_add())
+        return node
+
+    def parse_add(self) -> _Node:
+        node = self.parse_mul()
+        while self.peek()[1] in ("+", "-"):
+            op = self.next()[1]
+            node = _BinOp(op, node, self.parse_mul())
+        return node
+
+    def parse_mul(self) -> _Node:
+        node = self.parse_unary()
+        while self.peek()[1] in ("*", "/"):
+            op = self.next()[1]
+            ignoring: Sequence[str] = ()
+            if self.peek()[1] in ("ignoring", "on"):
+                mode = self.next()[1]
+                names = self._label_list()
+                if mode == "ignoring":
+                    ignoring = names
+                else:
+                    raise PromQLError("on(...) matching is not supported")
+            node = _BinOp(op, node, self.parse_unary(), ignoring=ignoring)
+        return node
+
+    def parse_unary(self) -> _Node:
+        kind, value = self.peek()
+        if value == "(":
+            self.next()
+            node = self.parse_or()
+            self.expect(")")
+            return node
+        if kind == "num":
+            self.next()
+            return _Number(float(value))
+        if kind != "id":
+            raise PromQLError(f"unexpected token {value!r}")
+        if value in _AGG_OPS:
+            return self._parse_agg()
+        if value in _RANGE_FNS:
+            fn = self.next()[1]
+            self.expect("(")
+            sel = self._parse_selector()
+            self.expect(")")
+            return _RangeFn(fn, sel)
+        return self._parse_selector()
+
+    def _parse_agg(self) -> _Node:
+        op = self.next()[1]
+        by: Optional[Sequence[str]] = None
+        if self.peek()[1] in ("by", "without"):
+            mode = self.next()[1]
+            if mode == "without":
+                raise PromQLError("without(...) grouping is not supported")
+            by = self._label_list()
+        self.expect("(")
+        arg = self.parse_or()
+        self.expect(")")
+        if by is None and self.peek()[1] == "by":
+            self.next()
+            by = self._label_list()
+        return _Agg(op, by, arg)
+
+    def _label_list(self) -> List[str]:
+        self.expect("(")
+        names = []
+        while True:
+            kind, value = self.next()
+            if kind != "id":
+                raise PromQLError(f"expected label name, got {value!r}")
+            names.append(value)
+            kind, value = self.next()
+            if value == ")":
+                return names
+            if value != ",":
+                raise PromQLError(f"expected ',' or ')', got {value!r}")
+
+    def _parse_selector(self) -> _Selector:
+        kind, name = self.next()
+        if kind != "id":
+            raise PromQLError(f"expected metric name, got {name!r}")
+        matchers: Dict[str, str] = {}
+        if self.peek()[1] == "{":
+            self.next()
+            while self.peek()[1] != "}":
+                lkind, label = self.next()
+                if lkind != "id":
+                    raise PromQLError(f"expected label, got {label!r}")
+                self.expect("=")
+                skind, raw = self.next()
+                if skind != "str":
+                    raise PromQLError(f"expected string, got {raw!r}")
+                matchers[label] = raw[1:-1].replace(r"\"", '"')
+                if self.peek()[1] == ",":
+                    self.next()
+            self.expect("}")
+        range_s: Optional[float] = None
+        if self.peek()[1] == "[":
+            self.next()
+            dkind, dur = self.next()
+            if dkind not in ("dur", "num"):
+                raise PromQLError(f"expected duration, got {dur!r}")
+            range_s = parse_duration(dur)
+            self.expect("]")
+        return _Selector(name, matchers, range_s)
+
+    def expect_eq(self) -> None:  # pragma: no cover - grammar helper
+        self.expect("=")
+
+
+def parse_duration(text: str) -> float:
+    if text and text[-1] in _DUR_UNITS:
+        return float(text[:-1]) * _DUR_UNITS[text[-1]]
+    return float(text)
+
+
+def parse_expr(expr: str) -> _Node:
+    return _Parser(_tokenize(expr)).parse()
+
+
+# -- rules -------------------------------------------------------------------
+
+class Rule:
+    """One alert rule with the real evaluator's state machine: the expr
+    returns a non-empty vector → pending; pending held for ``for_s`` →
+    firing; empty result → inactive (no resolve hold)."""
+
+    def __init__(self, name: str, expr: str, for_s: float = 0.0,
+                 severity: str = "") -> None:
+        self.name = name
+        self.expr_text = expr
+        self.expr = parse_expr(expr)
+        self.for_s = for_s
+        self.severity = severity
+        self.state = "inactive"
+        self.pending_since: Optional[float] = None
+        self.first_firing_t: Optional[float] = None
+        self.transitions: List[Tuple[float, str]] = []
+
+    def evaluate(self, store: SampleStore, t: float,
+                 time_scale: float = 1.0) -> str:
+        result = self.expr.eval(store, t, time_scale)
+        active = (bool(result) if isinstance(result, list)
+                  else bool(result))
+        hold = self.for_s / time_scale
+        if not active:
+            new_state = "inactive"
+            self.pending_since = None
+        else:
+            if self.pending_since is None:
+                self.pending_since = t
+            new_state = ("firing" if t - self.pending_since >= hold
+                         else "pending")
+        if new_state != self.state:
+            self.transitions.append((t, new_state))
+            if new_state == "firing" and self.first_firing_t is None:
+                self.first_firing_t = t
+        self.state = new_state
+        return new_state
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "severity": self.severity,
+            "fired": self.first_firing_t is not None,
+            "first_firing_t": self.first_firing_t,
+            "transitions": [[round(t, 3), s] for t, s in self.transitions],
+        }
+
+
+def load_rules(alerts_path) -> List[Rule]:
+    """Parse ``ops/alerts.yml`` into :class:`Rule` objects. Every expression
+    must be inside the supported grammar — a PromQLError here means the rule
+    file drifted outside what the soak harness can live-test."""
+    import yaml
+
+    doc = yaml.safe_load(open(alerts_path, "r", encoding="utf-8"))
+    rules = []
+    for group in (doc or {}).get("groups", []):
+        for rule in group.get("rules", []):
+            if "alert" not in rule:
+                continue
+            rules.append(Rule(
+                rule["alert"], str(rule["expr"]),
+                for_s=parse_duration(str(rule.get("for", "0s"))),
+                severity=(rule.get("labels") or {}).get("severity", "")))
+    return rules
+
+
+class RuleEvaluator:
+    """Evaluate every rule on each scrape tick; collect the firing story."""
+
+    def __init__(self, rules: List[Rule], time_scale: float = 1.0) -> None:
+        self.rules = rules
+        self.time_scale = max(1e-9, float(time_scale))
+
+    def tick(self, store: SampleStore, t: float) -> Dict[str, str]:
+        return {rule.name: rule.evaluate(store, t, self.time_scale)
+                for rule in self.rules}
+
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        return {rule.name: rule.report() for rule in self.rules}
+
+    def fired(self) -> List[str]:
+        return [rule.name for rule in self.rules
+                if rule.first_firing_t is not None]

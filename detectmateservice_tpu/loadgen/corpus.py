@@ -1,0 +1,163 @@
+"""The one synthetic-payload source for load generation and benchmarks.
+
+Extracted from ``examples/gen_audit_log.py`` (which is now a thin wrapper)
+so the load generator, the demo/example scripts, and the differential
+fuzzers all draw the same traffic: Linux-audit-style SYSCALL records whose
+normal population cycles a small set of processes/uids and whose anomalies
+are rare never-seen executables.
+
+On top of the plain audit rows the corpus produces the two edge shapes the
+parser's permissive ingest path has to survive in production:
+
+* **JSON reroute rows** — what stock fluentd's ``<format> @type json``
+  emits for a tailed source: ``{"message": <line>, "logSource": ...,
+  "hostname": ...}`` as raw JSON bytes (NOT a LogSchema protobuf). These
+  ride the parser's ``accept_raw_lines`` envelope detection and, on the
+  native kernel, the flagged-row batched fallback.
+* **invalid-UTF-8 rows** — raw byte lines with undecodable bytes spliced
+  into the variable section (protobuf string fields cannot carry them, so
+  they are necessarily raw-line traffic). The parser decodes them with
+  ``errors="replace"``; the native kernels must flag, not crash.
+
+``PayloadMix`` weights the four row kinds; :func:`payload_bytes` is the
+per-row entry the open-loop generator cycles.
+"""
+from __future__ import annotations
+
+import json
+import random
+from typing import Iterator, List, Tuple
+
+NORMAL_COMMS = [
+    ("cron", "/usr/sbin/cron", 0),
+    ("sshd", "/usr/sbin/sshd", 0),
+    ("systemd", "/lib/systemd/systemd", 0),
+    ("bash", "/bin/bash", 1000),
+    ("python3", "/usr/bin/python3", 1000),
+]
+ANOMALOUS_COMMS = [
+    ("nc", "/tmp/.hidden/nc", 1000),
+    ("xmrig", "/dev/shm/xmrig", 33),
+    ("sh", "/var/www/uploads/sh", 33),
+]
+
+# the audit record header every corpus row carries — matches the
+# ``type=<Type> msg=audit(<Time>): <Content>`` log_format the example
+# parser configs ship, so every generated row parses into a ParserSchema
+# (a row the parser would silently filter cannot take part in the load
+# generator's loss accounting)
+_HEADER = "type=SYSCALL msg=audit({ts}.{ms:03d}:{serial}): "
+
+
+def make_line(i: int, rng: random.Random, anomaly: bool) -> str:
+    """One plain audit line (the historical ``gen_audit_log.make_line``)."""
+    comm, exe, uid = rng.choice(ANOMALOUS_COMMS if anomaly else NORMAL_COMMS)
+    ts = 1_753_800_000 + i
+    serial = 9000 + i
+    syscall = rng.choice([59, 42, 2]) if not anomaly else 59
+    return (
+        _HEADER.format(ts=ts, ms=i % 1000, serial=serial)
+        + f'arch=c000003e syscall={syscall} success=yes exit=0 '
+        f'pid={rng.randint(300, 9000)} '
+        f'uid={uid} comm="{comm}" exe="{exe}"'
+    )
+
+
+def make_json_line(i: int, rng: random.Random) -> bytes:
+    """A fluentd ``@type json`` envelope carrying a normal audit line as raw
+    JSON bytes — the reroute traffic that exercises the parser's permissive
+    (non-protobuf) ingest path end to end."""
+    return json.dumps({
+        "message": make_line(i, rng, anomaly=False),
+        "logSource": "fluentd.audit",
+        "hostname": f"host{i % 4}",
+    }).encode("utf-8") + b"\n"
+
+
+def make_invalid_utf8_line(i: int, rng: random.Random) -> bytes:
+    """A raw audit byte line whose comm field carries undecodable bytes
+    (0xC0/0xFE can open no valid UTF-8 sequence). The header section stays
+    clean so the row still parses after ``errors='replace'`` decoding."""
+    clean = make_line(i, rng, anomaly=False).encode("utf-8")
+    # splice the invalid bytes into the quoted comm value, past the header
+    return clean.replace(b'comm="', b'comm="\xc0\xfe', 1)
+
+
+class PayloadMix:
+    """Weights for the four corpus row kinds; normalized at construction.
+
+    ``audit`` is the plain-traffic remainder — callers usually set only the
+    edge fractions (``anomaly``, ``json``, ``invalid_utf8``).
+    """
+
+    __slots__ = ("audit", "anomaly", "json", "invalid_utf8")
+
+    def __init__(self, audit: float = 0.0, anomaly: float = 0.005,
+                 json: float = 0.01, invalid_utf8: float = 0.005) -> None:
+        if min(anomaly, json, invalid_utf8) < 0:
+            raise ValueError("mix fractions must be >= 0")
+        edges = anomaly + json + invalid_utf8
+        if edges > 1.0:
+            raise ValueError("mix fractions sum past 1.0")
+        self.audit = audit if audit > 0 else 1.0 - edges
+        self.anomaly = anomaly
+        self.json = json
+        self.invalid_utf8 = invalid_utf8
+
+    def to_dict(self) -> dict:
+        return {"audit": self.audit, "anomaly": self.anomaly,
+                "json": self.json, "invalid_utf8": self.invalid_utf8}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PayloadMix":
+        allowed = {"audit", "anomaly", "json", "invalid_utf8"}
+        unknown = set(data) - allowed
+        if unknown:
+            raise ValueError(f"unknown mix keys: {sorted(unknown)}")
+        return cls(**{k: float(v) for k, v in data.items()})
+
+
+def payload_bytes(i: int, rng: random.Random, mix: PayloadMix) -> bytes:
+    """Row ``i`` of the corpus under ``mix``: serialized LogSchema for the
+    protobuf kinds, raw bytes for the edge kinds — exactly the shapes a
+    production ingress mixes. Import of the schema layer is deferred so the
+    pure-line users (the example generator) stay dependency-free."""
+    roll = rng.random()
+    if roll < mix.json:
+        return make_json_line(i, rng)
+    roll -= mix.json
+    if roll < mix.invalid_utf8:
+        return make_invalid_utf8_line(i, rng)
+    roll -= mix.invalid_utf8
+    anomaly = roll < mix.anomaly
+    from ..schemas import LogSchema
+
+    return LogSchema(logID=str(i), log=make_line(i, rng, anomaly),
+                     logSource="loadgen").serialize()
+
+
+def generate(n: int, anomaly_rate: float = 0.005,
+             seed: int = 7) -> Iterator[Tuple[str, bool]]:
+    """The historical ``gen_audit_log.generate``: plain audit lines with
+    anomalies held past the training prefix (the scorer example trains on
+    the first 512 messages, so any stream long enough for that path keeps
+    its anomalies past index 640)."""
+    rng = random.Random(seed)
+    guard = max(640, n // 10) if n > 640 else max(64, n // 10)
+    for i in range(n):
+        anomaly = i > guard and rng.random() < anomaly_rate
+        yield make_line(i, rng, anomaly), anomaly
+
+
+def training_preamble(n: int, seed: int = 11) -> List[bytes]:
+    """Serialized LogSchema rows for warming a scorer pipeline before a
+    measured load phase (all-normal traffic, no edge rows — the threshold
+    calibration must not see the anomaly population)."""
+    from ..schemas import LogSchema
+
+    rng = random.Random(seed)
+    return [
+        LogSchema(logID=f"warm-{i}", log=make_line(i, rng, anomaly=False),
+                  logSource="loadgen-warm").serialize()
+        for i in range(n)
+    ]

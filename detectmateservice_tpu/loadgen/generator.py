@@ -1,0 +1,381 @@
+"""Open-loop load generator: wall-clock-scheduled arrivals, traced frames.
+
+**Open loop** means the arrival schedule is fixed at start — burst ``i`` is
+due at ``t0 + i * burst / rate`` — and a slow send path never pushes later
+arrivals back. A closed-loop driver (send, wait, send) silently absorbs
+pipeline backpressure into its own pacing, which is exactly the
+coordinated-omission bug that made three of five bench rounds report no
+usable latency picture. Here, when the sender falls behind it sends
+immediately (no sleep) and the *scheduled* time — not send-completion — is
+stamped into the frame's v2 trace block as ``ingest_ns``, so the backlog
+wait the client would have experienced counts against e2e latency.
+
+Topology: the generator plays the reader role of PAPER.md §0's pipeline —
+it dials the first stage's engine ingress and emits LogSchema/raw-line
+frames from :mod:`corpus`; the collector listens where the terminal stage
+dials and closes the loop on trace ids (:mod:`scorecard`).
+
+``LOADGEN`` is the process-wide manager behind ``POST/GET /admin/load``:
+one run at a time (HTTP 409 while one is active), last run's scorecard kept
+for post-mortem reads.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ..engine import metrics as m
+from ..engine.framing import (
+    MAGIC_SHM,
+    TraceContext,
+    pack_batch,
+    unpack_batch,
+    unwrap_trace,
+    wrap_trace,
+)
+from ..engine.socket import TransportError, TransportTimeout, make_socket_factory
+from .corpus import PayloadMix, payload_bytes, training_preamble
+from .scorecard import Scorecard
+
+
+class LoadBusyError(RuntimeError):
+    """A load run is already active in this process (HTTP 409)."""
+
+
+class LoadIdleError(RuntimeError):
+    """No load run is active to stop (HTTP 409)."""
+
+
+class OpenLoopSchedule:
+    """The arrival schedule, shared by the load generator and ``bench.py``'s
+    open-loop phase: burst ``i`` is due at ``t0 + i * interval`` on the
+    injected monotonic clock, immutably — the whole point is that nothing a
+    slow consumer does can move a deadline."""
+
+    def __init__(self, rate_lines_per_s: float, burst: int,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if rate_lines_per_s <= 0:
+            raise ValueError("rate must be > 0 lines/s")
+        self.burst = max(1, int(burst))
+        self.rate = float(rate_lines_per_s)
+        self.interval_s = self.burst / self.rate
+        self.clock = clock
+        self.t0 = clock()
+
+    def deadline(self, i: int) -> float:
+        return self.t0 + i * self.interval_s
+
+    def lag_s(self, i: int) -> float:
+        """How far behind schedule burst ``i`` is right now (<= 0: early)."""
+        return self.clock() - self.deadline(i)
+
+
+@dataclass
+class LoadProfile:
+    """One load run's knobs (the ``POST /admin/load`` body)."""
+
+    target_addr: str
+    listen_addr: Optional[str] = None
+    rate: float = 2000.0            # offered lines/s
+    burst: int = 256                # lines per traced wire frame
+    seconds: float = 30.0           # 0 = run until stopped
+    mix: PayloadMix = field(default_factory=PayloadMix)
+    seed: int = 7
+    settle_s: float = 5.0           # post-send drain window before loss counts
+    warm_lines: int = 0             # untraced preamble (scorer training)
+
+    @classmethod
+    def from_payload(cls, payload: Dict[str, Any]) -> "LoadProfile":
+        data = dict(payload or {})
+        data.pop("action", None)
+        target = data.pop("target_addr", None)
+        if not target:
+            raise ValueError("target_addr is required")
+        mix = data.pop("mix", None)
+        known = {f for f in cls.__dataclass_fields__ if f != "target_addr"}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown load profile keys: {sorted(unknown)}")
+        profile = cls(target_addr=str(target), **data)
+        if mix is not None:
+            profile.mix = PayloadMix.from_dict(mix)
+        if profile.rate <= 0:
+            raise ValueError("rate must be > 0")
+        if profile.burst < 1:
+            raise ValueError("burst must be >= 1")
+        return profile
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "target_addr": self.target_addr, "listen_addr": self.listen_addr,
+            "rate": self.rate, "burst": self.burst, "seconds": self.seconds,
+            "mix": self.mix.to_dict(), "seed": self.seed,
+            "settle_s": self.settle_s, "warm_lines": self.warm_lines,
+        }
+
+
+class LoadGenerator:
+    """One open-loop run: a sender thread (and, with ``listen_addr``, a
+    collector thread) around a shared :class:`Scorecard`.
+
+    ``clock``/``sleep`` are injectable for the coordinated-omission tests;
+    the wall anchor maps monotonic deadlines onto ``time.time_ns`` epoch
+    stamps comparable with the pipeline's hop records.
+    """
+
+    def __init__(self, profile: LoadProfile,
+                 labels: Optional[Dict[str, str]] = None,
+                 socket_factory=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 logger: Optional[logging.Logger] = None) -> None:
+        self.profile = profile
+        self.logger = logger or logging.getLogger("loadgen")
+        self._factory = socket_factory or make_socket_factory("auto",
+                                                              self.logger)
+        self._clock = clock
+        self._sleep = sleep
+        self._stop = threading.Event()
+        # chaos seam (scripts/soak.py slow_sink): while set, the collector
+        # stops draining its socket — the downstream peer going slow/dead,
+        # from the pipeline's point of view
+        self.collector_pause = threading.Event()
+        self._sender: Optional[threading.Thread] = None
+        self._collector: Optional[threading.Thread] = None
+        self._send_sock = None
+        self._recv_sock = None
+        self._started_mono: Optional[float] = None
+        self._finished = threading.Event()
+        self.scorecard = Scorecard(offered_lines_per_s=profile.rate)
+        labels = dict(labels or {"component_type": "loadgen",
+                                 "component_id": "loadgen"})
+        # label children resolved once — the sender loop runs per frame
+        self._m_sent_frames = m.LOADGEN_SENT_FRAMES().labels(**labels)
+        self._m_sent_lines = m.LOADGEN_SENT_LINES().labels(**labels)
+        self._m_recv_frames = m.LOADGEN_RECEIVED_FRAMES().labels(**labels)
+        self._m_recv_lines = m.LOADGEN_RECEIVED_LINES().labels(**labels)
+        self._m_lost = m.LOADGEN_LOST_TRACES().labels(**labels)
+        self._m_e2e = m.LOADGEN_E2E_LATENCY().labels(**labels)
+        self._m_offered = m.LOADGEN_OFFERED_RATE().labels(**labels)
+        self._m_lag = m.LOADGEN_SEND_LAG().labels(**labels)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self._sender is not None:
+            raise LoadBusyError("load generator already started")
+        if self.profile.listen_addr:
+            # listener first: the terminal stage may already be dialing
+            self._recv_sock = self._factory.create(self.profile.listen_addr,
+                                                   self.logger)
+            self._recv_sock.recv_timeout = 100
+            self._collector = threading.Thread(
+                target=self._collector_loop, name="loadgen-collector",
+                daemon=True)
+            self._collector.start()
+        self._send_sock = self._factory.create_output(
+            self.profile.target_addr, self.logger)
+        self._started_mono = self._clock()
+        self._m_offered.set(self.profile.rate)
+        self._sender = threading.Thread(
+            target=self._sender_loop, name="loadgen-sender", daemon=True)
+        self._sender.start()
+
+    def stop(self, timeout: float = 10.0) -> Dict[str, Any]:
+        self._stop.set()
+        for thread in (self._sender, self._collector):
+            if thread is not None:
+                thread.join(timeout=timeout)
+        for sock in (self._send_sock, self._recv_sock):
+            if sock is not None:
+                try:
+                    sock.close()
+                except TransportError:
+                    pass
+        self._send_sock = self._recv_sock = None
+        self._m_offered.set(0.0)
+        self._m_lag.set(0.0)
+        return self.status()
+
+    @property
+    def running(self) -> bool:
+        return self._sender is not None and not self._finished.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the sender finished its schedule + settle window."""
+        return self._finished.wait(timeout)
+
+    def status(self) -> Dict[str, Any]:
+        elapsed = (self._clock() - self._started_mono
+                   if self._started_mono is not None else 0.0)
+        return {
+            "running": self.running,
+            "elapsed_s": round(max(0.0, elapsed), 3),
+            "profile": self.profile.to_dict(),
+            "scorecard": self.scorecard.snapshot(),
+        }
+
+    # -- sender ----------------------------------------------------------
+    def _sender_loop(self) -> None:
+        profile = self.profile
+        try:
+            if profile.warm_lines > 0:
+                self._send_warmup(profile.warm_lines)
+            sched = OpenLoopSchedule(profile.rate, profile.burst,
+                                     clock=self._clock)
+            # anchor: monotonic deadline -> epoch ns, one pair of clock
+            # reads for the whole run (the schedule is immutable)
+            wall_anchor_ns = time.time_ns()
+            mono_anchor = self._clock()
+            rng = random.Random(profile.seed)
+            total_bursts = (int(profile.seconds * profile.rate
+                                / profile.burst)
+                            if profile.seconds > 0 else None)
+            i = 0
+            row = 0
+            while not self._stop.is_set():
+                if total_bursts is not None and i >= total_bursts:
+                    break
+                deadline = sched.deadline(i)
+                now = self._clock()
+                if now < deadline:
+                    self._sleep(min(deadline - now, 0.05))
+                    continue
+                # behind or on time: send NOW, stamped with the SCHEDULED
+                # time — the open-loop contract (no coordinated omission)
+                payloads = [payload_bytes(row + k, rng, profile.mix)
+                            for k in range(profile.burst)]
+                row += profile.burst
+                sched_ns = wall_anchor_ns + int(
+                    (deadline - mono_anchor) * 1e9)
+                ctx = TraceContext.new(sched_ns)
+                wire = pack_batch(payloads)
+                lag = max(0.0, now - deadline)
+                try:
+                    self._send_sock.send(wrap_trace(wire, ctx))
+                except TransportError as exc:
+                    self.logger.warning("loadgen send failed: %s", exc)
+                    # the frame never left: it is client-visible loss and
+                    # stays in the outstanding table
+                self.scorecard.record_sent(ctx.trace_id, sched_ns,
+                                           profile.burst, lag_s=lag)
+                self._m_sent_frames.inc()
+                self._m_sent_lines.inc(profile.burst)
+                self._m_lag.set(lag)
+                i += 1
+            # settle: give in-flight frames their drain window before the
+            # outstanding table is read as loss
+            settle_end = self._clock() + max(0.0, profile.settle_s)
+            while (self._clock() < settle_end and not self._stop.is_set()
+                   and self.scorecard.outstanding > 0):
+                self._sleep(0.05)
+            self._m_lost.inc(self.scorecard.outstanding)
+        except Exception as exc:  # a dead generator must not die silently
+            self.logger.error("loadgen sender crashed: %s", exc)
+        finally:
+            self._finished.set()
+
+    def _send_warmup(self, n: int) -> None:
+        """Untraced all-normal preamble (scorer training traffic). Not part
+        of the scorecard: frames the pipeline emits for it arrive at the
+        collector with pipeline-originated trace ids and are counted
+        ``unmatched_frames``."""
+        rows = training_preamble(n, seed=self.profile.seed + 1)
+        burst = self.profile.burst
+        for start in range(0, len(rows), burst):
+            if self._stop.is_set():
+                return
+            try:
+                self._send_sock.send(pack_batch(rows[start:start + burst]))
+            except TransportError as exc:
+                self.logger.warning("loadgen warmup send failed: %s", exc)
+
+    # -- collector -------------------------------------------------------
+    def _collector_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.collector_pause.is_set():
+                self._sleep(0.05)
+                continue
+            try:
+                raw = self._recv_sock.recv()
+            except TransportTimeout:
+                continue
+            except TransportError:
+                if self._stop.is_set():
+                    return
+                self._sleep(0.05)
+                continue
+            if not raw:
+                continue
+            if raw.startswith(MAGIC_SHM):
+                # a shm reference cannot be resolved outside the sending
+                # process tree; the soak topology keeps the final hop plain
+                self.logger.warning("collector received a shm reference "
+                                    "frame it cannot resolve; dropped")
+                continue
+            ctx = None
+            try:
+                payload, ctx, _damaged = unwrap_trace(raw)
+            except Exception:
+                payload = raw
+            try:
+                msgs = unpack_batch(payload)
+            except Exception:
+                msgs = None
+            lines = len(msgs) if msgs is not None else 1
+            e2e = self.scorecard.record_received(
+                ctx.trace_id if ctx is not None else None,
+                time.time_ns(), lines)
+            self._m_recv_frames.inc()
+            self._m_recv_lines.inc(lines)
+            if e2e is not None:
+                self._m_e2e.observe(e2e)
+
+
+class LoadManager:
+    """Process-wide run registry behind the admin plane: one active run,
+    the last finished run's status kept for ``GET /admin/load`` after."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._active: Optional[LoadGenerator] = None
+        self._last: Optional[Dict[str, Any]] = None
+
+    def start(self, profile: LoadProfile,
+              labels: Optional[Dict[str, str]] = None,
+              socket_factory=None) -> Dict[str, Any]:
+        with self._lock:
+            if self._active is not None and self._active.running:
+                raise LoadBusyError(
+                    "a load run is already active; stop it first "
+                    "(POST /admin/load {\"action\": \"stop\"})")
+            if self._active is not None:
+                # finished but never explicitly stopped: reap it
+                self._last = self._active.stop()
+            generator = LoadGenerator(profile, labels=labels,
+                                      socket_factory=socket_factory)
+            generator.start()
+            self._active = generator
+            return generator.status()
+
+    def stop(self) -> Dict[str, Any]:
+        with self._lock:
+            if self._active is None:
+                raise LoadIdleError("no load run is active")
+            self._last = self._active.stop()
+            self._active = None
+            return self._last
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            if self._active is not None:
+                return self._active.status()
+            if self._last is not None:
+                return dict(self._last, running=False)
+            return {"running": False, "detail": "no load run yet"}
+
+
+LOADGEN = LoadManager()

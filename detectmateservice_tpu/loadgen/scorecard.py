@@ -1,0 +1,191 @@
+"""Client-side SLO scorecard for open-loop load runs.
+
+The pipeline's own ``pipeline_e2e_latency_seconds`` is observed by the
+terminal stage — it cannot see the ingress hop into the first stage or the
+egress hop to the consumer. The scorecard is the *external* view: the load
+generator records every traced frame it schedules, the collector records
+every traced frame that reaches the sink, and the difference is exactly the
+client-observed truth:
+
+* **e2e latency** — collector receive wall-time minus the frame's
+  *scheduled* arrival time (the v2 trace block's ``ingest_ns``, stamped by
+  the generator at schedule time, not send-completion time — so a backlogged
+  sender's queueing delay counts against latency instead of being silently
+  omitted: the coordinated-omission guard);
+* **loss** — trace ids sent but never received (after the pipeline had its
+  settle window);
+* **goodput** — achieved receive rate vs the offered (configured) rate.
+
+Latencies land in a log-bucketed histogram (powers of two from 0.25 ms)
+mirroring the prometheus histogram convention so client-side and internal
+percentiles compare bucket-for-bucket.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+# log2-spaced upper bounds, 0.25 ms .. ~2 min, +inf terminal — wide enough
+# that a soak surviving a full engine-loop stall still buckets its tail
+LATENCY_BUCKETS_S = tuple(0.00025 * (2 ** i) for i in range(20))
+
+
+class LatencyHistogram:
+    """Minimal log-bucketed histogram with prometheus-style cumulative
+    quantile readout. Not a prometheus collector on purpose: scorecards are
+    per-run objects (created and thrown away per load run), while collectors
+    are process-immortal — the run's numbers also feed the process-wide
+    ``loadgen_e2e_latency_seconds`` series via the generator."""
+
+    def __init__(self, buckets=LATENCY_BUCKETS_S) -> None:
+        self._le = tuple(buckets)
+        self._counts = [0] * (len(self._le) + 1)  # +inf terminal
+        self._sum = 0.0
+        self._max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(0.0, float(seconds))
+        self._sum += seconds
+        if seconds > self._max:
+            self._max = seconds
+        for i, le in enumerate(self._le):
+            if seconds <= le:
+                self._counts[i] += 1
+                return
+        self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return sum(self._counts)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper bucket bound holding quantile ``q`` (None when empty);
+        +inf tail reports the observed max instead of infinity."""
+        total = self.count
+        if total == 0:
+            return None
+        rank = q * total
+        seen = 0
+        for i, le in enumerate(self._le):
+            seen += self._counts[i]
+            if seen >= rank:
+                return le
+        return self._max
+
+    def to_dict(self) -> Dict[str, Any]:
+        buckets = {f"{le:g}": c for le, c in zip(self._le, self._counts)
+                   if c}
+        if self._counts[-1]:
+            buckets["+Inf"] = self._counts[-1]
+        out: Dict[str, Any] = {
+            "count": self.count,
+            "sum_s": round(self._sum, 6),
+            "max_ms": round(self._max * 1000.0, 3),
+            "buckets_le_s": buckets,
+        }
+        for q in (0.5, 0.9, 0.99):
+            v = self.quantile(q)
+            out[f"p{int(q * 100)}_ms"] = (round(v * 1000.0, 3)
+                                          if v is not None else None)
+        return out
+
+
+class Scorecard:
+    """Thread-safe sent/received ledger keyed on v2 trace ids.
+
+    The sender thread calls :meth:`record_sent`; the collector thread calls
+    :meth:`record_received`. ``snapshot()`` is safe from any thread (the
+    admin plane serves it live behind ``GET /admin/load``).
+    """
+
+    def __init__(self, offered_lines_per_s: float = 0.0) -> None:
+        self._lock = threading.Lock()
+        self.offered_lines_per_s = float(offered_lines_per_s)
+        self._outstanding: Dict[int, tuple] = {}  # trace_id -> (sched_ns, lines)
+        self._hist = LatencyHistogram()
+        self._sent_frames = 0
+        self._sent_lines = 0
+        self._recv_frames = 0
+        self._recv_lines = 0
+        self._matched_lines = 0      # lines arriving under a sent trace id
+        self._unmatched_frames = 0   # received with no/unknown trace id
+        self._send_lag_s = 0.0       # scheduler behind-ness, last observed
+        self._send_lag_max_s = 0.0
+        self._first_sched_ns: Optional[int] = None
+        self._last_recv_ns: Optional[int] = None
+
+    # -- sender side -----------------------------------------------------
+    def record_sent(self, trace_id: int, sched_ns: int, lines: int,
+                    lag_s: float = 0.0) -> None:
+        with self._lock:
+            self._outstanding[trace_id] = (sched_ns, lines)
+            self._sent_frames += 1
+            self._sent_lines += lines
+            self._send_lag_s = max(0.0, lag_s)
+            if lag_s > self._send_lag_max_s:
+                self._send_lag_max_s = lag_s
+            if self._first_sched_ns is None or sched_ns < self._first_sched_ns:
+                self._first_sched_ns = sched_ns
+
+    # -- collector side --------------------------------------------------
+    def record_received(self, trace_id: Optional[int], recv_ns: int,
+                        lines: int) -> Optional[float]:
+        """Returns the client-observed e2e seconds when the frame matched a
+        sent trace id (None for untraced/unknown frames — e.g. the warm-up
+        preamble, which the pipeline traces itself)."""
+        with self._lock:
+            self._recv_frames += 1
+            self._recv_lines += lines
+            self._last_recv_ns = recv_ns
+            entry = (self._outstanding.pop(trace_id, None)
+                     if trace_id is not None else None)
+            if entry is None:
+                self._unmatched_frames += 1
+                return None
+            self._matched_lines += lines
+            e2e = max(0, recv_ns - entry[0]) / 1e9
+            self._hist.observe(e2e)
+            return e2e
+
+    # -- readout ---------------------------------------------------------
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._outstanding)
+
+    def missing_trace_ids(self, limit: int = 32) -> List[str]:
+        with self._lock:
+            ids = sorted(self._outstanding)[:max(0, limit)]
+        return [f"{t:016x}" for t in ids]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lost = len(self._outstanding)
+            elapsed_s = 0.0
+            if self._first_sched_ns is not None and self._last_recv_ns:
+                elapsed_s = max(0.0, (self._last_recv_ns
+                                      - self._first_sched_ns) / 1e9)
+            # achieved goodput counts only lines that arrived under a sent
+            # trace id — stragglers of earlier traffic (e.g. a warm-up
+            # preamble draining) must not inflate this run's rate
+            achieved = (self._matched_lines / elapsed_s) if elapsed_s > 0 \
+                else 0.0
+            offered = self.offered_lines_per_s
+            return {
+                "offered_lines_per_s": round(offered, 1),
+                "achieved_lines_per_s": round(achieved, 1),
+                "goodput_ratio": (round(achieved / offered, 4)
+                                  if offered > 0 else None),
+                "sent_frames": self._sent_frames,
+                "sent_lines": self._sent_lines,
+                "received_frames": self._recv_frames,
+                "received_lines": self._recv_lines,
+                "matched_lines": self._matched_lines,
+                "unmatched_frames": self._unmatched_frames,
+                "lost_traces": lost,
+                "loss": lost,  # the verdict key the soak gate reads
+                "send_lag_s": round(self._send_lag_s, 4),
+                "send_lag_max_s": round(self._send_lag_max_s, 4),
+                "elapsed_s": round(elapsed_s, 3),
+                "latency": self._hist.to_dict(),
+            }

@@ -183,6 +183,16 @@ class ServiceSettings(BaseModel):
     # writer with a downstream sink that is not a framework engine): it
     # finalizes instead of propagating, and its downstream sees plain v1.
     trace_terminal: Optional[bool] = None
+    # egress e2e observation for a FORWARDING stage: when true this stage
+    # observes pipeline_e2e_latency_seconds (and feeds its flight recorder)
+    # as each frame leaves, while STILL propagating the v2 trace downstream
+    # — unlike trace_terminal, which finalizes and strips. Set it on the
+    # last framework stage of a pipeline whose sink is an external consumer
+    # that keys on trace ids (e.g. the loadgen scorecard collector): the
+    # internal e2e then measures ingest→egress, and the collector's
+    # client-observed latency minus it is the ingress/egress blind spot
+    # (docs/walkthrough.md "read the client skew").
+    trace_observe_e2e: bool = False
     # flight recorder bounds (engine/tracing.py): N slowest traces kept,
     # ring of sampled traces, and the 1-in-K completed-trace sampling rate
     trace_slowest: int = Field(default=32, ge=1, le=1024)

@@ -121,6 +121,12 @@ def _xla(service, query, payload) -> Response:
     return Response(200, snapshot)
 
 
+def _load_status(service, query, payload) -> Response:
+    from ..loadgen.generator import LOADGEN
+
+    return Response(200, LOADGEN.status())
+
+
 def _profile_status(service, query, payload) -> Response:
     from ..utils.profiling import PROFILER
 
@@ -193,6 +199,33 @@ def _profile_start(service, query, payload) -> Response:
     return Response(200, info)
 
 
+def _load_control(service, query, payload) -> Response:
+    from ..loadgen.generator import (
+        LOADGEN,
+        LoadBusyError,
+        LoadIdleError,
+        LoadProfile,
+    )
+
+    payload = payload or {}
+    action = str(payload.get("action", "start"))
+    try:
+        if action == "stop":
+            return Response(200, LOADGEN.stop())
+        if action != "start":
+            raise ValueError(f"unknown action {action!r} "
+                             "(expected 'start' or 'stop')")
+        profile = LoadProfile.from_payload(payload)
+        labels = dict(
+            component_type=service.settings.component_type,
+            component_id=service.settings.component_id or "loadgen")
+        return Response(200, LOADGEN.start(profile, labels=labels))
+    except (LoadBusyError, LoadIdleError) as exc:
+        # one run per process; a second start (or a stop with nothing
+        # running) is a state conflict, same semantics as /admin/profile
+        return Response(409, {"detail": str(exc)})
+
+
 # one row per route; dmlint DM-C007/8 keeps this table and the route table
 # in docs/usage.md synchronized in both directions
 ROUTES: Tuple[Route, ...] = (
@@ -205,6 +238,8 @@ ROUTES: Tuple[Route, ...] = (
           "XLA compile ledger + device-batch spans"),
     Route("GET", "/admin/profile", _profile_status,
           "profiler capture status"),
+    Route("GET", "/admin/load", _load_status,
+          "live SLO scorecard of the open-loop load run"),
     Route("GET", "/admin/profile/latest", _profile_latest,
           "download the newest completed capture as a zip"),
     Route("POST", "/admin/start", _start, "start the engine"),
@@ -216,6 +251,8 @@ ROUTES: Tuple[Route, ...] = (
           "checkpoint component state"),
     Route("POST", "/admin/profile", _profile_start,
           "start an on-demand jax.profiler capture"),
+    Route("POST", "/admin/load", _load_control,
+          "start/stop an open-loop load run against a pipeline"),
 )
 
 

@@ -5,47 +5,28 @@ Stands in for the reference's bundled 2,316-line ``audit.log`` fixture
 domain (Linux audit records), synthetic content. Normal traffic cycles a
 small set of processes/uids; anomalies are rare records with never-seen
 executables.
+
+Thin wrapper: the corpus itself lives in
+``detectmateservice_tpu/loadgen/corpus.py`` so the open-loop load
+generator, the bench harness, and this example all draw one payload
+source; this script keeps the historical file-writing CLI.
 """
 from __future__ import annotations
 
 import argparse
-import random
+import sys
+from pathlib import Path
 
-NORMAL_COMMS = [
-    ("cron", "/usr/sbin/cron", 0),
-    ("sshd", "/usr/sbin/sshd", 0),
-    ("systemd", "/lib/systemd/systemd", 0),
-    ("bash", "/bin/bash", 1000),
-    ("python3", "/usr/bin/python3", 1000),
-]
-ANOMALOUS_COMMS = [
-    ("nc", "/tmp/.hidden/nc", 1000),
-    ("xmrig", "/dev/shm/xmrig", 33),
-    ("sh", "/var/www/uploads/sh", 33),
-]
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from detectmateservice_tpu.loadgen.corpus import (  # noqa: E402
+    ANOMALOUS_COMMS,
+    NORMAL_COMMS,
+    generate,
+    make_line,
+)
 
-def make_line(i: int, rng: random.Random, anomaly: bool) -> str:
-    comm, exe, uid = rng.choice(ANOMALOUS_COMMS if anomaly else NORMAL_COMMS)
-    ts = 1_753_800_000 + i
-    serial = 9000 + i
-    syscall = rng.choice([59, 42, 2]) if not anomaly else 59
-    return (
-        f"type=SYSCALL msg=audit({ts}.{i % 1000:03d}:{serial}): "
-        f'arch=c000003e syscall={syscall} success=yes exit=0 pid={rng.randint(300, 9000)} '
-        f'uid={uid} comm="{comm}" exe="{exe}"'
-    )
-
-
-def generate(n: int, anomaly_rate: float = 0.005, seed: int = 7):
-    rng = random.Random(seed)
-    # anomalies only after the training prefix would have been consumed —
-    # the scorer example trains on the first 512 messages, so any stream
-    # long enough for that path keeps its anomalies past index 640
-    guard = max(640, n // 10) if n > 640 else max(64, n // 10)
-    for i in range(n):
-        anomaly = i > guard and rng.random() < anomaly_rate
-        yield make_line(i, rng, anomaly), anomaly
+__all__ = ["NORMAL_COMMS", "ANOMALOUS_COMMS", "make_line", "generate"]
 
 
 def main() -> None:

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Syntax-validate the Prometheus artifacts with promtool — the layer the
+# cross-artifact lint (dmlint DM-C001..4) does NOT cover: dmlint checks
+# series names and coverage both directions, but only promtool parses the
+# PromQL grammar and the config schema itself. Skips gracefully when
+# promtool is not installed (the sandbox/laptop case); CI installs it and
+# runs this for real.
+set -eu
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+
+if ! command -v promtool >/dev/null 2>&1; then
+    echo "check_prom_rules: promtool not found; skipping (CI runs this" \
+         "with promtool installed)"
+    exit 0
+fi
+
+promtool check rules "$REPO/ops/alerts.yml"
+# prometheus.yml resolves rule_files relative to itself (alerts.yml sits
+# alongside), so check it from its own directory
+cd "$REPO/ops"
+promtool check config prometheus.yml
+echo "check_prom_rules: ops/alerts.yml + ops/prometheus.yml OK"

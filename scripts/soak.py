@@ -1,0 +1,472 @@
+#!/usr/bin/env python
+"""Chaos soak harness: open-loop load + fault injection + live alert rules.
+
+Boots the full PAPER.md §0 pipeline IN PROCESS — loadgen (the reader role)
+→ MatcherParser → JaxScorerDetector → OutputWriter → scorecard collector —
+over inproc sockets, drives it with wall-clock-scheduled open-loop traffic
+from the shared corpus (audit rows, JSON ``@type`` reroute, invalid UTF-8),
+scrapes ``/metrics`` once a second into a sample store, and evaluates the
+*actual* ``ops/alerts.yml`` expressions against it (loadgen/alerteval.py).
+Two phases, one ``SOAK_*.json`` verdict:
+
+1. **baseline** (the pre-fault window): client-visible ``loss == 0``,
+   achieved rate ≥ 95% of offered, a populated client-latency histogram —
+   the external view ``pipeline_e2e_latency_seconds`` cannot provide, and
+   with ``--scenario none`` additionally that NO alert rule fired;
+2. **chaos**: the scenario's fault is injected under continued load and
+   every rule it is expected to trip must actually transition to
+   ``firing`` — alert coverage tested by execution, not cross-referencing —
+   then the fault clears and the pipeline must be seen delivering again.
+
+The scorer runs with an explicit alert-all ``score_threshold`` so every row
+flows end to end (loss accounting is exact: a missing trace id is loss, not
+filtering); aggregation is 1:1 at the output stage for the same reason.
+
+Durations: a CI-sized run cannot hold a fault for a literal ``for: 1m`` on
+top of 5m rate windows, so ``--time-scale K`` divides every rule *duration*
+(holds and range windows) by K while leaving value thresholds untouched
+(loadgen/alerteval.py). ``docs/benchmarks.md`` documents the record schema.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# scenario -> (expected alerts, one-line story). dmlint DM-C009 keeps this
+# table and the docs/benchmarks.md soak-scenario table in sync.
+SCENARIOS = {
+    "none": ((), "no fault: the loss==0 / goodput / histogram baseline"),
+    "stall": (("EngineLoopStalled", "StageUnhealthy"),
+              "parser hot loop wedged mid-process for the fault window"),
+    "slow_sink": (("MessageDropRateHigh",),
+                  "collector stops draining; the output stage's bounded "
+                  "retries exhaust and drop"),
+    "recompile": (("RecompileStorm",),
+                  "post-warm-up dispatch compiles injected into the XLA "
+                  "ledger"),
+    "replica_kill": (("StageScrapeDown",),
+                     "detector replica stopped cold mid-stream, then "
+                     "restarted through the admin verb"),
+}
+
+AUDIT_LOG_FORMAT = "type=<Type> msg=audit(<Time>): <Content>"
+AUDIT_TEMPLATE = ("arch=<*> syscall=<*> success=<*> exit=<*> pid=<*> "
+                  "uid=<*> comm=<*> exe=<*>")
+
+
+def build_settings(tmp: Path, burst: int):
+    """The three service settings + component configs of the soak pipeline.
+    Frame sizes are kept uniform (engine_frame_batch == loadgen burst) so
+    wire frames map ~1:1 through every stage and the FIFO trace attachment
+    stays exact — the precondition for trace-id loss accounting."""
+    from detectmateservice_tpu.settings import ServiceSettings
+
+    common = dict(
+        http_port=0, log_to_file=False, log_to_console=False,
+        engine_trace=True, backend="cpu",
+        engine_batch_size=max(512, 2 * burst), engine_batch_timeout_ms=5.0,
+        engine_frame_batch=burst, engine_recv_timeout=50,
+    )
+    parser = ServiceSettings(
+        component_type="parsers.template_matcher.MatcherParser",
+        component_id="soak-parser", trace_stage="parser",
+        engine_addr="inproc://soak-parser",
+        out_addr=["inproc://soak-detector"], **common)
+    detector = ServiceSettings(
+        component_type="detectors.jax_scorer.JaxScorerDetector",
+        component_id="soak-detector", trace_stage="detector",
+        engine_addr="inproc://soak-detector",
+        out_addr=["inproc://soak-output"], **common)
+    output = ServiceSettings(
+        component_type="outputs.file_sink.OutputWriter",
+        component_id="soak-output", trace_stage="output",
+        engine_addr="inproc://soak-output",
+        out_addr=["inproc://soak-collector"],
+        # the collector is an external consumer keying on trace ids: this
+        # stage is the pipeline's internal completion point but must keep
+        # propagating the v2 trace — the egress-observe mode
+        trace_observe_e2e=True, **common)
+
+    templates = tmp / "soak_templates.txt"
+    templates.write_text(AUDIT_TEMPLATE + "\n", encoding="utf-8")
+    parser_cfg = {"parsers": {"MatcherParser": {
+        "method_type": "matcher_parser", "auto_config": False,
+        "log_format": AUDIT_LOG_FORMAT, "accept_raw_lines": True,
+        "params": {"path_templates": str(templates)},
+    }}}
+    detector_cfg = {"detectors": {"JaxScorerDetector": {
+        "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+        "data_use_training": 64, "train_epochs": 1, "min_train_steps": 8,
+        "seq_len": 8, "dim": 16, "max_batch": 2 * burst,
+        # pipeline_depth 0 = drain every dispatch before returning: outputs
+        # leave in the same engine iteration as their ingest, which is what
+        # keeps the FIFO trace attachment exact (a deferred output would
+        # leave on an idle drain tick with no pending context and the
+        # trace would finalize at the detector instead of the collector)
+        "async_fit": False, "pipeline_depth": 0,
+        # alert-all: every scored row emits, so the collector sees every
+        # line and a missing trace id can only mean loss
+        "score_threshold": -1e30,
+    }}}
+    output_cfg = {"outputs": {"OutputWriter": {
+        "method_type": "output_writer", "aggregate_count": 1,
+        "write_files": False, "emit_records": True,
+    }}}
+    return [(parser, parser_cfg), (detector, detector_cfg),
+            (output, output_cfg)]
+
+
+def boot_pipeline(tmp: Path, factory, burst: int):
+    from detectmateservice_tpu.core import Service
+
+    services = []
+    for settings, config in build_settings(tmp, burst):
+        service = Service(settings, component_config=config,
+                          socket_factory=factory)
+        service.setup_io()
+        service.web_server.start()
+        service.start()
+        services.append(service)
+    return services
+
+
+def teardown_pipeline(services) -> None:
+    for service in reversed(services):
+        for step in (service.stop, service.health.stop,
+                     service.web_server.stop):
+            try:
+                step()
+            except Exception:
+                pass
+
+
+class Scraper(threading.Thread):
+    """Once a second: one pass over the process-wide prometheus registry
+    into the sample store (every in-process stage shares the registry, so
+    one scrape covers the fleet) + a synthetic per-stage ``up`` series +
+    one rule-evaluator tick — the soak's stand-in for a Prometheus server
+    on its evaluation interval."""
+
+    def __init__(self, store, evaluator, services,
+                 interval_s: float = 1.0) -> None:
+        super().__init__(name="soak-scraper", daemon=True)
+        self._store = store
+        self._evaluator = evaluator
+        self._services = services
+        self._interval = interval_s
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        from prometheus_client import generate_latest
+
+        while not self._halt.is_set():
+            t = time.monotonic()
+            text = generate_latest().decode("utf-8", errors="replace")
+            self._store.ingest_exposition(text, t)
+            for service in self._services:
+                self._store.add("up", {
+                    "job": "detectmate",
+                    "instance": service.settings.component_id or "?",
+                }, t, 1.0 if service.engine.running else 0.0)
+            self._evaluator.tick(self._store, t)
+            self._halt.wait(self._interval)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5.0)
+
+
+# -- fault injectors ---------------------------------------------------------
+
+def install_stall(services, flag: threading.Event) -> None:
+    """Wedge the parser's hot loop while ``flag`` is set: its
+    component-level process_frames blocks exactly where a pathological
+    payload or a GIL-holding native call would wedge it. Instance-attribute
+    shadowing — the adapter resolves the component hook per call, so this
+    takes effect on the very next frame burst."""
+    parser = services[0].library_component
+    original = parser.process_frames
+
+    def stalled(frames):
+        while flag.is_set():
+            time.sleep(0.05)
+        return original(frames)
+
+    parser.process_frames = stalled
+
+
+def inject_recompiles(n: int = 4, spacing_s: float = 0.5) -> None:
+    """Feed post-warm-up dispatch-path compiles into the XLA ledger (the
+    same injection seam tests/test_device_obs.py uses): each one is what a
+    bucket miss costs — here without actually stalling the engine, so the
+    RecompileStorm rule is exercised in isolation."""
+    from detectmateservice_tpu.engine import device_obs
+
+    ledger = device_obs.get_ledger()
+    ledger.mark_warmup_complete()
+    for i in range(n):
+        ledger.record_compile(0.4, bucket=4096 + i, backend="cpu",
+                              where="dispatch", expected=False)
+        time.sleep(spacing_s)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS), default="none")
+    ap.add_argument("--seconds", type=float, default=60.0,
+                    help="baseline (pre-fault) load window (default 60)")
+    ap.add_argument("--fault-seconds", type=float, default=None,
+                    help="fault hold; default per scenario")
+    # defaults sized for a shared-GIL in-process pipeline on a small CI
+    # box: the scorer's per-dispatch cost dominates (~100 ms readback on
+    # XLA:CPU), so bigger-but-fewer frames buy headroom, and 1000 lines/s
+    # keeps utilization low enough that queueing stays out of the baseline
+    ap.add_argument("--rate", type=float, default=1000.0,
+                    help="offered lines/s (default 1000)")
+    ap.add_argument("--burst", type=int, default=500,
+                    help="lines per traced frame (default 500)")
+    ap.add_argument("--time-scale", type=float, default=None,
+                    help="divide alert-rule durations by this; default "
+                         "per scenario")
+    ap.add_argument("--settle", type=float, default=8.0,
+                    help="baseline drain window before loss is counted")
+    ap.add_argument("--mix", default="anomaly=0.005,json=0.01,"
+                                     "invalid_utf8=0.005")
+    ap.add_argument("--out-dir", default=str(REPO))
+    args = ap.parse_args()
+
+    # per-scenario fault/scale defaults: each fault must outlive its rule's
+    # (scaled) detection horizon — threshold crossing + for: hold
+    fault_defaults = {"none": 0.0, "stall": 45.0, "slow_sink": 45.0,
+                      "recompile": 8.0, "replica_kill": 30.0}
+    scale_defaults = {"none": 6.0, "stall": 6.0, "slow_sink": 12.0,
+                      "recompile": 6.0, "replica_kill": 12.0}
+    fault_s = (args.fault_seconds if args.fault_seconds is not None
+               else fault_defaults[args.scenario])
+    time_scale = (args.time_scale if args.time_scale is not None
+                  else scale_defaults[args.scenario])
+
+    import tempfile
+
+    from detectmateservice_tpu.engine.framing import pack_batch
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+    from detectmateservice_tpu.loadgen.alerteval import (
+        RuleEvaluator,
+        SampleStore,
+        load_rules,
+    )
+    from detectmateservice_tpu.loadgen.corpus import (
+        PayloadMix,
+        training_preamble,
+    )
+    from detectmateservice_tpu.loadgen.generator import (
+        LoadGenerator,
+        LoadProfile,
+    )
+
+    expected_alerts = list(SCENARIOS[args.scenario][0])
+    mix = PayloadMix.from_dict(
+        {k.strip(): float(v) for k, _, v in
+         (part.partition("=") for part in args.mix.split(",") if part)})
+
+    checks = []
+
+    def check(name: str, ok: bool, detail: str) -> bool:
+        checks.append({"name": name, "ok": bool(ok), "detail": str(detail)})
+        print(f"[soak] {'PASS' if ok else 'FAIL'} {name}: {detail}")
+        return ok
+
+    def new_generator(factory, seconds: float, settle: float):
+        profile = LoadProfile(
+            target_addr="inproc://soak-parser",
+            listen_addr="inproc://soak-collector",
+            rate=args.rate, burst=args.burst, seconds=seconds,
+            mix=mix, settle_s=settle)
+        return LoadGenerator(profile, labels=dict(
+            component_type="loadgen", component_id="soak-loadgen"),
+            socket_factory=factory)
+
+    # deep ingress/inter-stage queues: a stall scenario banks the whole
+    # fault window's arrivals and must drain them afterwards, not drop
+    # them. The collector link alone stays shallow so a paused collector
+    # (slow_sink) exhausts the output stage's bounded retries within the
+    # fault window — depth is fixed by whichever factory touches the
+    # address first (the registry is per-address).
+    factory = InprocQueueSocketFactory(maxsize=65536)
+    InprocQueueSocketFactory(maxsize=64)._pair("inproc://soak-collector")
+    store = SampleStore()
+    evaluator = RuleEvaluator(load_rules(REPO / "ops" / "alerts.yml"),
+                              time_scale=time_scale)
+    t_start_utc = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    t0 = time.monotonic()
+
+    record = {
+        "schema": "soak-v1",
+        "scenario": args.scenario,
+        "scenario_story": SCENARIOS[args.scenario][1],
+        "expected_alerts": expected_alerts,
+        "started_utc": t_start_utc,
+        "time_scale": time_scale,
+        "profile": {"rate_lines_per_s": args.rate, "burst": args.burst,
+                    "baseline_seconds": args.seconds,
+                    "fault_seconds": fault_s, "mix": mix.to_dict()},
+    }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        services = boot_pipeline(Path(tmp), factory, args.burst)
+        scraper = Scraper(store, evaluator, services)
+        generator = None
+        stall_flag = threading.Event()
+        try:
+            # warm: train + calibrate the scorer and pay every jit compile
+            # before the measured window; confirmation = the output stage
+            # writing lines (read off the shared in-process registry) AND
+            # the XLA compile ledger going quiet — the scorer keeps warming
+            # its host-twin buckets on a background thread after the warm
+            # traffic has drained, and on a small CPU box each of those
+            # compiles would stall the shared-GIL pipeline mid-measurement
+            # (a 1-2 s e2e spike per compile, enough to burn-rate-page a
+            # no-fault baseline)
+            from detectmateservice_tpu.engine import device_obs
+            from detectmateservice_tpu.engine import metrics as m
+
+            warm_rows = training_preamble(6 * args.burst)
+            ingress = factory.create_output("inproc://soak-parser")
+            for start in range(0, len(warm_rows), args.burst):
+                ingress.send(pack_batch(warm_rows[start:start + args.burst]))
+            out_labels = dict(
+                component_type=services[2].settings.component_type,
+                component_id="soak-output")
+            written = m.DATA_WRITTEN_LINES().labels(**out_labels)
+            ledger = device_obs.get_ledger()
+            deadline = time.monotonic() + 180
+            prev = -1.0
+            prev_compiles = -1
+            quiet_ticks = 0
+            while True:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("pipeline never warmed: no output-"
+                                       "stage writes within 180 s")
+                time.sleep(0.5)
+                now_written = written._value.get()
+                compiles = ledger.snapshot(limit=1)["totals"]["compiles"]
+                quiet_ticks = (quiet_ticks + 1
+                               if (now_written == prev
+                                   and compiles == prev_compiles) else 0)
+                # three quiet ticks: drained AND no compile for ~1.5 s
+                # (the host-bucket warm sequence spaces compiles well
+                # inside that)
+                if now_written > 0 and quiet_ticks >= 3:
+                    break
+                prev = now_written
+                prev_compiles = compiles
+            ingress.close()
+            print(f"[soak] pipeline warm ({written._value.get():.0f} lines "
+                  "through); starting baseline load")
+
+            scraper.start()
+
+            # -- phase 1: baseline (the pre-fault window) -----------------
+            generator = new_generator(factory, args.seconds, args.settle)
+            generator.start()
+            generator.wait(timeout=args.seconds + args.settle + 120)
+            baseline = generator.stop()
+            generator = None
+            card = baseline["scorecard"]
+            record["baseline"] = card
+            check("baseline_loss_zero", card["loss"] == 0,
+                  f"loss={card['loss']} of {card['sent_frames']} frames "
+                  f"({card['sent_lines']} lines)")
+            check("baseline_goodput",
+                  (card["goodput_ratio"] or 0) >= 0.95,
+                  f"achieved {card['achieved_lines_per_s']}/s of "
+                  f"{card['offered_lines_per_s']}/s offered "
+                  f"(ratio {card['goodput_ratio']})")
+            check("baseline_histogram_populated",
+                  card["latency"]["count"] > 0,
+                  f"{card['latency']['count']} client-observed samples, "
+                  f"p99={card['latency']['p99_ms']}ms")
+            baseline_fired = set(evaluator.fired())
+            if args.scenario == "none":
+                check("no_alert_fired", not baseline_fired,
+                      f"fired={sorted(baseline_fired)}")
+
+            # -- phase 2: chaos under continued load ----------------------
+            if args.scenario != "none":
+                print(f"[soak] injecting fault: {args.scenario} "
+                      f"({fault_s:.0f} s, time scale {time_scale:g})")
+                if args.scenario == "stall":
+                    install_stall(services, stall_flag)
+                lead_s, tail_s = 5.0, 20.0
+                generator = new_generator(
+                    factory, lead_s + fault_s + tail_s,
+                    settle=fault_s + 60.0)
+                generator.start()
+                time.sleep(lead_s)
+                fault_t0 = time.monotonic()
+                if args.scenario == "stall":
+                    stall_flag.set()
+                    time.sleep(fault_s)
+                    stall_flag.clear()
+                elif args.scenario == "slow_sink":
+                    generator.collector_pause.set()
+                    time.sleep(fault_s)
+                    generator.collector_pause.clear()
+                elif args.scenario == "recompile":
+                    inject_recompiles()
+                    time.sleep(max(0.0, fault_s - 2.0))
+                elif args.scenario == "replica_kill":
+                    services[1].stop()
+                    time.sleep(fault_s)
+                    services[1].start()
+                fault_held_s = time.monotonic() - fault_t0
+                generator.wait(timeout=lead_s + fault_s + tail_s
+                               + fault_s + 60.0 + 60.0)
+                chaos = generator.stop()
+                generator = None
+                record["chaos"] = chaos["scorecard"]
+                record["chaos"]["fault_held_s"] = round(fault_held_s, 1)
+                fired = set(evaluator.fired())
+                for alert in expected_alerts:
+                    check(f"alert_{alert}_fired", alert in fired,
+                          "transitioned to firing under the fault"
+                          if alert in fired else
+                          f"never fired (fired={sorted(fired)})")
+                check("recovered_after_fault",
+                      chaos["scorecard"]["received_frames"] > 0,
+                      f"received {chaos['scorecard']['received_frames']} "
+                      "frames across the chaos window")
+        finally:
+            if generator is not None:
+                try:
+                    generator.stop()
+                except Exception:
+                    pass
+            scraper.stop()
+            teardown_pipeline(services)
+
+    record["alerts"] = evaluator.report()
+    record["elapsed_s"] = round(time.monotonic() - t0, 1)
+    record["checks"] = checks
+    record["pass"] = all(c["ok"] for c in checks)
+
+    out = (Path(args.out_dir)
+           / f"SOAK_{args.scenario}_{time.strftime('%Y%m%d-%H%M%S')}.json")
+    out.write_text(json.dumps(record, indent=2) + "\n", encoding="utf-8")
+    print(f"[soak] verdict {'PASS' if record['pass'] else 'FAIL'} "
+          f"({record['elapsed_s']:.0f}s) -> {out}")
+    return 0 if record["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

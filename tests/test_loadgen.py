@@ -1,0 +1,513 @@
+"""Load generator, SLO scorecard, and alert-rule evaluator.
+
+Covers the ISSUE-8 surface: the open-loop scheduler's coordinated-omission
+guard (arrival stamps fixed by the schedule, never by a slow send path),
+trace-id loss accounting, the log-bucketed client-latency histogram,
+``/admin/load`` lifecycle (start / live scorecard / stop / 409 conflicts),
+the shared payload corpus's edge rows, the forwarding-stage
+``trace_observe_e2e`` mode, and the miniature PromQL evaluator that
+live-tests ``ops/alerts.yml`` — including the regression gate that every
+expression in the rule file stays inside the evaluator's grammar.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from detectmateservice_tpu.engine.framing import (
+    TraceContext,
+    pack_batch,
+    unpack_batch,
+    unwrap_trace,
+    wrap_trace,
+)
+from detectmateservice_tpu.loadgen import alerteval as ae
+from detectmateservice_tpu.loadgen import corpus
+from detectmateservice_tpu.loadgen.generator import (
+    LoadGenerator,
+    LoadProfile,
+    OpenLoopSchedule,
+)
+from detectmateservice_tpu.loadgen.scorecard import LatencyHistogram, Scorecard
+
+from conftest import wait_until
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class FakeClock:
+    """Injectable monotonic clock + sleep for deterministic scheduler tests
+    (sleep advances time; nothing ever blocks)."""
+
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.t += max(0.0, dt)
+
+
+class TestOpenLoopSchedule:
+    def test_deadlines_are_immutable(self):
+        clock = FakeClock()
+        sched = OpenLoopSchedule(100.0, 10, clock=clock)
+        d5 = sched.deadline(5)
+        clock.sleep(42.0)  # wall time passing must not move the schedule
+        assert sched.deadline(5) == d5
+        assert sched.deadline(6) - d5 == pytest.approx(sched.interval_s)
+
+    def test_lag_reflects_clock_not_sends(self):
+        clock = FakeClock()
+        sched = OpenLoopSchedule(100.0, 10, clock=clock)
+        assert sched.lag_s(0) == pytest.approx(0.0)
+        clock.sleep(1.0)
+        assert sched.lag_s(0) == pytest.approx(1.0)
+
+
+class _SlowSendSocket:
+    """Stub output socket whose send costs ``cost_s`` of fake time — the
+    deliberately slow send path of the coordinated-omission test."""
+
+    def __init__(self, clock: FakeClock, cost_s: float) -> None:
+        self.clock = clock
+        self.cost_s = cost_s
+        self.frames = []
+
+    def send(self, data, block=True):
+        self.clock.sleep(self.cost_s)
+        self.frames.append(data)
+
+    def close(self):
+        pass
+
+
+class _StubFactory:
+    def __init__(self, sock) -> None:
+        self.sock = sock
+
+    def create_output(self, addr, logger=None, **kw):
+        return self.sock
+
+    def create(self, addr, logger=None, **kw):  # pragma: no cover
+        raise AssertionError("no listener expected in this test")
+
+
+class TestCoordinatedOmissionGuard:
+    def test_slow_sends_never_shift_the_arrival_stamps(self):
+        """Send path costs 3x the arrival interval; the open-loop contract:
+        every burst still goes out, stamped with its SCHEDULED time — so
+        the recorded arrival stamps are exactly interval-spaced while the
+        sender itself runs ever further behind (visible as send lag)."""
+        clock = FakeClock()
+        sock = _SlowSendSocket(clock, cost_s=0.3)   # interval is 0.1
+        profile = LoadProfile(target_addr="stub://x", rate=100.0, burst=10,
+                              seconds=1.0, settle_s=0.0)
+        gen = LoadGenerator(profile, socket_factory=_StubFactory(sock),
+                            clock=clock, sleep=clock.sleep)
+        gen.start()
+        assert gen.wait(timeout=10.0)
+        assert len(sock.frames) == 10          # nothing skipped
+        # scheduled stamps, recovered from the sent ledger: exact spacing
+        scheds = sorted(ns for ns, _ in gen.scorecard._outstanding.values())
+        diffs = {round((b - a) / 1e9, 6)
+                 for a, b in zip(scheds, scheds[1:])}
+        assert diffs == {0.1}
+        snap = gen.scorecard.snapshot()
+        assert snap["send_lag_max_s"] >= 1.5   # sender was deeply behind
+        gen.stop()
+
+    def test_wire_frames_carry_the_scheduled_ingest_ns(self):
+        clock = FakeClock()
+        sock = _SlowSendSocket(clock, cost_s=0.25)
+        profile = LoadProfile(target_addr="stub://x", rate=100.0, burst=10,
+                              seconds=0.5, settle_s=0.0)
+        gen = LoadGenerator(profile, socket_factory=_StubFactory(sock),
+                            clock=clock, sleep=clock.sleep)
+        gen.start()
+        assert gen.wait(timeout=10.0)
+        gen.stop()
+        stamps = []
+        for frame in sock.frames:
+            _payload, ctx, _ = unwrap_trace(frame)
+            assert ctx is not None
+            stamps.append(ctx.ingest_ns)
+        diffs = {round((b - a) / 1e9, 6)
+                 for a, b in zip(stamps, stamps[1:])}
+        assert diffs == {0.1}
+
+
+class TestScorecard:
+    def test_loss_accounting_catches_a_dropped_trace_id(self):
+        card = Scorecard(offered_lines_per_s=100.0)
+        now = time.time_ns()
+        for trace_id in (0xA, 0xB, 0xC):
+            card.record_sent(trace_id, now, lines=10)
+        card.record_received(0xA, now + 1_000_000, lines=10)
+        card.record_received(0xC, now + 2_000_000, lines=10)
+        snap = card.snapshot()
+        assert snap["loss"] == 1 and snap["lost_traces"] == 1
+        assert card.missing_trace_ids() == [f"{0xB:016x}"]
+
+    def test_unknown_trace_ids_count_unmatched_not_matched(self):
+        card = Scorecard()
+        card.record_sent(1, time.time_ns(), lines=5)
+        assert card.record_received(999, time.time_ns(), lines=5) is None
+        snap = card.snapshot()
+        assert snap["unmatched_frames"] == 1
+        assert snap["matched_lines"] == 0
+        assert snap["loss"] == 1
+
+    def test_histogram_bucket_math_and_quantiles(self):
+        hist = LatencyHistogram(buckets=(0.001, 0.01, 0.1, 1.0))
+        for value in (0.0005, 0.0006, 0.005, 0.05, 0.5, 3.0):
+            hist.observe(value)
+        d = hist.to_dict()
+        assert d["count"] == 6
+        assert d["buckets_le_s"] == {"0.001": 2, "0.01": 1, "0.1": 1,
+                                     "1": 1, "+Inf": 1}
+        assert d["max_ms"] == pytest.approx(3000.0)
+        # cumulative-rank readout: p50 falls in the 0.01 bucket (rank 3)
+        assert hist.quantile(0.5) == 0.01
+        # the +inf tail reports the observed max, never infinity
+        assert hist.quantile(0.99) == pytest.approx(3.0)
+
+    def test_e2e_measured_from_scheduled_time(self):
+        card = Scorecard()
+        sched_ns = time.time_ns()
+        card.record_sent(7, sched_ns, lines=1)
+        e2e = card.record_received(7, sched_ns + 250_000_000, lines=1)
+        assert e2e == pytest.approx(0.25)
+
+
+class TestCorpus:
+    def test_invalid_utf8_rows_are_really_invalid(self):
+        import random
+
+        rng = random.Random(1)
+        row = corpus.make_invalid_utf8_line(3, rng)
+        with pytest.raises(UnicodeDecodeError):
+            row.decode("utf-8")
+        # ...but the permissive decode keeps a parseable audit header
+        assert row.decode("utf-8", errors="replace").startswith(
+            "type=SYSCALL msg=audit(")
+
+    def test_json_rows_are_fluentd_envelopes_of_audit_lines(self):
+        import random
+
+        rec = json.loads(corpus.make_json_line(5, random.Random(2)))
+        assert set(rec) == {"message", "logSource", "hostname"}
+        assert rec["message"].startswith("type=SYSCALL msg=audit(")
+
+    def test_payload_mix_weights_are_validated(self):
+        with pytest.raises(ValueError):
+            corpus.PayloadMix(anomaly=0.9, json=0.9)
+        with pytest.raises(ValueError):
+            corpus.PayloadMix.from_dict({"nope": 0.1})
+        mix = corpus.PayloadMix.from_dict({"json": 0.25})
+        assert mix.audit == pytest.approx(1.0 - 0.25 - 0.005 - 0.005)
+
+    def test_generate_is_deterministic_and_guards_training_prefix(self):
+        lines = list(corpus.generate(1000, anomaly_rate=0.5, seed=3))
+        assert lines == list(corpus.generate(1000, anomaly_rate=0.5, seed=3))
+        # anomalies held past the scorer example's training prefix
+        assert not any(anomaly for _, anomaly in lines[:640])
+        assert any(anomaly for _, anomaly in lines[640:])
+
+    def test_example_script_is_a_thin_wrapper_over_the_corpus(self):
+        import importlib.util
+        import random
+
+        spec = importlib.util.spec_from_file_location(
+            "gen_audit_log", REPO / "examples" / "gen_audit_log.py")
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        rng_a, rng_b = random.Random(9), random.Random(9)
+        assert (module.make_line(4, rng_a, False)
+                == corpus.make_line(4, rng_b, False))
+
+
+class _Echo:
+    def process(self, data):
+        return data
+
+    def process_batch(self, batch):
+        # batch-capable: the engine's micro-batch + frame re-packing path,
+        # which is what keeps wire frames (and their traces) 1:1
+        return list(batch)
+
+
+class TestLoadGeneratorEndToEnd:
+    def test_echo_pipeline_loss_zero_and_populated_histogram(self):
+        """Full loadgen round trip against a traced echo engine with
+        aligned frame sizes: every traced frame must come back (loss==0),
+        matched, with a populated client-latency histogram."""
+        from detectmateservice_tpu.engine import Engine
+        from detectmateservice_tpu.engine.socket import (
+            InprocQueueSocketFactory,
+        )
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        factory = InprocQueueSocketFactory(maxsize=4096)
+        settings = ServiceSettings(
+            component_type="core", component_id="loadgen-echo",
+            engine_addr="inproc://lg-echo-in",
+            out_addr=["inproc://lg-echo-out"],
+            engine_trace=True, trace_stage="echo",
+            engine_batch_size=40, engine_batch_timeout_ms=2.0,
+            engine_frame_batch=40, log_to_file=False)
+        engine = Engine(settings, _Echo(), factory)
+        engine.start()
+        try:
+            profile = LoadProfile(
+                target_addr="inproc://lg-echo-in",
+                listen_addr="inproc://lg-echo-out",
+                rate=4000.0, burst=40, seconds=1.5, settle_s=5.0)
+            gen = LoadGenerator(profile, socket_factory=factory)
+            gen.start()
+            assert gen.wait(timeout=30.0)
+            final = gen.stop()
+        finally:
+            engine.stop()
+        card = final["scorecard"]
+        assert card["loss"] == 0
+        assert card["sent_frames"] > 0
+        assert card["matched_lines"] == card["sent_lines"]
+        assert card["latency"]["count"] == card["sent_frames"]
+        assert card["goodput_ratio"] > 0.9
+
+
+class TestTraceObserveE2E:
+    def test_forwarding_stage_observes_e2e_and_still_propagates(self):
+        """trace_observe_e2e: the stage records the trace (flight recorder
+        + internal e2e) at egress AND the downstream consumer still gets
+        the v2 header — the mode the soak pipeline's output stage runs in.
+        Without the flag a forwarding stage records nothing."""
+        from detectmateservice_tpu.engine import Engine
+        from detectmateservice_tpu.engine.socket import (
+            InprocQueueSocketFactory,
+            TransportTimeout,
+        )
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        for observe in (True, False):
+            factory = InprocQueueSocketFactory()
+            suffix = "on" if observe else "off"
+            settings = ServiceSettings(
+                component_type="core", component_id=f"obs-{suffix}",
+                engine_addr=f"inproc://obs-in-{suffix}",
+                out_addr=[f"inproc://obs-out-{suffix}"],
+                engine_trace=True, trace_observe_e2e=observe,
+                log_to_file=False)
+            engine = Engine(settings, _Echo(), factory)
+            sink = factory.create(f"inproc://obs-out-{suffix}")
+            sink.recv_timeout = 200
+            engine.start()
+            try:
+                ctx = TraceContext.new(time.time_ns() - 5_000_000)
+                ingress = factory.create_output(f"inproc://obs-in-{suffix}")
+                ingress.send(wrap_trace(b"payload-x", ctx))
+                deadline = time.monotonic() + 5.0
+                raw = None
+                while raw is None and time.monotonic() < deadline:
+                    try:
+                        raw = sink.recv()
+                    except TransportTimeout:
+                        continue
+                assert raw is not None
+                _payload, out_ctx, _ = unwrap_trace(raw)
+                # propagation is unconditional for a forwarding stage...
+                assert out_ctx is not None
+                assert out_ctx.trace_id == ctx.trace_id
+                # ...observation is what the flag adds
+                assert engine.trace_recorder.completed == (
+                    1 if observe else 0)
+            finally:
+                engine.stop()
+
+
+class TestAdminLoad:
+    @pytest.fixture()
+    def echo_service(self, tmp_path):
+        """A real core echo Service (admin plane + engine over ipc), plus a
+        guarantee the process-global load manager is quiesced afterwards."""
+        from detectmateservice_tpu.core import Service
+        from detectmateservice_tpu.loadgen.generator import LOADGEN
+        from detectmateservice_tpu.settings import ServiceSettings
+
+        settings = ServiceSettings(
+            component_type="core", component_id="load-admin",
+            engine_addr=f"ipc://{tmp_path}/load-in.ipc",
+            out_addr=[f"ipc://{tmp_path}/load-out.ipc"],
+            engine_trace=True, engine_batch_size=20, engine_frame_batch=20,
+            http_port=0, log_to_file=False, watchdog_enabled=False)
+        service = Service(settings)
+        service.web_server.start()
+        service.start()
+        try:
+            yield service
+        finally:
+            try:
+                LOADGEN.stop()
+            except Exception:
+                pass
+            service.stop()
+            service.health.stop()
+            service.web_server.stop()
+
+    def _post(self, port, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/load",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def _get(self, port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/admin/load", timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def test_start_scorecard_conflict_stop_lifecycle(self, echo_service,
+                                                     tmp_path):
+        port = echo_service.web_server.port
+        profile = {
+            "target_addr": f"ipc://{tmp_path}/load-in.ipc",
+            "listen_addr": f"ipc://{tmp_path}/load-out.ipc",
+            "rate": 2000.0, "burst": 20, "seconds": 30.0, "settle_s": 2.0,
+        }
+        status, body = self._post(port, dict(profile, action="start"))
+        assert status == 200 and body["running"]
+
+        # live scorecard becomes non-trivial while the run is active
+        assert wait_until(
+            lambda: self._get(port)["scorecard"]["matched_lines"] > 0, 15.0)
+
+        # second start while one is active: state conflict
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            self._post(port, dict(profile, action="start"))
+        assert exc_info.value.code == 409
+
+        status, final = self._post(port, {"action": "stop"})
+        assert status == 200 and not final["running"]
+        assert final["scorecard"]["sent_frames"] > 0
+
+        # stop with nothing active: also a conflict
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            self._post(port, {"action": "stop"})
+        assert exc_info.value.code == 409
+
+        # the last run's scorecard stays readable after the stop
+        assert self._get(port)["scorecard"]["sent_frames"] > 0
+
+    def test_bad_profiles_are_client_errors(self, echo_service):
+        port = echo_service.web_server.port
+        for payload in ({"action": "start"},                  # no target
+                        {"action": "start", "target_addr": "ipc:///x",
+                         "nope": 1},                          # unknown key
+                        {"action": "blorp"}):                 # bad action
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                self._post(port, payload)
+            assert exc_info.value.code == 400
+
+
+class TestAlertEval:
+    def test_every_alerts_yml_expression_parses(self):
+        """The live-test contract: a rule edit that drifts outside the
+        evaluator's PromQL subset must break here, not silently stop being
+        soak-testable."""
+        rules = ae.load_rules(REPO / "ops" / "alerts.yml")
+        assert len(rules) >= 10
+        names = {rule.name for rule in rules}
+        assert {"EngineLoopStalled", "BatchOccupancyLow",
+                "PipelineLatencyBudgetBurnFast"} <= names
+
+    def test_unsupported_syntax_fails_loudly(self):
+        with pytest.raises(ae.PromQLError):
+            ae.parse_expr("histogram_quantile(0.99, foo_bucket)")
+        with pytest.raises(ae.PromQLError):
+            ae.parse_expr("sum without (x) (foo)")
+
+    def test_exposition_ingest_and_instant_lookup(self):
+        store = ae.SampleStore()
+        store.ingest_exposition(
+            'foo_total{a="x",b="y"} 3.5\n# HELP junk\nbar 1\n', t=10.0)
+        assert store.instant("foo_total", {"a": "x"}, 10.0) == [
+            ({"a": "x", "b": "y"}, 3.5)]
+        assert store.instant("foo_total", {"a": "z"}, 10.0) == []
+        # staleness: an old sample stops answering instant queries
+        assert store.instant("bar", {}, 10.0 + 400.0) == []
+
+    def test_rate_ratio_sum_by_and_gate(self):
+        """The MessageDropRateHigh shape: rate/rate ratio per stage, and a
+        time-scaled for: hold."""
+        rules = [r for r in ae.load_rules(REPO / "ops" / "alerts.yml")
+                 if r.name == "MessageDropRateHigh"]
+        evaluator = ae.RuleEvaluator(rules, time_scale=30.0)
+        store = ae.SampleStore()
+        labels = 'component_type="core",component_id="s1"'
+        for t in range(0, 41, 2):
+            read = 1000.0 * t
+            dropped = 0.0 if t < 10 else 100.0 * (t - 10)  # 10% drop rate
+            store.ingest_exposition(
+                f'data_read_lines_total{{{labels}}} {read}\n'
+                f'data_dropped_lines_total{{{labels}}} {dropped}\n',
+                float(t))
+            evaluator.tick(store, float(t))
+        report = evaluator.report()["MessageDropRateHigh"]
+        assert report["fired"]
+        states = [s for _, s in report["transitions"]]
+        assert states[:2] == ["pending", "firing"]
+
+    def test_min_over_time_and_increase(self):
+        assert ae.parse_expr("min_over_time(x[5m]) > 0")
+        store = ae.SampleStore()
+        for t, v in [(0, 1.0), (10, 2.0), (20, 3.0)]:
+            store.add("x", {}, float(t), v)
+        node = ae.parse_expr("min_over_time(x[1m])")
+        assert node.eval(store, 20.0, 1.0) == [({}, 1.0)]
+        inc = ae.parse_expr("increase(x[1m])")
+        [(lbl, value)] = inc.eval(store, 20.0, 1.0)
+        assert value >= 2.0  # 1 -> 3 over the window (+ extrapolation)
+
+    def test_ignoring_vector_matching(self):
+        """The DeviceHbmPressure shape: in_use / ignoring(kind) limit."""
+        store = ae.SampleStore()
+        base = 'component_type="d",component_id="s",device="tpu0"'
+        store.ingest_exposition(
+            f'device_hbm_bytes{{{base},kind="in_use"}} 95\n'
+            f'device_hbm_bytes{{{base},kind="limit"}} 100\n', 0.0)
+        node = ae.parse_expr(
+            'device_hbm_bytes{kind="in_use"} '
+            '/ ignoring(kind) device_hbm_bytes{kind="limit"} > 0.92')
+        result = node.eval(store, 0.0, 1.0)
+        assert len(result) == 1 and result[0][1] == pytest.approx(0.95)
+
+    def test_for_hold_honors_time_scale(self):
+        rule = ae.Rule("r", "x > 1", for_s=60.0)
+        store = ae.SampleStore()
+        for t in range(0, 16):
+            store.add("x", {}, float(t), 5.0)
+        # unscaled: 15 s of pending is not 60 s yet
+        for t in range(0, 16):
+            rule.evaluate(store, float(t), time_scale=1.0)
+        assert rule.state == "pending"
+        # scaled by 6: the hold is 10 s, so the same history fires
+        rule2 = ae.Rule("r2", "x > 1", for_s=60.0)
+        for t in range(0, 16):
+            rule2.evaluate(store, float(t), time_scale=6.0)
+        assert rule2.state == "firing"
+
+    def test_recovery_returns_to_inactive(self):
+        rule = ae.Rule("r", "x > 1", for_s=0.0)
+        store = ae.SampleStore()
+        store.add("x", {}, 0.0, 5.0)
+        assert rule.evaluate(store, 0.0) == "firing"
+        store.add("x", {}, 1.0, 0.5)
+        assert rule.evaluate(store, 1.0) == "inactive"
+        assert [s for _, s in rule.transitions] == ["firing", "inactive"]
