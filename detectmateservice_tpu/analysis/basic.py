@@ -84,7 +84,10 @@ def check_yaml_artifacts(repo: Path) -> List[Finding]:
             rel = path.relative_to(repo).as_posix()
             try:
                 with open(path, encoding="utf-8") as fh:
-                    yaml.safe_load(fh)
+                    # safe_load_all: k8s manifests (ops/k8s-*.yaml) are
+                    # legitimately multi-document streams
+                    for _doc in yaml.safe_load_all(fh):
+                        pass
             except yaml.YAMLError as exc:
                 mark = getattr(exc, "problem_mark", None)
                 line = (mark.line + 1) if mark is not None else 1

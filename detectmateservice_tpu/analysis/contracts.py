@@ -54,6 +54,8 @@ ALERT_COVERED_SERIES = (
     "scorer_xla_recompiles_unexpected_total",
     "device_hbm_bytes",
     "detector_batch_occupancy",
+    "router_replica_state",
+    "router_requeue_total",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

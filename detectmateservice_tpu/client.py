@@ -11,7 +11,11 @@ ledger + batch spans), ``profile [--seconds N] [--wait] [-o FILE]`` (start an
 on-demand jax.profiler capture and, with --wait, download the artifact zip),
 ``load start|status|stop`` (drive the open-loop load generator behind
 ``/admin/load`` and read its live SLO scorecard; ``start --wait`` exits
-non-zero on client-visible loss)
+non-zero on client-visible loss),
+``replicas [targets...] [--drain ADDR | --undrain ADDR]`` (replica-router
+roll-up across a pipeline — one row per replica with state/backlog/
+inflight/frames, non-zero exit on any non-active replica; the drain verbs
+post operator drain/undrain to a single router stage)
 and ``health`` — which fans out across every stage of
 a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
 ``stages:`` mapping), prints a roll-up table, and exits non-zero when any
@@ -109,6 +113,26 @@ class DetectMateClient:
         suffix = f"?limit={int(limit)}" if limit is not None else ""
         return self._request("GET", "/admin/xla" + suffix)
 
+    def replicas(self) -> Any:
+        """Replica-router roll-up (``GET /admin/replicas``). HTTP 404 means
+        the stage is not a router — surfaced to the caller as None so the
+        fan-out can skip non-router stages instead of erroring."""
+        try:
+            return self._request("GET", "/admin/replicas")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+
+    def replica_drain(self, replica: str) -> Any:
+        """Operator drain of one replica (``POST /admin/replicas``)."""
+        return self._request("POST", "/admin/replicas",
+                             {"action": "drain", "replica": replica})
+
+    def replica_undrain(self, replica: str) -> Any:
+        return self._request("POST", "/admin/replicas",
+                             {"action": "undrain", "replica": replica})
+
     def load_start(self, profile: dict) -> Any:
         """Start an open-loop load run (``POST /admin/load``). HTTP 409
         (another run active) is raised as urllib.error.HTTPError."""
@@ -203,6 +227,64 @@ def health_rollup(default_url: str, targets: List[str],
             for check in failing:
                 print(f"{'':<{name_w}}  {'':<{state_w}}    "
                       f"{check.get('name', '?')}: {check.get('detail', '')}")
+    return exit_code
+
+
+def replicas_rollup(default_url: str, targets: List[str],
+                    drain: Optional[str] = None,
+                    undrain: Optional[str] = None) -> int:
+    """Fan ``GET /admin/replicas`` out over every stage (same target forms
+    as the ``health`` roll-up), print one row per replica, and return the
+    exit code: 0 only when every replica of every router stage is active.
+    ``--drain`` / ``--undrain`` post the operator verb to the single
+    targeted router stage first."""
+    stages = resolve_stages(default_url, targets)
+    if drain or undrain:
+        if len(stages) != 1:
+            print("error: --drain/--undrain need exactly one router stage "
+                  "target", file=sys.stderr)
+            return 2
+        client = DetectMateClient(stages[0][1])
+        result = (client.replica_drain(drain) if drain
+                  else client.replica_undrain(undrain))
+        print(json.dumps(result, indent=2))
+    rows = []        # (stage, replica, state, backlog, inflight, frames)
+    exit_code = 0
+    saw_router = False
+    for name, url in stages:
+        try:
+            snap = DetectMateClient(url).replicas()
+        except (urllib.error.URLError, OSError) as exc:
+            rows.append((name, "-", "unreachable", "-", "-", "-", str(exc)))
+            exit_code = 1
+            continue
+        if snap is None:
+            continue                      # not a router stage: skip quietly
+        saw_router = True
+        policy = snap.get("policy", "?")
+        for rep in snap.get("replicas", []):
+            state = rep.get("state", "?")
+            if state != "active":
+                exit_code = 1
+            rows.append((name, rep.get("addr", "?"), state,
+                         rep.get("backlog", 0), rep.get("inflight", 0),
+                         rep.get("frames_total", 0),
+                         f"policy={policy}" if rep is snap["replicas"][0]
+                         else ""))
+    if not saw_router and not rows:
+        print("no replica-router stage found among the targets",
+              file=sys.stderr)
+        return 1
+    widths = [max([len(h), *(len(str(r[i])) for r in rows)])
+              for i, h in enumerate(
+                  ("STAGE", "REPLICA", "STATE", "BACKLOG", "INFLIGHT",
+                   "FRAMES"))]
+    header = ("STAGE", "REPLICA", "STATE", "BACKLOG", "INFLIGHT", "FRAMES")
+    print("  ".join(h.ljust(widths[i]) for i, h in enumerate(header)))
+    for row in rows:
+        print("  ".join(str(v).ljust(widths[i])
+                        for i, v in enumerate(row[:6]))
+              + (f"  {row[6]}" if row[6] else ""))
     return exit_code
 
 
@@ -310,6 +392,19 @@ def main(argv: Optional[List[str]] = None) -> int:
              "YAML with a 'stages: {name: url}' mapping; none = --url only")
     health.add_argument("--deep", action="store_true",
                         help="print per-check detail for failing stages")
+    replicas_p = sub.add_parser(
+        "replicas",
+        help="replica-router roll-up across stages (/admin/replicas)")
+    replicas_p.add_argument(
+        "targets", nargs="*",
+        help="stage admin URLs, per-stage settings YAMLs, or a pipeline "
+             "YAML with a 'stages: {name: url}' mapping; none = --url only")
+    replicas_p.add_argument("--drain", metavar="REPLICA_ADDR",
+                           help="operator-drain this replica on the (single) "
+                                "targeted router stage first")
+    replicas_p.add_argument("--undrain", metavar="REPLICA_ADDR",
+                           help="lift an operator drain on the (single) "
+                                "targeted router stage first")
     events_p = sub.add_parser(
         "events", help="read the structured event ring (/admin/events)")
     events_p.add_argument("--limit", type=int, default=None,
@@ -375,6 +470,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.command == "health":
             return health_rollup(args.url, args.targets, deep=args.deep)
+        if args.command == "replicas":
+            return replicas_rollup(args.url, args.targets,
+                                   drain=args.drain, undrain=args.undrain)
         if args.command == "profile":
             return run_profile(client, args.seconds, args.wait, args.out)
         if args.command == "load":

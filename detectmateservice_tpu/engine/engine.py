@@ -186,6 +186,11 @@ class Engine:
             self._transit_obs = m.PIPELINE_TRANSIT().labels(**self._labels).observe
             self._e2e_obs = m.PIPELINE_E2E_LATENCY().labels(**self._labels).observe
 
+        # router slot initialized before any socket exists so the failure
+        # cleanup path (_close_all) can always probe it
+        self._health = health
+        self.router = None
+
         # input socket (close nothing else exists yet on failure)
         self._pair_sock: EngineSocket = self._create_ingress()
 
@@ -206,6 +211,17 @@ class Engine:
         self._m_shm_zero = self._m_shm_copy = None
         try:
             self._setup_zero_copy()
+        except Exception:
+            self._close_all()
+            raise
+
+        # replica-parallel tier (router/): with ``router_replicas`` set this
+        # stage load-balances each outgoing frame to ONE downstream scorer
+        # replica instead of duplicating to every output (settings validation
+        # keeps out_addr empty in that mode). The router owns the replica
+        # sockets; its supervisor drives drain/requeue/re-dial.
+        try:
+            self._setup_router()
         except Exception:
             self._close_all()
             raise
@@ -290,6 +306,27 @@ class Engine:
             err_c.inc()
         return payload
 
+    def _setup_router(self) -> None:
+        replicas = list(getattr(self.settings, "router_replicas", ()) or ())
+        if not replicas:
+            return
+        from ..router import ReplicaRouter
+
+        self.router = ReplicaRouter(
+            self.settings, self._factory, self.logger, self._labels,
+            monitor=self._health, abort_check=self._router_abort)
+
+    def _router_abort(self) -> bool:
+        """Stop-aware backpressure escape for the router's block mode: the
+        same single shared drain window the output pump uses, so a stop with
+        every replica down still lands inside the 2 s stop-join deadline."""
+        if self._running and not self._stop_event.is_set():
+            return False
+        if self._stop_drain_deadline is None:
+            self._stop_drain_deadline = (
+                time.monotonic() + self.settings.out_stop_drain_ms / 1000.0)
+        return time.monotonic() >= self._stop_drain_deadline
+
     def _setup_output_sockets(self) -> None:
         for addr in self.settings.out_addr:
             try:
@@ -326,6 +363,7 @@ class Engine:
             try:
                 self._setup_output_sockets()
                 self._setup_zero_copy()
+                self._setup_router()
             except Exception:
                 self._close_all()
                 raise
@@ -378,6 +416,9 @@ class Engine:
         if self._shm_reader is not None:
             self._shm_reader.close()
             self._shm_reader = None
+        if self.router is not None:
+            self.router.close()
+            self.router = None
 
     @property
     def running(self) -> bool:
@@ -443,7 +484,7 @@ class Engine:
             return
         now = time.time_ns()
         terminal = (self._trace_terminal if self._trace_terminal is not None
-                    else not self._out_socks)
+                    else not self._out_socks and self.router is None)
         while self._trace_pending:
             ctx, recv_ns = self._trace_pending.popleft()
             ctx.hops.append(Hop(self._trace_stage, recv_ns, now))
@@ -576,7 +617,7 @@ class Engine:
         # outputs; unavailable (falls back to the heuristic) for fused-frame
         # and pipelined processors, which decouple outputs from this call's
         # inputs.
-        track_origins = (not self._out_socks
+        track_origins = (not self._out_socks and self.router is None
                          and hasattr(self._pair_sock, "last_origin"))
         # a short-poll tick is NOT true idleness: drain only what is already
         # host-readable (drain_ready) so the loop never blocks on an unready
@@ -595,9 +636,15 @@ class Engine:
         short_timeout = (min(base_timeout, max(1, hint)) if hint > 0
                          else min(5, base_timeout))
         current_timeout = base_timeout
+        # replica-router deferred work (re-dials, drain deadlines, requeue
+        # redelivery) runs on THIS thread — sockets are single-threaded by
+        # design; the no-work tick is one lock acquire + three scans
+        router = self.router
         # dmlint: hot-loop
         while self._running and not self._stop_event.is_set():
             self._hb_loop.beat()
+            if router is not None:
+                router.tick()
             if callable(pending_fn):
                 want = short_timeout if pending_fn() > 0 else base_timeout
                 if want != current_timeout:
@@ -756,6 +803,10 @@ class Engine:
             except Exception as exc:
                 self.logger.error("flush at stop raised: %s", exc)
         self._finalize_traces()
+        if router is not None:
+            # last redelivery pass so frames requeued from a drained replica
+            # are not abandoned in the requeue queue at stop
+            router.tick()
 
     # -- fan-out --------------------------------------------------------
     def _send_results(self, outs, origins=None) -> None:
@@ -782,7 +833,8 @@ class Engine:
                        if o is not None]
         else:
             pending = [(o, None) for o in outs if o is not None]
-        attach = bool(self._trace_enabled and self._out_socks
+        attach = bool(self._trace_enabled
+                      and (self._out_socks or self.router is not None)
                       and not self._trace_terminal
                       and self._trace_pending and pending)
         now_ns = time.time_ns() if attach else 0  # one clock read per call
@@ -934,6 +986,19 @@ class Engine:
         dropped_l = self._m_dropped_l
         if lines is None:
             lines = _count_lines(data)
+
+        # replica-router mode: exactly ONE replica gets the frame (policy
+        # choice + credit flow control live in router/); written counts a
+        # delivered frame once, dropped counts a frame no dispatchable
+        # replica accepted within the backpressure budget
+        if self.router is not None:
+            if self.router.dispatch(data, lines):
+                written_b.inc(len(data))
+                written_l.inc(lines)
+                return True
+            dropped_b.inc(len(data))
+            dropped_l.inc(lines)
+            return False
 
         # zero-copy framing: the payload moves into a refcounted shm slot
         # and a ~40-byte reference goes on the wire instead. A reply (origin

@@ -295,6 +295,22 @@ def unwrap_trace(data: bytes) -> Tuple[bytes, Optional[TraceContext], bool]:
     return data[start:], ctx, False
 
 
+def peek_trace_id(data: bytes) -> Optional[int]:
+    """The trace id of a v2 frame WITHOUT parsing the hop records — the
+    router's sticky_trace policy runs this per dispatched frame, so it reads
+    exactly one varint and eight bytes. None for non-v2 frames and for
+    frames whose declared trace block cannot hold an id."""
+    if not data.startswith(MAGIC_V2):
+        return None
+    try:
+        trace_len, pos = _get_varint(data, len(MAGIC_V2))
+    except FramingError:
+        return None
+    if trace_len < 8 or pos + 8 > len(data):
+        return None
+    return int.from_bytes(data[pos:pos + 8], "big")
+
+
 def unpack_batch(data: bytes) -> Optional[List[bytes]]:
     """Batch frame → messages; None when ``data`` is a plain single message
     (no magic). Raises FramingError on a corrupt batch body."""

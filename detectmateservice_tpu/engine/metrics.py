@@ -316,6 +316,35 @@ LOADGEN_SEND_LAG = _series(
     "sustained growth means the generator itself cannot source the "
     "offered rate (the scheduled stamps still keep latency honest)")
 
+# replica-parallel serving tier (router/): one routing stage fanning frames
+# across N scorer replicas. frames_total splits traffic by replica and the
+# policy that picked it; replica_state is the supervisor's state machine
+# (3=active, 2=recovering, 1=draining, 0=drained) — anything below 3 for
+# long is the ReplicaDrainedSustained page; requeue_total counts frames
+# resent to a healthy peer after a replica died holding them (at-least-once
+# redelivery, the replica_kill soak's zero-loss mechanism); inflight is the
+# unacked credit window per replica (pinned at router_credit_window means
+# that replica is not draining its ingest).
+REPLICA_LABELS = ("component_type", "component_id", "replica", "policy")
+ROUTER_FRAMES = _series(
+    Counter, "router_frames_total",
+    "Frames the replica router dispatched, by replica and balancing policy",
+    REPLICA_LABELS)
+ROUTER_REPLICA_STATE = _series(
+    Gauge, "router_replica_state",
+    "Supervisor state per replica: 3=active, 2=recovering, 1=draining, "
+    "0=drained",
+    ("component_type", "component_id", "replica"))
+ROUTER_REQUEUE = _series(
+    Counter, "router_requeue_total",
+    "Frames requeued to a healthy peer after their replica was drained "
+    "while still holding them unacked (at-least-once redelivery)")
+ROUTER_INFLIGHT = _series(
+    Gauge, "router_inflight",
+    "Unacked frames outstanding per replica (the credit window); pinned at "
+    "router_credit_window means the replica is not draining its ingest",
+    ("component_type", "component_id", "replica"))
+
 # adaptive continuous batching (library/detectors/jax_scorer.py coalescer):
 # rows held across process_batch calls toward the best-fitting warm bucket
 # under a latency budget. Depth is the current hold; releases count why

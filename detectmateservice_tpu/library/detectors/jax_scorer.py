@@ -460,8 +460,12 @@ class JaxScorerDetector(CoreDetector):
         host_path = self._cpu_device is not None
         small = () if host_path else (1, 8)
         # compiles in here are the expected warm-up set; after
-        # mark_warmup_complete any dispatch-path compile is an unexpected
-        # recompile (engine/device_obs.py — the RecompileStorm signal)
+        # mark_warmup_complete a dispatch-path compile of a bucket in
+        # _device_warm is an unexpected recompile (engine/device_obs.py —
+        # the RecompileStorm signal: the cache for a shape we believed
+        # compiled was invalidated). First touch of a bucket OUTSIDE the
+        # warm set is planned growth and pre-warms expected instead
+        # (_warm_device_bucket) on both the adaptive and legacy paths.
         with self._ledger.context(where="warmup", backend=self._obs_backend,
                                   expected=True):
             for b in (*small, self.config.train_batch_size, self.config.max_batch):
@@ -1299,6 +1303,14 @@ class JaxScorerDetector(CoreDetector):
             self._bucket_usage[bucket] = self._bucket_usage.get(bucket, 0) + 1
         else:
             bucket = _bucket(n, self.config.max_batch)
+            if bucket not in self._device_warm:
+                # legacy (non-coalescer) path: a bucket outside the warm
+                # set — traffic whose natural batch size the setup warm-up
+                # never saw, e.g. a replica tier halving each scorer's
+                # burst — gets the same EXPECTED on-demand pre-warm the
+                # adaptive path does, instead of paging the first dispatch
+                # as an unexpected recompile
+                self._warm_device_bucket(bucket)
         use_workers = self.config.upload_workers > 0
         if use_workers:
             self._ensure_upload_workers()

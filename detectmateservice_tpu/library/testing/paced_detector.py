@@ -1,0 +1,57 @@
+"""Device-paced detector double: models a DEVICE-BOUND scorer.
+
+``scripts/replica_bench.py`` needs to measure the replica-router tier's
+scale-out — N replicas sustaining ~N× one replica's goodput — but on a
+host with fewer cores than replicas a CPU-bound scorer cannot scale by
+construction (the cores are the ceiling, not the router). The regime the
+paper targets is the opposite: the TPU does the scoring while the host
+only orchestrates, so replica throughput is bounded by *device* time that
+overlaps freely across replica processes.
+
+:class:`PacedDetector` models exactly that regime: each ``process_batch``
+call "occupies the device" for ``service_ms`` of wall time (a sleep — no
+host CPU consumed, like a dispatch waiting on device compute + readback)
+and then passes every message through unchanged. One batch at a time per
+replica, like a scorer with ``pipeline_depth`` 0. The bench's ``jax``
+mode swaps this for the real ``JaxScorerDetector`` on hosts that can
+exercise it.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+from ..common.core import CoreComponent, CoreConfig
+
+
+class PacedDetectorConfig(CoreConfig):
+    method_type: str = "paced_detector"
+    # wall milliseconds one batch "occupies the device"
+    service_ms: float = 50.0
+
+
+class PacedDetector(CoreComponent):
+    config_class = PacedDetectorConfig
+    category = "detectors"
+    description = ("PacedDetector passes messages through after a fixed "
+                   "per-batch device-time wait (replica-bench double).")
+
+    def __init__(self, name: Optional[str] = None, config: Any = None) -> None:
+        super().__init__(name=name or "PacedDetector", config=config)
+        self.config: PacedDetectorConfig
+
+    def _occupy_device(self) -> None:
+        wait_s = max(0.0, float(self.config.service_ms)) / 1000.0
+        if wait_s:
+            time.sleep(wait_s)
+
+    def process(self, data: bytes) -> Optional[bytes]:
+        self._occupy_device()
+        return data
+
+    def process_batch(self, batch: List[bytes]) -> List[Optional[bytes]]:
+        """One device occupancy per BATCH — the whole point: a bigger
+        micro-batch amortizes the device wait exactly like a real
+        accelerator dispatch."""
+        self._occupy_device()
+        return list(batch)

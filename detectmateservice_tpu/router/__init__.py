@@ -1,0 +1,49 @@
+"""Replica-parallel serving tier: one pipeline, N scorer replicas.
+
+PAPER.md §0/§7 describes the production topology the reference only
+gestures at — one parser feeding a *tier* of detector processes wired by
+NNG addresses. This package is that tier's routing stage:
+
+* :mod:`balancer` — pluggable dispatch policies (``least_backlog``,
+  ``round_robin``, ``sticky_trace``),
+* :mod:`supervisor` — per-replica health/state machine driven by each
+  replica's ``/admin/health?deep=1`` and ingest watermark, with
+  drain → requeue → re-dial semantics,
+* :mod:`router` — the :class:`ReplicaRouter` the engine embeds when
+  ``settings.router_replicas`` is non-empty.
+
+The router is *just another stage*: it runs the same engine hot loop,
+watchdog heartbeats, v2 trace stamping, and metrics registry as every
+other component — ``router_frames_total`` / ``router_replica_state`` /
+``router_requeue_total`` / ``router_inflight`` are REGISTERED_SERIES, so
+dmlint's cross-artifact contracts (dashboard, alerts, docs) apply.
+"""
+from .balancer import (
+    LeastBacklogPolicy,
+    RoundRobinPolicy,
+    StickyTracePolicy,
+    make_policy,
+)
+from .router import ReplicaRouter
+from .supervisor import (
+    STATE_ACTIVE,
+    STATE_DRAINED,
+    STATE_DRAINING,
+    STATE_RECOVERING,
+    Replica,
+    ReplicaSupervisor,
+)
+
+__all__ = [
+    "LeastBacklogPolicy",
+    "RoundRobinPolicy",
+    "StickyTracePolicy",
+    "make_policy",
+    "ReplicaRouter",
+    "Replica",
+    "ReplicaSupervisor",
+    "STATE_ACTIVE",
+    "STATE_DRAINED",
+    "STATE_DRAINING",
+    "STATE_RECOVERING",
+]

@@ -1,0 +1,111 @@
+"""Balancing policies: which dispatchable replica gets the next frame.
+
+A policy is a pure choice function over the replicas the router already
+filtered down to *dispatchable* (state ACTIVE, credit available) — health
+and flow control are the supervisor's and router's jobs, not the policy's.
+``pick`` runs once per outgoing wire frame on the engine hot loop, so
+policies hold no locks and allocate nothing beyond what the choice needs.
+
+* ``round_robin``   — rotate; the baseline fairness policy.
+* ``least_backlog`` — the default: route to the replica with the fewest
+  unacked frames in its credit window, ties broken by the last-polled
+  ingress backlog (``engine_ingress_backlog`` piggybacked on the
+  supervisor's watermark poll), then by rotation. Lexicographic on
+  purpose: inflight is the router's OWN live knowledge in frames, backlog
+  a stale poll in messages — summing them lets hundreds of backlog
+  messages drown out the signal that actually predicts queueing, the
+  unacked window. Under even replicas this degenerates to round robin;
+  under a slow replica it shifts traffic away *before* the credit window
+  hard-stops dispatch.
+* ``sticky_trace``  — rendezvous (highest-random-weight) hash of the PR-1
+  trace id over the replica set: one source's frames stay on one replica
+  (per-source ordering holds there) while it is dispatchable, and only
+  that replica's traces re-home when membership changes — no global
+  reshuffle on a drain.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class RoundRobinPolicy:
+    name = "round_robin"
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def pick(self, replicas: Sequence, trace_id: Optional[int]):
+        if not replicas:
+            return None
+        choice = replicas[self._next % len(replicas)]
+        self._next = (self._next + 1) % (1 << 30)
+        return choice
+
+
+class LeastBacklogPolicy:
+    name = "least_backlog"
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def pick(self, replicas: Sequence, trace_id: Optional[int]):
+        if not replicas:
+            return None
+        # rotating start index breaks ties fairly without a second pass
+        start = self._next % len(replicas)
+        self._next = (self._next + 1) % (1 << 30)
+        best = None
+        best_load = None
+        for i in range(len(replicas)):
+            replica = replicas[(start + i) % len(replicas)]
+            load = (replica.inflight, replica.backlog)
+            if best_load is None or load < best_load:
+                best, best_load = replica, load
+        return best
+
+
+def _mix64(value: int) -> int:
+    """splitmix64 finalizer: cheap, well-distributed 64-bit mixing for the
+    rendezvous weights (no hashlib call per frame per replica)."""
+    value = (value ^ (value >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
+    value = (value ^ (value >> 27)) * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
+    return value ^ (value >> 31)
+
+
+class StickyTracePolicy:
+    name = "sticky_trace"
+
+    def __init__(self) -> None:
+        # untraced frames (no v2 header) cannot stick — rotate them
+        self._fallback = RoundRobinPolicy()
+
+    def pick(self, replicas: Sequence, trace_id: Optional[int]):
+        if not replicas:
+            return None
+        if trace_id is None:
+            return self._fallback.pick(replicas, None)
+        best = None
+        best_weight = -1
+        for replica in replicas:
+            weight = _mix64(trace_id ^ replica.id_hash)
+            if weight > best_weight:
+                best, best_weight = replica, weight
+        return best
+
+
+_POLICIES = {
+    RoundRobinPolicy.name: RoundRobinPolicy,
+    LeastBacklogPolicy.name: LeastBacklogPolicy,
+    StickyTracePolicy.name: StickyTracePolicy,
+}
+
+POLICY_NAMES: List[str] = sorted(_POLICIES)
+
+
+def make_policy(name: str):
+    try:
+        return _POLICIES[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown router policy {name!r}; expected one of {POLICY_NAMES}"
+        ) from None

@@ -1,0 +1,419 @@
+"""The replica router the engine embeds when ``router_replicas`` is set.
+
+Instead of duplicating every outgoing frame to all peers (the engine's
+fan-out contract), the router delivers each frame to exactly ONE healthy
+downstream replica, chosen by the configured :mod:`balancer` policy, under
+per-replica credit flow control. Replica health comes from the
+:class:`~detectmateservice_tpu.router.supervisor.ReplicaSupervisor` (deep
+health + ack watermark polls) and from send failures observed inline; a
+failed replica is drained — dispatch stops, in-flight frames get
+``router_drain_timeout_s`` to settle, what stays unacked is requeued to
+healthy peers (at-least-once) — and re-dialed when its probe recovers.
+
+Threading contract (mirrors the engine's own design):
+
+* **engine thread**: ``dispatch`` (per outgoing frame) and ``tick`` (per
+  loop iteration) — the only code that touches replica sockets;
+* **supervisor thread**: ``apply_probe`` / ``process_drains`` — state and
+  bookkeeping only, never a socket;
+* **admin threads**: ``snapshot`` / ``drain`` / ``undrain``.
+
+All shared replica state is guarded by ``self._lock``; socket sends happen
+strictly outside it. Structured events (``replica_drain`` /
+``replica_drained`` / ``replica_recovering`` / ``replica_undrain``) are
+collected under the lock and emitted after release through the service's
+``HealthMonitor.emit_event`` — the same ring ``/admin/events`` serves.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from ..engine import metrics as m
+from ..engine.framing import peek_trace_id
+from ..engine.socket import TransportAgain, TransportError
+from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
+from .balancer import StickyTracePolicy, make_policy
+from .supervisor import (
+    RECOVERY_POLLS,
+    STATE_ACTIVE,
+    STATE_DRAINED,
+    STATE_DRAINING,
+    STATE_NAMES,
+    STATE_RECOVERING,
+    ProbeResult,
+    Replica,
+    ReplicaSupervisor,
+)
+
+_RETRY_SLEEP_S = 0.01   # the engine's reference retry backoff
+
+
+class ReplicaRouter:
+    def __init__(
+        self,
+        settings: ServiceSettings,
+        factory,
+        logger: Optional[logging.Logger] = None,
+        labels: Optional[dict] = None,
+        monitor=None,
+        probe: Optional[Callable[[Replica], ProbeResult]] = None,
+        abort_check: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        self.settings = settings
+        self.logger = logger or logging.getLogger("router")
+        self._factory = factory
+        self._labels = dict(labels or dict(
+            component_type=settings.component_type,
+            component_id=settings.component_id or "router"))
+        self._monitor = monitor
+        self._abort = abort_check
+        self._policy = make_policy(settings.router_policy)
+        self._sticky = isinstance(self._policy, StickyTracePolicy)
+        self._credit = settings.router_credit_window
+        self._drain_timeout_s = settings.router_drain_timeout_s
+        self._retry_count = settings.engine_retry_count
+        self._block = settings.out_backpressure == "block"
+        self._lock = threading.RLock()
+        self._requeue: deque = deque()       # (lines, wire) awaiting redelivery
+        self._requeue_total = 0
+        self._m_requeue = m.ROUTER_REQUEUE().labels(**self._labels)
+
+        admin_urls = list(settings.router_admin_urls or [])
+        self.replicas: List[Replica] = []
+        for index, addr in enumerate(settings.router_replicas):
+            replica = Replica(
+                index, addr,
+                admin_urls[index] if index < len(admin_urls) else None,
+                self._labels, self._policy.name)
+            self.replicas.append(replica)
+        try:
+            for replica in self.replicas:
+                replica.sock = self._dial(replica.addr)
+        except Exception:
+            self.close()
+            raise
+
+        # supervision runs when there is something to poll: admin URLs for
+        # the HTTP probe, or an injected probe (tests, in-process fleets)
+        self._supervisor: Optional[ReplicaSupervisor] = None
+        if probe is not None or any(r.admin_url for r in self.replicas):
+            self._supervisor = ReplicaSupervisor(
+                self, settings.router_health_interval_s,
+                probe=probe, logger=self.logger)
+            self._supervisor.start()
+        self.logger.info(
+            "replica router up: %d replicas, policy=%s, credit_window=%d, "
+            "drain_timeout=%.1fs, supervision=%s",
+            len(self.replicas), self._policy.name, self._credit,
+            self._drain_timeout_s,
+            "on" if self._supervisor is not None else "send-failure only")
+
+    def _dial(self, addr: str):
+        is_tls = addr.startswith(TLS_SCHEME_PREFIXES)
+        return self._factory.create_output(
+            addr, self.logger,
+            self.settings.tls_output if is_tls else None,
+            dial_timeout=self.settings.out_dial_timeout,
+            buffer_size=self.settings.engine_buffer_size)
+
+    # -- engine-thread API -----------------------------------------------
+    def dispatch(self, wire: bytes, lines: int) -> bool:
+        """Deliver one wire frame to one replica. True when it left the
+        process; False when it had to be dropped (no dispatchable replica
+        within the backpressure budget). Runs on the engine hot path: one
+        lock acquire per pick, sends outside the lock."""
+        trace_id = peek_trace_id(wire) if self._sticky else None
+        retries = 0
+        tried: set = set()
+        while True:
+            with self._lock:
+                candidates = [r for r in self.replicas
+                              if r.state == STATE_ACTIVE
+                              and r.sock is not None
+                              and len(r.window) < self._credit
+                              and r.index not in tried]
+                choice = self._policy.pick(candidates, trace_id)
+                sock = choice.sock if choice is not None else None
+            if choice is None:
+                # every dispatchable replica was tried (or none exists):
+                # behave per the engine's backpressure contract
+                tried.clear()
+                if self._abort is not None and self._abort():
+                    return False
+                if self._block:
+                    time.sleep(0.001)    # flow control, stop-aware via abort
+                    continue
+                retries += 1
+                if retries >= self._retry_count:
+                    return False
+                time.sleep(_RETRY_SLEEP_S)
+                continue
+            try:
+                sock.send(wire, block=False)
+            except TransportAgain:
+                # transport buffer full: that replica is saturated right
+                # now — try the next one immediately, no backoff
+                tried.add(choice.index)
+                continue
+            except TransportError as exc:
+                self._fail_replica(choice, f"send failed: {exc}")
+                tried.add(choice.index)
+                continue
+            with self._lock:
+                choice.window.append((lines, wire))
+                choice.note_sent(lines)
+            return True
+
+    def tick(self) -> None:
+        """Deferred engine-thread work: re-dial recovered replicas, enforce
+        drain deadlines when no supervisor polls, redeliver requeued
+        frames. Called once per engine loop iteration — the no-work path is
+        one lock acquire and three cheap scans."""
+        with self._lock:
+            redials = [r for r in self.replicas if r.needs_redial]
+            work = bool(self._requeue) or bool(redials) or any(
+                r.state == STATE_DRAINING for r in self.replicas)
+        if not work:
+            return
+        for replica in redials:
+            old_sock = None
+            try:
+                sock = self._dial(replica.addr)
+            except TransportError as exc:
+                self.logger.warning("re-dial of replica %s failed: %s "
+                                    "(will retry)", replica.addr, exc)
+                continue
+            with self._lock:
+                old_sock, replica.sock = replica.sock, sock
+                replica.needs_redial = False
+                # without a supervisor there is no probe to promote a
+                # recovering replica — the successful re-dial is the best
+                # available signal, so dispatch resumes here
+                if (self._supervisor is None
+                        and replica.state == STATE_RECOVERING):
+                    replica.set_state(STATE_ACTIVE, "re-dialed (unsupervised)")
+            if old_sock is not None:
+                try:
+                    old_sock.close()
+                except TransportError:
+                    pass
+        if self._supervisor is None:
+            self.process_drains()
+        self._drain_requeue()
+
+    def _drain_requeue(self) -> None:
+        """Redeliver queued frames to healthy replicas — one non-blocking
+        pass; what cannot go now stays queued for the next tick. Only the
+        engine thread pops, so peek-then-pop is race-free."""
+        while True:
+            with self._lock:
+                if not self._requeue:
+                    return
+                lines, wire = self._requeue[0]
+                candidates = [r for r in self.replicas
+                              if r.state == STATE_ACTIVE
+                              and r.sock is not None
+                              and len(r.window) < self._credit]
+                choice = self._policy.pick(
+                    candidates,
+                    peek_trace_id(wire) if self._sticky else None)
+                sock = choice.sock if choice is not None else None
+            if choice is None:
+                return
+            try:
+                sock.send(wire, block=False)
+            except TransportAgain:
+                return                      # retry on the next tick
+            except TransportError as exc:
+                self._fail_replica(choice, f"requeue send failed: {exc}")
+                continue
+            with self._lock:
+                self._requeue.popleft()
+                choice.window.append((lines, wire))
+                choice.note_sent(lines)
+                choice.requeued_total += 1
+                self._requeue_total += 1
+                self._m_requeue.inc()
+
+    def close(self) -> None:
+        if self._supervisor is not None:
+            self._supervisor.stop()
+            self._supervisor = None
+        for replica in self.replicas:
+            sock, replica.sock = replica.sock, None
+            if sock is not None:
+                try:
+                    sock.close()
+                except TransportError:
+                    pass
+
+    # -- supervision inputs (supervisor thread / engine thread) ----------
+    def apply_probe(self, replica: Replica, result: ProbeResult) -> None:
+        events: list = []
+        with self._lock:
+            if result.backlog is not None:
+                replica.backlog = float(result.backlog)
+            if result.component_id:
+                replica.component_id = result.component_id
+            if result.read_lines is not None:
+                replica.apply_watermark(float(result.read_lines))
+            if replica.manual_drain:
+                # the operator owns the state; the watermark above still
+                # advances so an operator drain settles cleanly
+                replica.state_detail = (f"operator drain "
+                                        f"(probe: {result.status})")
+            elif result.status == "healthy":
+                replica.healthy_streak += 1
+                if replica.state in (STATE_DRAINING, STATE_DRAINED):
+                    replica.set_state(STATE_RECOVERING,
+                                      "probe healthy again; re-dialing")
+                    replica.healthy_streak = 1
+                    replica.drain_deadline = None
+                    replica.needs_redial = True
+                    events.append(self._event(
+                        "replica_recovering", replica,
+                        detail="probe healthy; awaiting re-dial + "
+                               f"{RECOVERY_POLLS} clean polls"))
+                elif (replica.state == STATE_RECOVERING
+                        and replica.healthy_streak >= RECOVERY_POLLS
+                        and not replica.needs_redial
+                        and replica.sock is not None):
+                    replica.set_state(STATE_ACTIVE, "recovered")
+                    replica.send_failures = 0
+                    events.append(self._event("replica_undrain", replica,
+                                              detail="dispatch resumed"))
+                elif replica.state == STATE_ACTIVE:
+                    replica.state_detail = result.detail or "healthy"
+            else:
+                replica.healthy_streak = 0
+                if replica.state in (STATE_ACTIVE, STATE_RECOVERING):
+                    self._begin_drain(
+                        replica, f"{result.status}: {result.detail}", events)
+                else:
+                    replica.state_detail = (f"{result.status}: "
+                                            f"{result.detail}")
+        self._emit(events)
+
+    def process_drains(self, now: Optional[float] = None) -> None:
+        """Settle or expire draining replicas: an emptied window is a clean
+        drain; a window still unacked at the deadline moves to the requeue
+        queue for redelivery (at-least-once)."""
+        events: list = []
+        with self._lock:
+            now = time.monotonic() if now is None else now
+            for replica in self.replicas:
+                if replica.state != STATE_DRAINING:
+                    continue
+                if not replica.window:
+                    replica.set_state(STATE_DRAINED,
+                                      "drained clean (in-flight settled)")
+                    replica.drain_deadline = None
+                    events.append(self._event("replica_drained", replica,
+                                              requeued=0))
+                elif (replica.drain_deadline is not None
+                        and now >= replica.drain_deadline):
+                    taken = replica.take_window()
+                    self._requeue.extend(taken)
+                    replica.set_state(
+                        STATE_DRAINED,
+                        f"drain timeout: {len(taken)} unacked frames "
+                        "requeued to healthy peers")
+                    replica.drain_deadline = None
+                    events.append(self._event("replica_drained", replica,
+                                              requeued=len(taken)))
+        self._emit(events)
+
+    def _fail_replica(self, replica: Replica, detail: str) -> None:
+        events: list = []
+        with self._lock:
+            replica.send_failures += 1
+            if replica.state in (STATE_ACTIVE, STATE_RECOVERING):
+                self._begin_drain(replica, detail, events)
+        self._emit(events)
+
+    def _begin_drain(self, replica: Replica, reason: str,
+                     events: list) -> None:
+        """Caller holds the lock."""
+        replica.set_state(STATE_DRAINING, reason)
+        replica.drain_deadline = time.monotonic() + self._drain_timeout_s
+        events.append(self._event(
+            "replica_drain", replica, reason=reason,
+            inflight=len(replica.window),
+            drain_timeout_s=self._drain_timeout_s))
+
+    # -- admin-plane API --------------------------------------------------
+    def drain(self, addr: str) -> dict:
+        """Operator drain: stop dispatching to ``addr`` now; in-flight
+        frames settle (or requeue at the deadline) exactly like a
+        supervisor-initiated drain, but the replica stays down until an
+        explicit ``undrain`` — probes cannot resurrect it."""
+        replica = self._find(addr)
+        events: list = []
+        with self._lock:
+            replica.manual_drain = True
+            if replica.state in (STATE_ACTIVE, STATE_RECOVERING):
+                self._begin_drain(replica, "operator drain", events)
+        self._emit(events)
+        self.process_drains()
+        with self._lock:
+            return replica.snapshot()
+
+    def undrain(self, addr: str) -> dict:
+        replica = self._find(addr)
+        events: list = []
+        with self._lock:
+            replica.manual_drain = False
+            replica.healthy_streak = 0
+            if replica.state in (STATE_DRAINED, STATE_DRAINING):
+                replica.set_state(STATE_RECOVERING,
+                                  "operator undrain; re-dialing")
+                replica.drain_deadline = None
+                replica.needs_redial = True
+                events.append(self._event(
+                    "replica_recovering", replica,
+                    detail="operator undrain; awaiting re-dial"))
+        self._emit(events)
+        with self._lock:
+            return replica.snapshot()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            replicas = [r.snapshot() for r in self.replicas]
+            return {
+                "policy": self._policy.name,
+                "credit_window": self._credit,
+                "drain_timeout_s": self._drain_timeout_s,
+                "supervised": self._supervisor is not None,
+                "requeue_pending": len(self._requeue),
+                "requeue_total": self._requeue_total,
+                "replicas": replicas,
+                "dispatchable": sum(
+                    1 for r in replicas
+                    if r["state"] == STATE_NAMES[STATE_ACTIVE]),
+            }
+
+    def _find(self, addr: str) -> Replica:
+        for replica in self.replicas:
+            if replica.addr == addr:
+                return replica
+        raise ValueError(f"no replica with address {addr!r}; configured: "
+                         f"{[r.addr for r in self.replicas]}")
+
+    # -- events ------------------------------------------------------------
+    def _event(self, kind: str, replica: Replica, **extra) -> dict:
+        doc = {"kind": kind, "replica": replica.addr,
+               "state": STATE_NAMES[replica.state]}
+        doc.update(extra)
+        return doc
+
+    def _emit(self, events: list) -> None:
+        for event in events:
+            if self._monitor is not None:
+                self._monitor.emit_event(event)
+            else:
+                self.logger.warning("router event %s: %s",
+                                    event.get("kind"), event)

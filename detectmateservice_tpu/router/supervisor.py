@@ -1,0 +1,296 @@
+"""Replica supervision: probe, state machine inputs, watermark acks.
+
+Thread topology (the part that keeps the router's lock discipline simple):
+
+* the **engine thread** owns every replica socket — dispatch, requeue
+  resends, and re-dials all happen there (``ReplicaRouter.dispatch/tick``);
+* the **supervisor thread** (this module) only does blocking HTTP I/O —
+  ``GET /admin/health?deep=1`` and a ``/metrics`` watermark read per
+  replica per interval — and hands each :class:`ProbeResult` to
+  ``ReplicaRouter.apply_probe``, which runs the state machine under the
+  router lock. The supervisor never touches a socket.
+
+States (exported as the ``router_replica_state`` gauge):
+
+* ``ACTIVE (3)``     — dispatchable.
+* ``RECOVERING (2)`` — probe healthy again after a drain; the engine
+  re-dials the replica and it must stay healthy for
+  ``RECOVERY_POLLS`` consecutive polls before dispatch resumes
+  (fail fast, recover slow — same hysteresis shape as the watchdog).
+* ``DRAINING (1)``   — probe went unhealthy/unreachable (or an operator
+  posted a drain): new dispatch stopped, in-flight frames get
+  ``router_drain_timeout_s`` to settle via the ack watermark.
+* ``DRAINED (0)``    — settled (window emptied) or timed out (window moved
+  to the requeue queue for redelivery to healthy peers — at-least-once).
+
+The **ack watermark**: the router counts lines dispatched per replica; the
+probe reads the replica's cumulative ``data_read_lines_total`` from its
+``/metrics``. Because each replica has exactly ONE feeder (this router —
+the tier topology guarantees it), the replica's read counter advancing by
+N lines acks the oldest N dispatched lines, so the head of the unacked
+window pops exactly. The baseline is captured at the first successful
+poll, which UNDER-acks anything the replica read before that poll — the
+safe direction: an under-acked frame is at worst redelivered (duplicate
+scoring), never silently dropped from the window (loss).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import urllib.error
+import urllib.request
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..engine import metrics as m
+
+STATE_DRAINED = 0
+STATE_DRAINING = 1
+STATE_RECOVERING = 2
+STATE_ACTIVE = 3
+STATE_NAMES = {
+    STATE_DRAINED: "drained",
+    STATE_DRAINING: "draining",
+    STATE_RECOVERING: "recovering",
+    STATE_ACTIVE: "active",
+}
+
+# consecutive healthy polls a recovering replica needs before dispatch
+# resumes (the watchdog's recover-slow default)
+RECOVERY_POLLS = 2
+
+
+def _fnv64(text: str) -> int:
+    value = 0xCBF29CE484222325
+    for byte in text.encode("utf-8"):
+        value = ((value ^ byte) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return value
+
+
+@dataclass
+class ProbeResult:
+    """One supervision poll of one replica."""
+
+    status: str                       # "healthy" | "degraded" | "unhealthy" | "unreachable"
+    detail: str = ""
+    backlog: Optional[float] = None   # replica's engine_ingress_backlog
+    read_lines: Optional[float] = None  # replica's cumulative data_read_lines_total
+    component_id: Optional[str] = None
+
+
+class Replica:
+    """One downstream replica: its socket, supervision state, and the
+    unacked credit window. All mutable fields are guarded by the OWNING
+    router's lock (``ReplicaRouter._lock``); metric children are resolved
+    once here so the dispatch hot path never calls ``.labels()``."""
+
+    def __init__(self, index: int, addr: str, admin_url: Optional[str],
+                 labels: dict, policy_name: str) -> None:
+        self.index = index
+        self.addr = addr
+        self.admin_url = admin_url.rstrip("/") if admin_url else None
+        self.id_hash = _fnv64(addr)          # rendezvous-hash identity
+        self.sock = None                     # engine thread only
+        self.state = STATE_ACTIVE
+        self.state_detail = "never probed"
+        self.backlog = 0.0
+        # unacked credit window: (lines, wire) FIFO; maxlen is enforced by
+        # the dispatchable() credit check, not the deque, so a full window
+        # backpressures instead of silently evicting unacked frames
+        self.window: deque = deque()
+        self.window_head_lines = 0.0     # cumulative lines of popped entries
+        self.sent_lines = 0.0            # cumulative lines dispatched
+        self.acked_lines = 0.0           # watermark-confirmed lines
+        self.read_base: Optional[float] = None  # replica counter at 1st poll
+        self.component_id: Optional[str] = None
+        self.frames_total = 0
+        self.requeued_total = 0
+        self.send_failures = 0
+        self.healthy_streak = 0
+        self.needs_redial = False
+        self.drain_deadline: Optional[float] = None
+        self.manual_drain = False
+        self._m_frames = m.ROUTER_FRAMES().labels(
+            replica=addr, policy=policy_name, **labels)
+        self._m_state = m.ROUTER_REPLICA_STATE().labels(replica=addr, **labels)
+        self._m_inflight = m.ROUTER_INFLIGHT().labels(replica=addr, **labels)
+        self._m_state.set(self.state)
+        self._m_inflight.set(0)
+
+    @property
+    def inflight(self) -> int:
+        """Unacked frames outstanding (the credit window's fill)."""
+        return len(self.window)
+
+    # -- accounting helpers (caller holds the router lock) ---------------
+    def note_sent(self, lines: int) -> None:
+        self.frames_total += 1
+        self.sent_lines += lines
+        self._m_frames.inc()
+        self._m_inflight.set(len(self.window))
+
+    def set_state(self, state: int, detail: str) -> None:
+        self.state = state
+        self.state_detail = detail
+        self._m_state.set(state)
+
+    def apply_watermark(self, read_lines: float) -> None:
+        """Advance the ack watermark from the replica's cumulative read
+        counter and pop fully-covered window heads."""
+        if self.read_base is None:
+            # first observation: everything read so far (ours or not) is
+            # the baseline — under-acks our pre-poll frames, the safe side
+            self.read_base = read_lines
+            return
+        if read_lines < self.read_base:
+            # counter reset (replica process restarted): re-anchor; frames
+            # in the window stay unacked and ride the drain/requeue path
+            self.read_base = read_lines - self.acked_lines
+        self.acked_lines = min(self.sent_lines,
+                               max(self.acked_lines,
+                                   read_lines - self.read_base))
+        while (self.window and self.window_head_lines + self.window[0][0]
+               <= self.acked_lines):
+            lines, _wire = self.window.popleft()
+            self.window_head_lines += lines
+        self._m_inflight.set(len(self.window))
+
+    def take_window(self):
+        """Move every unacked frame out (drain timeout): the caller
+        redelivers them to healthy peers."""
+        taken = list(self.window)
+        for lines, _wire in taken:
+            self.window_head_lines += lines
+        self.window.clear()
+        self.acked_lines = self.sent_lines
+        self._m_inflight.set(0)
+        return taken
+
+    def snapshot(self) -> dict:
+        return {
+            "addr": self.addr,
+            "admin_url": self.admin_url,
+            "state": STATE_NAMES[self.state],
+            "state_value": self.state,
+            "detail": self.state_detail,
+            "backlog": self.backlog,
+            "inflight": len(self.window),
+            "frames_total": self.frames_total,
+            "requeued_total": self.requeued_total,
+            "sent_lines": self.sent_lines,
+            "acked_lines": self.acked_lines,
+            "send_failures": self.send_failures,
+            "component_id": self.component_id,
+        }
+
+
+# -- the default HTTP probe --------------------------------------------------
+
+# one compiled matcher per poll loop, not per line: value rows of the two
+# series the probe reads off the replica's exposition
+_SERIES_ROW_RE = re.compile(
+    r'^(data_read_lines_total|engine_ingress_backlog)\{([^}]*)\}\s+([0-9.eE+-]+)',
+    re.M)
+_CID_RE = re.compile(r'component_id="([^"]*)"')
+
+
+class HttpProbe:
+    """Poll one replica's admin plane: deep health for the verdict, then a
+    ``/metrics`` read for the ack watermark + ingress backlog (filtered to
+    the replica's own ``component_id``, learned from the health report —
+    in-process fleets share one registry, so the filter is load-bearing)."""
+
+    def __init__(self, timeout_s: float = 2.0) -> None:
+        self._timeout = timeout_s
+
+    def __call__(self, replica: Replica) -> ProbeResult:
+        if not replica.admin_url:
+            return ProbeResult("healthy", "no admin_url: send-failure "
+                                          "supervision only")
+        try:
+            report = self._get_json(replica.admin_url
+                                    + "/admin/health?deep=1")
+        except urllib.error.HTTPError as exc:
+            # a 503 IS an answer: the body carries the failing-check report
+            try:
+                report = json.loads(exc.read())
+            except (json.JSONDecodeError, OSError):
+                return ProbeResult("unhealthy", f"deep health HTTP {exc.code}")
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            return ProbeResult("unreachable", str(exc))
+        status = str(report.get("state", "unknown"))
+        if status not in ("healthy", "degraded", "unhealthy"):
+            status = "unhealthy"
+        failing = [c.get("name", "?") for c in report.get("checks", [])
+                   if c.get("status") != "pass"]
+        detail = ", ".join(failing) if failing else "all checks passing"
+        cid = report.get("component_id") or replica.component_id
+        backlog, read_lines = self._watermark(replica, cid)
+        return ProbeResult(status, detail, backlog=backlog,
+                           read_lines=read_lines, component_id=cid)
+
+    def _get_json(self, url: str):
+        with urllib.request.urlopen(url, timeout=self._timeout) as resp:
+            return json.loads(resp.read())
+
+    def _watermark(self, replica: Replica, cid: Optional[str]):
+        if not cid:
+            return None, None
+        try:
+            with urllib.request.urlopen(replica.admin_url + "/metrics",
+                                        timeout=self._timeout) as resp:
+                text = resp.read().decode("utf-8", errors="replace")
+        except (urllib.error.URLError, OSError, TimeoutError):
+            return None, None
+        backlog = read_lines = None
+        for name, labels, value in _SERIES_ROW_RE.findall(text):
+            cid_match = _CID_RE.search(labels)
+            if cid_match is None or cid_match.group(1) != cid:
+                continue
+            if name == "engine_ingress_backlog":
+                backlog = float(value)
+            else:
+                read_lines = (read_lines or 0.0) + float(value)
+        return backlog, read_lines
+
+
+class ReplicaSupervisor(threading.Thread):
+    """The polling thread: probe every replica each interval and hand the
+    results to ``router.apply_probe`` (which owns the state machine). A
+    probe that raises is itself an ``unreachable`` verdict — the supervisor
+    must outlive a misbehaving replica admin plane."""
+
+    def __init__(self, router, interval_s: float,
+                 probe: Optional[Callable[[Replica], ProbeResult]] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
+        super().__init__(name="ReplicaSupervisor", daemon=True)
+        self._router = router
+        self._interval = interval_s
+        self._probe = probe or HttpProbe(timeout_s=min(2.0, interval_s))
+        self._logger = logger or logging.getLogger("router.supervisor")
+        self._halt = threading.Event()
+
+    def poll_once(self) -> None:
+        for replica in self._router.replicas:
+            try:
+                result = self._probe(replica)
+            except Exception as exc:  # noqa: BLE001 — probe crash == unreachable
+                result = ProbeResult("unreachable", f"probe crashed: {exc!r}")
+            self._router.apply_probe(replica, result)
+        self._router.process_drains()
+
+    def run(self) -> None:
+        # dmlint: hot-loop
+        while not self._halt.wait(self._interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — supervision must not die silently
+                self._logger.exception("replica supervision poll failed")
+
+    def stop(self) -> None:
+        self._halt.set()
+        if self.is_alive():
+            self.join(timeout=5.0)

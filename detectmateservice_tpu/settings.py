@@ -259,6 +259,41 @@ class ServiceSettings(BaseModel):
     coordinator_address: Optional[str] = None  # "host:port"
     num_processes: int = Field(default=1, ge=1)
     process_id: int = Field(default=0, ge=0)
+    # -- replica-parallel serving tier (router/, PR 9) --------------------
+    # Non-empty turns this stage into a REPLICA ROUTER: instead of
+    # duplicating every outgoing frame to all ``out_addr`` peers, each frame
+    # is load-balanced to exactly ONE of these downstream replica addresses
+    # (the PAPER §0/§7 production topology: one parser feeding a tier of
+    # detector processes). Mutually exclusive with ``out_addr`` — a router
+    # routes, it does not also fan out.
+    router_replicas: List[TransportAddr] = Field(default_factory=list)
+    # Admin-plane URL per replica, parallel to router_replicas (same length
+    # or empty). With URLs the supervisor polls each replica's deep health
+    # (GET /admin/health?deep=1) and ingest watermark (/metrics) to drive
+    # drain/undrain and the least_backlog policy; without them health is
+    # inferred from send failures only (no proactive drain).
+    router_admin_urls: List[str] = Field(default_factory=list)
+    # balancing policy: least_backlog routes to the replica with the fewest
+    # unacked frames + lowest polled ingress backlog; round_robin rotates;
+    # sticky_trace rendezvous-hashes the PR-1 trace id so one source's
+    # frames keep per-source ordering on a single replica while it stays
+    # healthy.
+    router_policy: str = Field(default="least_backlog",
+                               pattern="^(least_backlog|round_robin|sticky_trace)$")
+    # drain window: a replica whose probe goes unhealthy/unreachable stops
+    # receiving new frames immediately; after this many seconds its still-
+    # unacked frames are requeued to healthy peers (at-least-once — a frame
+    # the dead replica did process may be scored twice; duplicates are
+    # harmless to detection, loss is not).
+    router_drain_timeout_s: float = Field(default=5.0, ge=0.0, le=600.0)
+    # credit window: max unacked frames outstanding per replica. Acks ride
+    # the supervisor's watermark poll (the replica's data_read_lines_total
+    # covering the window head); a full window removes the replica from
+    # dispatch until credit frees — per-replica flow control.
+    router_credit_window: int = Field(default=64, ge=1, le=8192)
+    # supervisor poll cadence (deep health + watermark per replica)
+    router_health_interval_s: float = Field(default=2.0, ge=0.05, le=300.0)
+
     # -- self-diagnosis (engine/health.py) --------------------------------
     # "json" renders every log record as one JSON object per line (component
     # identity + message + attached structured event), for fleet log
@@ -306,6 +341,22 @@ class ServiceSettings(BaseModel):
                 f"({self.watchdog_unhealthy_seconds} < {self.watchdog_stall_seconds})")
         return self
 
+    # -- router cross-validation ------------------------------------------
+    @model_validator(mode="after")
+    def _check_router(self) -> "ServiceSettings":
+        if self.router_replicas and self.out_addr:
+            raise ValueError(
+                "router_replicas and out_addr are mutually exclusive: a "
+                "router load-balances each frame to ONE replica; plain "
+                "fan-out duplicates to every out_addr")
+        if (self.router_admin_urls
+                and len(self.router_admin_urls) != len(self.router_replicas)):
+            raise ValueError(
+                "router_admin_urls must be empty or match router_replicas "
+                f"1:1 ({len(self.router_admin_urls)} urls for "
+                f"{len(self.router_replicas)} replicas)")
+        return self
+
     # -- TLS cross-validation (reference: settings.py:116-132) ------------
     @model_validator(mode="after")
     def _check_tls(self) -> "ServiceSettings":
@@ -322,6 +373,10 @@ class ServiceSettings(BaseModel):
             raise ValueError("an engine_ingress_addr uses a TLS scheme but tls_input is not configured")
         if any(a.startswith(tls_schemes) for a in self.out_addr) and self.tls_output is None:
             raise ValueError("an out_addr uses a TLS scheme but tls_output is not configured")
+        if (any(a.startswith(tls_schemes) for a in self.router_replicas)
+                and self.tls_output is None):
+            raise ValueError("a router_replicas address uses a TLS scheme "
+                             "but tls_output is not configured")
         return self
 
     # -- loading -----------------------------------------------------------

@@ -121,6 +121,14 @@ def _xla(service, query, payload) -> Response:
     return Response(200, snapshot)
 
 
+def _replicas(service, query, payload) -> Response:
+    router = getattr(service.engine, "router", None)
+    if router is None:
+        return Response(404, {"detail": "this stage is not a replica "
+                                        "router (router_replicas not set)"})
+    return Response(200, router.snapshot())
+
+
 def _load_status(service, query, payload) -> Response:
     from ..loadgen.generator import LOADGEN
 
@@ -226,6 +234,27 @@ def _load_control(service, query, payload) -> Response:
         return Response(409, {"detail": str(exc)})
 
 
+def _replicas_control(service, query, payload) -> Response:
+    router = getattr(service.engine, "router", None)
+    if router is None:
+        return Response(404, {"detail": "this stage is not a replica "
+                                        "router (router_replicas not set)"})
+    payload = payload or {}
+    action = str(payload.get("action", ""))
+    addr = payload.get("replica")
+    if action not in ("drain", "undrain"):
+        raise ValueError(f"unknown action {action!r} "
+                         "(expected 'drain' or 'undrain')")
+    if not addr:
+        raise ValueError("replica (the configured replica address) "
+                         "is required")
+    # ValueError from an unknown address surfaces as HTTP 400 with the
+    # configured address list in the detail — the router raises it
+    verb = router.drain if action == "drain" else router.undrain
+    return Response(200, {"detail": f"{action} applied",
+                          "replica": verb(str(addr))})
+
+
 # one row per route; dmlint DM-C007/8 keeps this table and the route table
 # in docs/usage.md synchronized in both directions
 ROUTES: Tuple[Route, ...] = (
@@ -242,6 +271,8 @@ ROUTES: Tuple[Route, ...] = (
           "live SLO scorecard of the open-loop load run"),
     Route("GET", "/admin/profile/latest", _profile_latest,
           "download the newest completed capture as a zip"),
+    Route("GET", "/admin/replicas", _replicas,
+          "replica-router roll-up: per-replica state/backlog/inflight"),
     Route("POST", "/admin/start", _start, "start the engine"),
     Route("POST", "/admin/stop", _stop, "stop the engine"),
     Route("POST", "/admin/shutdown", _shutdown, "shut the service down"),
@@ -253,6 +284,8 @@ ROUTES: Tuple[Route, ...] = (
           "start an on-demand jax.profiler capture"),
     Route("POST", "/admin/load", _load_control,
           "start/stop an open-loop load run against a pipeline"),
+    Route("POST", "/admin/replicas", _replicas_control,
+          "operator drain/undrain of one replica"),
 )
 
 

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""CI replica-smoke: the router tier end to end on CPU, in-process, < 90 s.
+
+Topology (inproc sockets, one process):
+
+    feeder → MatcherParser → router stage → 2 detector replicas → collector
+
+The replicas run the deterministic DummyDetector (pattern ``[True]``: every
+parsed row emits, so delivery accounting is exact) — the full JaxScorer
+replica path is the soak harness's ``replica_kill`` scenario; this smoke
+gates the ROUTER mechanics fast:
+
+1. balanced dispatch: both replicas serve traffic, everything lands;
+2. kill one replica mid-stream — engine stopped first (frames pile up
+   unacked in its ingress), then its admin plane (the supervisor's probe
+   goes unreachable) — and assert, within the supervision interval:
+   a ``replica_drain`` event in ``/admin/events``,
+   ``router_requeue_total > 0`` (the unacked frames were redelivered), and
+   ZERO unique-row loss end to end (duplicates allowed: requeue is
+   at-least-once);
+3. restart the replica and assert it returns to ``active`` (re-dial +
+   clean-poll hysteresis) and serves traffic again.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+AUDIT_LOG_FORMAT = "type=<Type> msg=audit(<Time>): <Content>"
+AUDIT_TEMPLATE = ("arch=<*> syscall=<*> success=<*> exit=<*> pid=<*> "
+                  "uid=<*> comm=<*> exe=<*>")
+
+
+def http_json(url, method="GET", payload=None):
+    body = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def wait_until(predicate, timeout, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def main() -> int:
+    import tempfile
+
+    from detectmateservice_tpu.core import Service
+    from detectmateservice_tpu.engine.socket import (
+        InprocQueueSocketFactory,
+        TransportError,
+        TransportTimeout,
+    )
+    from detectmateservice_tpu.settings import ServiceSettings
+
+    t0 = time.monotonic()
+    checks = []
+
+    def check(name, ok, detail=""):
+        checks.append(ok)
+        print(f"[replica-smoke] {'PASS' if ok else 'FAIL'} {name}"
+              + (f": {detail}" if detail else ""))
+        return ok
+
+    common = dict(log_to_console=False, log_to_file=False, http_port=0,
+                  engine_recv_timeout=20, watchdog_interval_s=0.5)
+    factory = InprocQueueSocketFactory(maxsize=65536)
+    collector = factory.create("inproc://smoke-collector")
+    collector.recv_timeout = 50
+
+    with tempfile.TemporaryDirectory() as tmp:
+        templates = Path(tmp) / "templates.txt"
+        templates.write_text(AUDIT_TEMPLATE + "\n", encoding="utf-8")
+        parser_cfg = {"parsers": {"MatcherParser": {
+            "method_type": "matcher_parser", "auto_config": False,
+            "log_format": AUDIT_LOG_FORMAT, "accept_raw_lines": True,
+            "params": {"path_templates": str(templates)}}}}
+        detector_cfg = {"detectors": {"DummyDetector": {
+            "method_type": "dummy_detector", "auto_config": False,
+            "pattern": [True]}}}
+
+        replicas = []
+        admin_urls = []
+        for i in range(2):
+            settings = ServiceSettings(
+                component_type="testing.dummy_detector.DummyDetector",
+                component_id=f"smoke-replica-{i}",
+                engine_addr=f"inproc://smoke-rep-{i}",
+                out_addr=["inproc://smoke-collector"], **common)
+            service = Service(settings, component_config=detector_cfg,
+                              socket_factory=factory)
+            service.setup_io()
+            service.web_server.start()
+            service.start()
+            replicas.append(service)
+            admin_urls.append(f"http://127.0.0.1:{service.web_server.port}")
+
+        router_settings = ServiceSettings(
+            component_type="core", component_id="smoke-router",
+            engine_addr="inproc://smoke-router",
+            router_replicas=[f"inproc://smoke-rep-{i}" for i in range(2)],
+            router_admin_urls=admin_urls,
+            router_health_interval_s=0.3, router_drain_timeout_s=1.0,
+            **common)
+        router_service = Service(router_settings, socket_factory=factory)
+        router_service.web_server.start()
+        router_service.start()
+        router_url = f"http://127.0.0.1:{router_service.web_server.port}"
+
+        parser_settings = ServiceSettings(
+            component_type="parsers.template_matcher.MatcherParser",
+            component_id="smoke-parser",
+            engine_addr="inproc://smoke-parser",
+            out_addr=["inproc://smoke-router"], **common)
+        parser_service = Service(parser_settings,
+                                 component_config=parser_cfg,
+                                 socket_factory=factory)
+        parser_service.setup_io()
+        parser_service.web_server.start()
+        parser_service.start()
+
+        services = [parser_service, router_service, *replicas]
+        from detectmateservice_tpu.schemas import schemas_pb2 as pb
+
+        feeder = factory.create_output("inproc://smoke-parser")
+        received = set()
+
+        def pump():
+            """Collect the set of ROW IDS seen at the sink — each row's id
+            rides ``audit(<id>)`` into DetectorSchema.extractedTimestamps.
+            Requeue is at-least-once, so duplicates are expected and only a
+            MISSING id is loss (the soak scorecard's accounting shape)."""
+            while True:
+                try:
+                    frame = collector.recv()
+                except (TransportTimeout, TransportError):
+                    return
+                alert = pb.DetectorSchema()
+                try:
+                    alert.ParseFromString(frame)
+                except Exception:
+                    continue
+                if alert.extractedTimestamps:
+                    received.add(int(alert.extractedTimestamps[0]))
+
+        def row(i: int) -> bytes:
+            return (f"type=SYSCALL msg=audit({i}): arch=c000003e "
+                    f"syscall=59 success=yes exit=0 pid={i} uid=0 "
+                    f"comm=cat exe=/usr/bin/cat\n").encode()
+
+        try:
+            # -- phase 1: balanced delivery ------------------------------
+            for i in range(40):
+                feeder.send(row(i))
+            ok = wait_until(lambda: pump() or len(received) >= 40, 30)
+            check("balanced_delivery", ok, f"{len(received)}/40 unique rows")
+            _, snap = http_json(router_url + "/admin/replicas")
+            spread = [r["frames_total"] for r in snap["replicas"]]
+            check("both_replicas_served", all(n > 0 for n in spread),
+                  f"frames per replica: {spread}")
+
+            # -- phase 2: kill replica 1 mid-stream ----------------------
+            victim = replicas[1]
+            victim.stop()               # frames now pile up unacked...
+            for i in range(40, 80):
+                feeder.send(row(i))
+            time.sleep(1.0)             # let dispatch reach the dead queue
+            victim.web_server.stop()    # ...and the probe goes unreachable
+
+            drained = wait_until(lambda: any(
+                r["state"] != "active" for r in
+                http_json(router_url + "/admin/replicas")[1]["replicas"]),
+                10)
+            check("drain_within_supervision_interval", drained)
+            requeued = wait_until(lambda: http_json(
+                router_url + "/admin/replicas")[1]["requeue_total"] > 0, 15)
+            _, snap = http_json(router_url + "/admin/replicas")
+            check("requeue_happened", requeued,
+                  f"requeue_total={snap['requeue_total']}")
+            ok = wait_until(lambda: pump() or len(received) >= 80, 30)
+            check("zero_loss_through_kill", ok,
+                  f"{len(received)}/80 unique rows")
+            _, events = http_json(router_url + "/admin/events")
+            kinds = [e.get("kind") for e in events["events"]]
+            check("drain_event_emitted", "replica_drain" in kinds,
+                  f"event kinds: {sorted(set(kinds))}")
+
+            # -- phase 3: recovery ---------------------------------------
+            victim.web_server.start()
+            victim.start()
+            # ephemeral port changed on restart: re-point the supervisor
+            # (real deployments use stable admin addresses)
+            router_service.engine.router.replicas[1].admin_url = (
+                f"http://127.0.0.1:{victim.web_server.port}")
+            recovered = wait_until(lambda: all(
+                r["state"] == "active" for r in
+                http_json(router_url + "/admin/replicas")[1]["replicas"]),
+                20)
+            check("replica_recovered", recovered)
+            for i in range(80, 100):
+                feeder.send(row(i))
+            ok = wait_until(lambda: pump() or len(received) >= 100, 30)
+            check("post_recovery_delivery", ok,
+                  f"{len(received)}/100 unique rows")
+        finally:
+            for service in services:
+                for step in (service.stop, service.health.stop,
+                             service.web_server.stop):
+                    try:
+                        step()
+                    except Exception:
+                        pass
+
+    elapsed = time.monotonic() - t0
+    ok = all(checks)
+    print(f"[replica-smoke] {'PASS' if ok else 'FAIL'} "
+          f"({len(checks)} checks, {elapsed:.0f}s)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

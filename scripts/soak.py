@@ -3,7 +3,9 @@
 
 Boots the full PAPER.md §0 pipeline IN PROCESS — loadgen (the reader role)
 → MatcherParser → JaxScorerDetector → OutputWriter → scorecard collector —
-over inproc sockets, drives it with wall-clock-scheduled open-loop traffic
+over inproc sockets (the ``replica_kill`` scenario swaps the single
+detector for the REAL replica tier: parser → router → 2 scorer replicas,
+``boot_replica_pipeline``), drives it with wall-clock-scheduled open-loop traffic
 from the shared corpus (audit rows, JSON ``@type`` reroute, invalid UTF-8),
 scrapes ``/metrics`` once a second into a sample store, and evaluates the
 *actual* ``ops/alerts.yml`` expressions against it (loadgen/alerteval.py).
@@ -54,9 +56,13 @@ SCENARIOS = {
     "recompile": (("RecompileStorm",),
                   "post-warm-up dispatch compiles injected into the XLA "
                   "ledger"),
-    "replica_kill": (("StageScrapeDown",),
-                     "detector replica stopped cold mid-stream, then "
-                     "restarted through the admin verb"),
+    "replica_kill": (("StageScrapeDown", "ReplicaDrainedSustained"),
+                     "one of two scorer replicas behind the REAL router "
+                     "tier wedges, dies cold mid-load (engine stopped, "
+                     "admin plane gone), and is restarted; gates: the "
+                     "router's replica_drain event, requeue_total > 0, "
+                     "post-settle loss == 0, survivors' unexpected "
+                     "recompiles == 0"),
 }
 
 AUDIT_LOG_FORMAT = "type=<Type> msg=audit(<Time>): <Content>"
@@ -138,6 +144,54 @@ def boot_pipeline(tmp: Path, factory, burst: int):
         service.start()
         services.append(service)
     return services
+
+
+def boot_replica_pipeline(tmp: Path, factory, burst: int,
+                          n_replicas: int = 2):
+    """The replica-tier topology for the ``replica_kill`` scenario:
+    parser → ROUTER → N scorer replicas → one output stage. Replicas boot
+    first so the router's supervisor can be given their (ephemeral) admin
+    URLs; every stage keeps the uniform-frame settings that make the FIFO
+    trace attachment exact. Returns ``[parser, router, *replicas,
+    output]``."""
+    from detectmateservice_tpu.core import Service
+    from detectmateservice_tpu.settings import ServiceSettings
+
+    base = build_settings(tmp, burst)
+    (parser_settings, parser_cfg) = base[0]
+    (detector_settings, detector_cfg) = base[1]
+    (output_settings, output_cfg) = base[2]
+
+    def boot(settings, config):
+        service = Service(settings, component_config=config,
+                          socket_factory=factory)
+        service.setup_io()
+        service.web_server.start()
+        service.start()
+        return service
+
+    output = boot(output_settings, output_cfg)
+    replicas = []
+    for i in range(n_replicas):
+        settings = detector_settings.model_copy(update=dict(
+            component_id=f"soak-detector-{i}",
+            engine_addr=f"inproc://soak-detector-{i}"))
+        replicas.append(boot(settings, detector_cfg))
+    router_settings = ServiceSettings(
+        component_type="core", component_id="soak-router",
+        trace_stage="router", engine_addr="inproc://soak-router",
+        router_replicas=[r.settings.engine_addr for r in replicas],
+        router_admin_urls=[f"http://127.0.0.1:{r.web_server.port}"
+                           for r in replicas],
+        router_health_interval_s=1.0, router_drain_timeout_s=5.0,
+        http_port=0, log_to_file=False, log_to_console=False,
+        engine_trace=True, backend="cpu",
+        engine_batch_size=max(512, 2 * burst), engine_batch_timeout_ms=5.0,
+        engine_frame_batch=burst, engine_recv_timeout=50)
+    router = boot(router_settings, None)
+    parser = boot(parser_settings.model_copy(update=dict(
+        out_addr=["inproc://soak-router"])), parser_cfg)
+    return [parser, router, *replicas, output]
 
 
 def teardown_pipeline(services) -> None:
@@ -248,7 +302,7 @@ def main() -> int:
     # per-scenario fault/scale defaults: each fault must outlive its rule's
     # (scaled) detection horizon — threshold crossing + for: hold
     fault_defaults = {"none": 0.0, "stall": 45.0, "slow_sink": 45.0,
-                      "recompile": 8.0, "replica_kill": 30.0}
+                      "recompile": 8.0, "replica_kill": 40.0}
     scale_defaults = {"none": 6.0, "stall": 6.0, "slow_sink": 12.0,
                       "recompile": 6.0, "replica_kill": 12.0}
     fault_s = (args.fault_seconds if args.fault_seconds is not None
@@ -323,7 +377,10 @@ def main() -> int:
     }
 
     with tempfile.TemporaryDirectory() as tmp:
-        services = boot_pipeline(Path(tmp), factory, args.burst)
+        if args.scenario == "replica_kill":
+            services = boot_replica_pipeline(Path(tmp), factory, args.burst)
+        else:
+            services = boot_pipeline(Path(tmp), factory, args.burst)
         scraper = Scraper(store, evaluator, services)
         generator = None
         stall_flag = threading.Event()
@@ -340,12 +397,20 @@ def main() -> int:
             from detectmateservice_tpu.engine import device_obs
             from detectmateservice_tpu.engine import metrics as m
 
-            warm_rows = training_preamble(6 * args.burst)
+            # replica mode: the warm traffic splits across N replicas and
+            # EVERY replica must see enough rows to train + calibrate
+            n_replicas = sum(1 for s in services
+                             if s.settings.component_id.startswith(
+                                 "soak-detector"))
+            warm_rows = training_preamble(6 * args.burst
+                                          * max(1, n_replicas))
             ingress = factory.create_output("inproc://soak-parser")
             for start in range(0, len(warm_rows), args.burst):
                 ingress.send(pack_batch(warm_rows[start:start + args.burst]))
+            out_service = next(s for s in services
+                               if s.settings.component_id == "soak-output")
             out_labels = dict(
-                component_type=services[2].settings.component_type,
+                component_type=out_service.settings.component_type,
                 component_id="soak-output")
             written = m.DATA_WRITTEN_LINES().labels(**out_labels)
             ledger = device_obs.get_ledger()
@@ -426,9 +491,30 @@ def main() -> int:
                     inject_recompiles()
                     time.sleep(max(0.0, fault_s - 2.0))
                 elif args.scenario == "replica_kill":
-                    services[1].stop()
-                    time.sleep(fault_s)
-                    services[1].start()
+                    # victim = the last replica behind the REAL router.
+                    # Wedge first (engine stopped, admin plane still up):
+                    # dispatched frames pile up unacked in its ingress —
+                    # the state a dying process leaves behind. Then the
+                    # admin plane goes too and the supervisor's probe
+                    # turns unreachable → drain → deadline requeue.
+                    router_service = services[1]
+                    victim = next(
+                        s for s in reversed(services)
+                        if s.settings.component_id.startswith(
+                            "soak-detector"))
+                    victim_pos = router_service.settings.router_replicas \
+                        .index(victim.settings.engine_addr)
+                    victim.stop()
+                    time.sleep(5.0)      # bank unacked frames on the victim
+                    victim.web_server.stop()
+                    time.sleep(max(0.0, fault_s - 5.0))
+                    victim.web_server.start()
+                    victim.start()
+                    # http_port=0 re-binds an ephemeral port on restart:
+                    # re-point the supervisor (deployments use stable URLs)
+                    router_service.engine.router.replicas[victim_pos] \
+                        .admin_url = (f"http://127.0.0.1:"
+                                      f"{victim.web_server.port}")
                 fault_held_s = time.monotonic() - fault_t0
                 generator.wait(timeout=lead_s + fault_s + tail_s
                                + fault_s + 60.0 + 60.0)
@@ -446,6 +532,35 @@ def main() -> int:
                       chaos["scorecard"]["received_frames"] > 0,
                       f"received {chaos['scorecard']['received_frames']} "
                       "frames across the chaos window")
+                if args.scenario == "replica_kill":
+                    # the router-tier contract, gated by execution: the
+                    # drain was observed, the victim's unacked frames were
+                    # redelivered, nothing was lost after the settle
+                    # window, and the survivors' warm compile set held
+                    router_service = services[1]
+                    snap = router_service.engine.router.snapshot()
+                    record["router"] = snap
+                    check("router_requeue_positive",
+                          snap["requeue_total"] > 0,
+                          f"router_requeue_total={snap['requeue_total']}")
+                    kinds = [e.get("kind") for e in
+                             router_service.events.snapshot()["events"]]
+                    check("replica_drain_event_emitted",
+                          "replica_drain" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("post_settle_loss_zero",
+                          chaos["scorecard"]["loss"] == 0,
+                          f"loss={chaos['scorecard']['loss']} of "
+                          f"{chaos['scorecard']['sent_frames']} frames")
+                    ledger_doc = device_obs.get_ledger().snapshot()
+                    unexpected = ledger_doc["totals"]["unexpected"]
+                    record["xla_unexpected"] = [
+                        c for c in ledger_doc.get("compiles", [])
+                        if c.get("unexpected")]
+                    check("no_unexpected_recompiles_on_survivors",
+                          unexpected == 0,
+                          f"scorer_xla_recompiles_unexpected_total="
+                          f"{unexpected}")
         finally:
             if generator is not None:
                 try:

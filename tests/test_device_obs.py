@@ -325,13 +325,30 @@ class TestScorerServiceEndToEnd:
         assert snap["totals"]["compiles"] >= 2  # warm set compiled for real
         assert all(not e["unexpected"] for e in snap["compiles"])
 
-        # injected recompile: bucket 4 is NOT in the warm set {1, 8, 16} —
-        # this dispatch pays a real XLA compile on the dispatch path
+        # cold-bucket dispatch: bucket 4 is NOT in the warm set {1, 8, 16}.
+        # Since the replica-tier round this is PLANNED warm-set growth —
+        # the dispatch path pre-warms the bucket under an expected
+        # bucket_warm context (a real XLA compile, but never a page): a
+        # tier splitting traffic must not recompile-page every replica
+        # whose natural batch size the setup warm-up didn't see.
         unexpected_before = snap["totals"]["unexpected"]
         tokens = np.zeros((3, 8), np.int32)
         det._dispatch(tokens, [b"a", b"b", b"c"])
         det.flush()
 
+        snap = ledger.snapshot()
+        assert snap["totals"]["unexpected"] == unexpected_before
+        warm_growth = [e for e in snap["compiles"]
+                       if e["bucket"] == "4" and e["where"] == "bucket_warm"]
+        assert warm_growth and not warm_growth[-1]["unexpected"]
+
+        # a TRUE unexpected recompile — a compile of a bucket the scorer
+        # believes warm (cache invalidation, the storm class) — drives the
+        # event/health/alert plumbing end to end via the ledger's
+        # injection seam (the same seam scripts/soak.py's `recompile`
+        # scenario uses)
+        ledger.record_compile(0.2, bucket=4, backend="cpu",
+                              where="dispatch", expected=False)
         snap = ledger.snapshot()
         assert snap["totals"]["unexpected"] == unexpected_before + 1
         flagged = [e for e in snap["compiles"] if e["unexpected"]]
