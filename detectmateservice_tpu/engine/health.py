@@ -285,6 +285,11 @@ class HealthMonitor:
         self._events = events
         self._logger = logger
         self.trace_recorder = None  # FlightRecorder, attached by the Service
+        # wall-clock start time, reported as ``started_unix``: a restart
+        # signal for pollers (the replica router re-anchors its ack
+        # watermark when this changes — cumulative counters reset with the
+        # process, and monotonicity alone cannot catch a fast restart)
+        self._started_unix = round(time.time(), 3)
 
         self._lock = threading.Lock()
         self._heartbeats: Dict[str, Heartbeat] = {}
@@ -411,6 +416,7 @@ class HealthMonitor:
                 "stage": self._stage,
                 "component_type": self._labels.get("component_type"),
                 "component_id": self._labels.get("component_id"),
+                "started_unix": self._started_unix,
                 "checks": results,
                 "heartbeat_age_seconds": ages,
             }

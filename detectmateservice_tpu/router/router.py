@@ -20,7 +20,8 @@ Threading contract (mirrors the engine's own design):
 
 All shared replica state is guarded by ``self._lock``; socket sends happen
 strictly outside it. Structured events (``replica_drain`` /
-``replica_drained`` / ``replica_recovering`` / ``replica_undrain``) are
+``replica_drained`` / ``replica_recovering`` / ``replica_restarted`` /
+``replica_undrain``) are
 collected under the lock and emitted after release through the service's
 ``HealthMonitor.emit_event`` — the same ring ``/admin/events`` serves.
 """
@@ -164,8 +165,16 @@ class ReplicaRouter:
                 tried.add(choice.index)
                 continue
             with self._lock:
-                choice.window.append((lines, wire))
-                choice.note_sent(lines)
+                if choice.state in (STATE_ACTIVE, STATE_DRAINING):
+                    choice.window.append((lines, wire))
+                    choice.note_sent(lines)
+                else:
+                    # the supervisor settled this replica between our send
+                    # and this append (DRAINING→DRAINED on an empty window,
+                    # or a recovery took the window): a frame parked in a
+                    # settled window is never requeued — queue it for
+                    # redelivery instead (a duplicate beats a loss)
+                    self._requeue.append((lines, wire))
             return True
 
     def tick(self) -> None:
@@ -183,7 +192,10 @@ class ReplicaRouter:
             old_sock = None
             try:
                 sock = self._dial(replica.addr)
-            except TransportError as exc:
+            except Exception as exc:  # noqa: BLE001 — tick() runs unguarded
+                # on the engine hot loop: ANY dial failure (TransportError,
+                # a ValueError on a bad address, raw OSError variants) must
+                # retry next tick, not kill the EngineLoop thread
                 self.logger.warning("re-dial of replica %s failed: %s "
                                     "(will retry)", replica.addr, exc)
                 continue
@@ -233,11 +245,17 @@ class ReplicaRouter:
                 continue
             with self._lock:
                 self._requeue.popleft()
-                choice.window.append((lines, wire))
-                choice.note_sent(lines)
-                choice.requeued_total += 1
-                self._requeue_total += 1
-                self._m_requeue.inc()
+                if choice.state in (STATE_ACTIVE, STATE_DRAINING):
+                    choice.window.append((lines, wire))
+                    choice.note_sent(lines)
+                    choice.requeued_total += 1
+                    self._requeue_total += 1
+                    self._m_requeue.inc()
+                else:
+                    # supervisor settled the replica between send and
+                    # append — keep the frame queued (the wire copy may
+                    # still land: at-least-once tolerates the duplicate)
+                    self._requeue.append((lines, wire))
 
     def close(self) -> None:
         if self._supervisor is not None:
@@ -259,24 +277,53 @@ class ReplicaRouter:
                 replica.backlog = float(result.backlog)
             if result.component_id:
                 replica.component_id = result.component_id
+            if result.started_unix is not None:
+                if (replica.started_unix is not None
+                        and result.started_unix != replica.started_unix):
+                    # the replica process restarted between polls — even if
+                    # its new read counter already exceeds the old baseline
+                    # (so counter monotonicity alone cannot see it). Frames
+                    # in flight at the restart are gone: requeue the whole
+                    # window and re-baseline the watermark before applying
+                    # this poll's reading (duplicates possible, loss not).
+                    taken = replica.note_restart()
+                    self._requeue.extend(taken)
+                    events.append(self._event(
+                        "replica_restarted", replica, requeued=len(taken),
+                        detail="replica restart observed between polls; "
+                               "watermark re-anchored"))
+                replica.started_unix = result.started_unix
             if result.read_lines is not None:
                 replica.apply_watermark(float(result.read_lines))
+            # "degraded" is advisory, not a drain signal: deep health
+            # reports it for transient/benign conditions (output briefly
+            # blocked, loop beat lag, ingest stall — which a DRAINED
+            # replica exhibits by construction, since it receives no
+            # traffic). It neither drains nor blocks recovery; only
+            # "unhealthy"/"unreachable" drain.
+            dispatchable = result.status in ("healthy", "degraded")
             if replica.manual_drain:
                 # the operator owns the state; the watermark above still
                 # advances so an operator drain settles cleanly
                 replica.state_detail = (f"operator drain "
                                         f"(probe: {result.status})")
-            elif result.status == "healthy":
+            elif dispatchable:
                 replica.healthy_streak += 1
                 if replica.state in (STATE_DRAINING, STATE_DRAINED):
+                    # at-least-once: the re-dial below closes the old
+                    # socket (dropping any frames buffered in it), and a
+                    # restarted replica re-anchors the watermark — so the
+                    # unacked window must be requeued NOW, not kept
+                    taken = replica.take_window()
+                    self._requeue.extend(taken)
                     replica.set_state(STATE_RECOVERING,
-                                      "probe healthy again; re-dialing")
+                                      "probe dispatchable again; re-dialing")
                     replica.healthy_streak = 1
                     replica.drain_deadline = None
                     replica.needs_redial = True
                     events.append(self._event(
-                        "replica_recovering", replica,
-                        detail="probe healthy; awaiting re-dial + "
+                        "replica_recovering", replica, requeued=len(taken),
+                        detail=f"probe {result.status}; awaiting re-dial + "
                                f"{RECOVERY_POLLS} clean polls"))
                 elif (replica.state == STATE_RECOVERING
                         and replica.healthy_streak >= RECOVERY_POLLS
@@ -287,7 +334,10 @@ class ReplicaRouter:
                     events.append(self._event("replica_undrain", replica,
                                               detail="dispatch resumed"))
                 elif replica.state == STATE_ACTIVE:
-                    replica.state_detail = result.detail or "healthy"
+                    replica.state_detail = (
+                        (result.detail or "healthy")
+                        if result.status == "healthy"
+                        else f"degraded: {result.detail}")
             else:
                 replica.healthy_streak = 0
                 if replica.state in (STATE_ACTIVE, STATE_RECOVERING):
@@ -369,12 +419,17 @@ class ReplicaRouter:
             replica.manual_drain = False
             replica.healthy_streak = 0
             if replica.state in (STATE_DRAINED, STATE_DRAINING):
+                # same at-least-once rule as probe-driven recovery: the
+                # re-dial drops the old socket's buffered frames, so the
+                # unacked window is requeued rather than kept
+                taken = replica.take_window()
+                self._requeue.extend(taken)
                 replica.set_state(STATE_RECOVERING,
                                   "operator undrain; re-dialing")
                 replica.drain_deadline = None
                 replica.needs_redial = True
                 events.append(self._event(
-                    "replica_recovering", replica,
+                    "replica_recovering", replica, requeued=len(taken),
                     detail="operator undrain; awaiting re-dial"))
         self._emit(events)
         with self._lock:

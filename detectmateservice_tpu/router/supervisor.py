@@ -13,13 +13,18 @@ Thread topology (the part that keeps the router's lock discipline simple):
 States (exported as the ``router_replica_state`` gauge):
 
 * ``ACTIVE (3)``     — dispatchable.
-* ``RECOVERING (2)`` — probe healthy again after a drain; the engine
-  re-dials the replica and it must stay healthy for
-  ``RECOVERY_POLLS`` consecutive polls before dispatch resumes
-  (fail fast, recover slow — same hysteresis shape as the watchdog).
+* ``RECOVERING (2)`` — probe dispatchable again after a drain; whatever
+  was still unacked is requeued at this transition (the re-dial drops the
+  old socket's buffered frames — at-least-once), the engine re-dials the
+  replica, and it must stay dispatchable for ``RECOVERY_POLLS``
+  consecutive polls before dispatch resumes (fail fast, recover slow —
+  same hysteresis shape as the watchdog).
 * ``DRAINING (1)``   — probe went unhealthy/unreachable (or an operator
   posted a drain): new dispatch stopped, in-flight frames get
-  ``router_drain_timeout_s`` to settle via the ack watermark.
+  ``router_drain_timeout_s`` to settle via the ack watermark. A merely
+  "degraded" probe never drains — deep health reports degraded for
+  transient/benign conditions (and a drained replica is ingest-stalled
+  by construction), so degraded counts as dispatchable throughout.
 * ``DRAINED (0)``    — settled (window emptied) or timed out (window moved
   to the requeue queue for redelivery to healthy peers — at-least-once).
 
@@ -31,7 +36,11 @@ N lines acks the oldest N dispatched lines, so the head of the unacked
 window pops exactly. The baseline is captured at the first successful
 poll, which UNDER-acks anything the replica read before that poll — the
 safe direction: an under-acked frame is at worst redelivered (duplicate
-scoring), never silently dropped from the window (loss).
+scoring), never silently dropped from the window (loss). A replica
+restart invalidates the anchor; it is detected two ways — the counter
+running BACKWARD, and the deep-health report's ``started_unix`` changing
+(which also catches a restart whose new counter already passed the old
+baseline) — and either way the window requeues and the baseline re-arms.
 """
 from __future__ import annotations
 
@@ -79,6 +88,7 @@ class ProbeResult:
     backlog: Optional[float] = None   # replica's engine_ingress_backlog
     read_lines: Optional[float] = None  # replica's cumulative data_read_lines_total
     component_id: Optional[str] = None
+    started_unix: Optional[float] = None  # replica process start time (restart signal)
 
 
 class Replica:
@@ -105,6 +115,7 @@ class Replica:
         self.sent_lines = 0.0            # cumulative lines dispatched
         self.acked_lines = 0.0           # watermark-confirmed lines
         self.read_base: Optional[float] = None  # replica counter at 1st poll
+        self.started_unix: Optional[float] = None  # last seen process start time
         self.component_id: Optional[str] = None
         self.frames_total = 0
         self.requeued_total = 0
@@ -141,9 +152,12 @@ class Replica:
         """Advance the ack watermark from the replica's cumulative read
         counter and pop fully-covered window heads."""
         if self.read_base is None:
-            # first observation: everything read so far (ours or not) is
-            # the baseline — under-acks our pre-poll frames, the safe side
-            self.read_base = read_lines
+            # first observation (or re-arm after ``note_restart``): anchor
+            # so the delta continues from the current acked level —
+            # everything read before this poll is under-acked, the safe
+            # side (at the initial anchor ``acked_lines`` is 0, so this is
+            # exactly "baseline = current reading")
+            self.read_base = read_lines - self.acked_lines
             return
         if read_lines < self.read_base:
             # counter reset (replica process restarted): re-anchor; frames
@@ -157,6 +171,17 @@ class Replica:
             lines, _wire = self.window.popleft()
             self.window_head_lines += lines
         self._m_inflight.set(len(self.window))
+
+    def note_restart(self):
+        """The probe observed a process restart (start-time change): every
+        in-flight frame is gone with the old process, and the read counter
+        restarted — possibly already past the old baseline, which is why
+        counter monotonicity alone cannot detect this. Empty the window for
+        the caller to requeue and re-baseline at the next watermark sample
+        (under-acks the interim, the safe side)."""
+        taken = self.take_window()
+        self.read_base = None
+        return taken
 
     def take_window(self):
         """Move every unacked frame out (drain timeout): the caller
@@ -228,9 +253,12 @@ class HttpProbe:
                    if c.get("status") != "pass"]
         detail = ", ".join(failing) if failing else "all checks passing"
         cid = report.get("component_id") or replica.component_id
+        started = report.get("started_unix")
         backlog, read_lines = self._watermark(replica, cid)
         return ProbeResult(status, detail, backlog=backlog,
-                           read_lines=read_lines, component_id=cid)
+                           read_lines=read_lines, component_id=cid,
+                           started_unix=(float(started)
+                                         if started is not None else None))
 
     def _get_json(self, url: str):
         with urllib.request.urlopen(url, timeout=self._timeout) as resp:

@@ -200,6 +200,28 @@ class TestCreditWatermark:
         replica.apply_watermark(1.0)      # reset (restart)
         assert replica.inflight == 1      # still unacked — will requeue
 
+    def test_note_restart_requeues_window_and_rearms_baseline(self):
+        """A restart whose new counter already PASSED the old baseline is
+        invisible to counter monotonicity; ``note_restart`` (driven by the
+        ``started_unix`` change) empties the window for requeue and re-arms
+        the anchor so post-restart reads ack only post-restart frames."""
+        replica = self.make_replica()
+        replica.apply_watermark(50.0)          # initial anchor
+        for i in range(4):
+            replica.window.append((1, b"w%d" % i))
+            replica.sent_lines += 1
+        taken = replica.note_restart()
+        assert len(taken) == 4
+        assert replica.inflight == 0
+        replica.apply_watermark(60.0)          # new counter > old baseline
+        for i in range(2):
+            replica.window.append((1, b"r%d" % i))
+            replica.sent_lines += 1
+        replica.apply_watermark(61.0)          # one post-restart line read
+        assert replica.inflight == 1
+        replica.apply_watermark(62.0)
+        assert replica.inflight == 0
+
     def test_take_window_empties_and_acks(self):
         replica = self.make_replica()
         for i in range(4):
@@ -351,6 +373,240 @@ class TestReplicaRouter:
                 lambda: any(router.dispatch(b"z\n", 1)
                             and len(drain_all(rx[1])) > 0
                             for _ in range(4)), 5.0)
+        finally:
+            router.close()
+
+    def test_fast_recovery_requeues_unacked_window(self):
+        """At-least-once on the FAST path: the probe turns healthy again
+        BEFORE the drain deadline. The unacked window must still be
+        requeued at the DRAINING→RECOVERING transition — the re-dial drops
+        the old socket's buffered frames, so keeping the window would lose
+        them silently."""
+        addrs = [unique("rep"), unique("rep")]
+        factory = InprocQueueSocketFactory(maxsize=4096)
+        rx = [factory.create(a) for a in addrs]
+        health = {addrs[0]: "healthy", addrs[1]: "healthy"}
+
+        def probe(replica):
+            return ProbeResult(health[replica.addr], "injected")
+
+        events = []
+
+        class FakeMonitor:
+            def emit_event(self, event, level=None):
+                events.append(event)
+                return event
+
+        router, _, _ = make_router(addrs, factory=factory, probe=probe,
+                                   monitor=FakeMonitor(),
+                                   router_credit_window=64,
+                                   router_drain_timeout_s=30.0)
+        try:
+            for i in range(10):
+                assert router.dispatch(b"f%d\n" % i, 1)
+            assert [len(drain_all(s)) for s in rx] == [5, 5]
+
+            health[addrs[1]] = "unreachable"
+            assert wait_until(lambda: router.replicas[1].state
+                              == STATE_DRAINING)
+            health[addrs[1]] = "healthy"   # recovers well inside 30 s
+            assert wait_until(lambda: router.replicas[1].state
+                              in (STATE_RECOVERING, STATE_ACTIVE))
+            recovering = next(e for e in events
+                              if e["kind"] == "replica_recovering")
+            assert recovering["requeued"] == 5
+            # the deadline never fired, yet nothing was parked: the engine
+            # tick redelivers all five to the healthy peer
+            assert wait_until(
+                lambda: (router.tick() or
+                         router.snapshot()["requeue_total"] == 5), 5.0)
+            assert len(drain_all(rx[0])) == 5
+            assert "replica_drained" not in [e["kind"] for e in events]
+        finally:
+            router.close()
+
+    def test_degraded_probe_does_not_drain(self):
+        """'degraded' is advisory (brief backpressure, ingest stall): the
+        replica keeps receiving traffic. Draining on it would shift load
+        onto the peers (cascade) and — with ingest-stall watchdogs — wedge
+        the drained replica degraded forever."""
+        addrs = [unique("rep")]
+        factory = InprocQueueSocketFactory(maxsize=4096)
+        rx = [factory.create(a) for a in addrs]
+        polls = {"n": 0}
+
+        def probe(replica):
+            polls["n"] += 1
+            return ProbeResult("degraded", "ingest_stalled")
+
+        router, _, _ = make_router(addrs, factory=factory, probe=probe)
+        try:
+            assert wait_until(lambda: polls["n"] >= 3)
+            assert router.replicas[0].state == STATE_ACTIVE
+            assert "degraded" in router.replicas[0].state_detail
+            assert router.dispatch(b"x\n", 1)
+            assert len(drain_all(rx[0])) == 1
+        finally:
+            router.close()
+
+    def test_degraded_counts_toward_recovery_of_drained_replica(self):
+        """A drained replica receives no traffic, so its ingest-stall check
+        keeps it 'degraded' even once the real fault is gone — degraded
+        must therefore count as dispatchable for promotion, or the drain
+        becomes permanent."""
+        addrs = [unique("rep"), unique("rep")]
+        factory = InprocQueueSocketFactory(maxsize=4096)
+        for a in addrs:
+            factory.create(a)
+        health = {addrs[0]: "healthy", addrs[1]: "healthy"}
+
+        def probe(replica):
+            return ProbeResult(health[replica.addr], "injected")
+
+        router, _, _ = make_router(addrs, factory=factory, probe=probe)
+        try:
+            health[addrs[1]] = "unreachable"
+            assert wait_until(lambda: router.replicas[1].state
+                              in (STATE_DRAINING, STATE_DRAINED))
+            health[addrs[1]] = "degraded"   # fault fixed; no traffic yet
+            assert wait_until(
+                lambda: (router.tick() or
+                         router.replicas[1].state == STATE_ACTIVE), 5.0)
+        finally:
+            router.close()
+
+    def test_restart_between_polls_requeues_and_reanchors(self):
+        """Issue: a replica that restarts between polls and whose NEW read
+        counter quickly exceeds the old baseline defeats the
+        counter-monotonicity reset check. The deep report's
+        ``started_unix`` changing is the restart signal: the window
+        requeues and the watermark re-anchors."""
+        addrs = [unique("rep")]
+        factory = InprocQueueSocketFactory(maxsize=4096)
+        rx = [factory.create(a) for a in addrs]
+        sim = {"start": 100.0, "lines": 50.0}
+
+        def probe(replica):
+            return ProbeResult("healthy", "ok", read_lines=sim["lines"],
+                               started_unix=sim["start"])
+
+        events = []
+
+        class FakeMonitor:
+            def emit_event(self, event, level=None):
+                events.append(event)
+                return event
+
+        router, _, _ = make_router(addrs, factory=factory, probe=probe,
+                                   monitor=FakeMonitor(),
+                                   router_credit_window=64)
+        try:
+            assert wait_until(lambda: router.replicas[0].started_unix
+                              is not None)
+            for i in range(4):
+                assert router.dispatch(b"f%d\n" % i, 1)
+            assert len(drain_all(rx[0])) == 4
+            # restart: new identity, counter already past the old baseline
+            sim["start"], sim["lines"] = 200.0, 60.0
+            assert wait_until(
+                lambda: any(e["kind"] == "replica_restarted"
+                            for e in events))
+            restarted = next(e for e in events
+                             if e["kind"] == "replica_restarted")
+            assert restarted["requeued"] == 4
+            assert router.replicas[0].state == STATE_ACTIVE
+            assert router.replicas[0].inflight == 0
+            # the tick redelivers the four lost frames to the replica
+            assert wait_until(
+                lambda: (router.tick() or
+                         router.snapshot()["requeue_total"] == 4), 5.0)
+            assert len(drain_all(rx[0])) == 4
+            # and the re-anchored watermark acks them against the NEW
+            # counter (60 + 4 redelivered lines), not the old baseline
+            sim["lines"] = 64.0
+            assert wait_until(lambda: router.replicas[0].inflight == 0)
+        finally:
+            router.close()
+
+    def test_settled_mid_dispatch_frame_is_requeued_not_parked(self):
+        """The dispatch append race: between the (unlocked) send and the
+        window append, the supervisor can settle the replica
+        DRAINING→DRAINED on its then-empty window. The just-sent frame
+        must land in the requeue queue, not sit forever in a settled
+        window."""
+        addrs = [unique("rep"), unique("rep")]
+        factory = InprocQueueSocketFactory(maxsize=4096)
+        rx = [factory.create(a) for a in addrs]
+        router, _, _ = make_router(addrs, factory=factory)
+
+        victim = router.replicas[0]
+        inner = victim.sock
+        fired = {"done": False}
+
+        class RacySock:
+            """Delivers the frame, then lets the 'supervisor' settle the
+            replica before dispatch() re-acquires the lock."""
+
+            def send(self, wire, block=False):
+                inner.send(wire, block=block)
+                if not fired["done"]:
+                    fired["done"] = True
+                    router.apply_probe(victim,
+                                       ProbeResult("unreachable", "boom"))
+                    router.process_drains()   # empty window → DRAINED clean
+
+            def close(self):
+                inner.close()
+
+        victim.sock = RacySock()
+        try:
+            # least_backlog rotates ties, so within a few dispatches the
+            # pick lands on the racy victim sock
+            sent = False
+            for _ in range(4):
+                if router.dispatch(b"raced\n", 1) and fired["done"]:
+                    sent = True
+                    break
+            assert sent
+            assert victim.state == STATE_DRAINED
+            assert victim.inflight == 0                  # nothing parked
+            snap = router.snapshot()
+            assert snap["requeue_pending"] == 1
+            router.tick()                                # redelivers to peer
+            assert router.snapshot()["requeue_pending"] == 0
+            assert len(drain_all(rx[1])) >= 1
+        finally:
+            router.close()
+
+    def test_redial_survives_non_transport_dial_errors(self):
+        """tick() runs unguarded on the engine hot loop: a factory that
+        raises something other than TransportError (bad address ValueError,
+        raw OSError) must not kill the loop — log and retry next tick."""
+        addrs = [unique("rep")]
+        inner = InprocQueueSocketFactory(maxsize=4096)
+        inner.create(addrs[0])
+
+        class FlakyFactory:
+            def __init__(self):
+                self.fail = False
+
+            def create_output(self, *args, **kwargs):
+                if self.fail:
+                    raise ValueError("bad address")
+                return inner.create_output(*args, **kwargs)
+
+        factory = FlakyFactory()
+        router, _, _ = make_router(addrs, factory=factory)
+        try:
+            router.drain(addrs[0])
+            router.undrain(addrs[0])
+            assert router.replicas[0].needs_redial
+            factory.fail = True
+            router.tick()                  # must not raise
+            assert router.replicas[0].needs_redial
+            factory.fail = False
+            router.tick()
+            assert router.replicas[0].state == STATE_ACTIVE
         finally:
             router.close()
 
@@ -539,13 +795,16 @@ class TestEngineIntegration:
                 lambda: any(r["state"] != "active" for r in
                             http_json(port, "/admin/replicas")[1]
                             ["replicas"]), 10.0)
-            # keep traffic flowing through the drain: everything must land
+            # keep traffic flowing through the drain: every unique frame
+            # must land. Requeue may DUPLICATE (at-least-once: the victim's
+            # unacked window redelivers even when the victim had already
+            # scored it) — it must never LOSE.
             for i in range(30):
                 feeder.send(b"mid-%d\n" % i)
             assert wait_until(
-                lambda: got.extend(drain_all(collector)) or len(got) >= 40,
-                15.0)
-            assert len(got) == 40        # zero loss through the kill
+                lambda: (got.extend(drain_all(collector)) or
+                         len(set(got)) >= 40), 15.0)
+            assert len(set(got)) == 40   # zero unique-frame loss
             _, events = http_json(port, "/admin/events")
             kinds = [e.get("kind") for e in events["events"]]
             assert "replica_drain" in kinds
@@ -566,10 +825,12 @@ class TestEngineIntegration:
                             ["replicas"]), 15.0)
             for i in range(10):
                 feeder.send(b"post-%d\n" % i)
+            expected = ({b"pre-%d\n" % i for i in range(10)}
+                        | {b"mid-%d\n" % i for i in range(30)}
+                        | {b"post-%d\n" % i for i in range(10)})
             assert wait_until(
-                lambda: got.extend(drain_all(collector)) or len(got) >= 50,
-                10.0)
-            assert len(got) == 50
+                lambda: (got.extend(drain_all(collector)) or
+                         set(got) >= expected), 10.0)
         finally:
             self.shutdown(router_service, replicas)
 
