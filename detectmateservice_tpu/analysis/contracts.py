@@ -56,6 +56,8 @@ ALERT_COVERED_SERIES = (
     "detector_batch_occupancy",
     "router_replica_state",
     "router_requeue_total",
+    "model_shadow_divergence",
+    "model_checkpoint_age_seconds",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

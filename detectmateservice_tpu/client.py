@@ -15,7 +15,12 @@ non-zero on client-visible loss),
 ``replicas [targets...] [--drain ADDR | --undrain ADDR]`` (replica-router
 roll-up across a pipeline — one row per replica with state/backlog/
 inflight/frames, non-zero exit on any non-active replica; the drain verbs
-post operator drain/undrain to a single router stage)
+post operator drain/undrain to a single router stage),
+``model status|history|promote|rollback|pin|unpin|cycle|deploy`` (the
+dmroll model lifecycle behind ``/admin/model``; ``deploy --version N``
+rolls one checkpoint across a replica tier — drain → promote → verify →
+undrain per replica via the router admin plane, rolling back on any
+rejection)
 and ``health`` — which fans out across every stage of
 a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
 ``stages:`` mapping), prints a roll-up table, and exits non-zero when any
@@ -132,6 +137,32 @@ class DetectMateClient:
     def replica_undrain(self, replica: str) -> Any:
         return self._request("POST", "/admin/replicas",
                              {"action": "undrain", "replica": replica})
+
+    def model_status(self) -> Any:
+        """Model lifecycle status (``GET /admin/model``). HTTP 404 (stage
+        without ``rollout_enabled``) surfaces as None so fan-outs can skip
+        non-lifecycle stages, mirroring ``replicas``."""
+        try:
+            return self._request("GET", "/admin/model")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+
+    def model_history(self, limit: Optional[int] = None) -> Any:
+        suffix = f"&limit={int(limit)}" if limit is not None else ""
+        return self._request("GET", "/admin/model?history=1" + suffix)
+
+    def model_action(self, action: str, version: Optional[int] = None,
+                     block: bool = False) -> Any:
+        """Model lifecycle verb (``POST /admin/model``): promote / rollback
+        / pin / unpin / cycle."""
+        payload: dict = {"action": action}
+        if version is not None:
+            payload["version"] = int(version)
+        if block:
+            payload["block"] = True
+        return self._request("POST", "/admin/model", payload)
 
     def load_start(self, profile: dict) -> Any:
         """Start an open-loop load run (``POST /admin/load``). HTTP 409
@@ -286,6 +317,124 @@ def replicas_rollup(default_url: str, targets: List[str],
                         for i, v in enumerate(row[:6]))
               + (f"  {row[6]}" if row[6] else ""))
     return exit_code
+
+
+def rolling_deploy(router_url: str, version: int,
+                   client_factory=DetectMateClient,
+                   timeout_s: float = 120.0, poll_s: float = 0.5,
+                   sleep=None, out=print) -> int:
+    """``client.py model deploy``: roll one checkpoint version across a
+    replica tier, one replica at a time, through the router's admin plane —
+    drain → promote → verify → undrain per replica, so a bad checkpoint
+    never takes more than the replica under rollout out of dispatch.
+
+    Replica admin URLs come from the router's own ``GET /admin/replicas``
+    snapshot (``router_admin_urls``); every replica must point its
+    ``rollout_dir`` at the shared store that holds ``version``. On any
+    promote/verify failure the failed replica is rolled back and undrained,
+    every ALREADY-promoted replica is rolled back too, and the deploy exits
+    non-zero — the tier converges back to the pre-deploy version instead of
+    serving a split brain."""
+    import time as _time
+
+    sleep = sleep if sleep is not None else _time.sleep
+    router = client_factory(router_url)
+    snap = router.replicas()
+    if snap is None:
+        out("error: the target stage is not a replica router")
+        return 2
+    replicas = snap.get("replicas", [])
+    missing = [r["addr"] for r in replicas if not r.get("admin_url")]
+    if missing:
+        out(f"error: replicas without admin URLs (router_admin_urls): "
+            f"{missing}")
+        return 2
+
+    def wait_state(addr: str, want: Tuple[str, ...]) -> bool:
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            for rep in (router.replicas() or {}).get("replicas", []):
+                if rep["addr"] == addr and rep["state"] in want:
+                    return True
+            sleep(poll_s)
+        return False
+
+    promoted: List[Tuple[str, str]] = []   # (addr, admin_url)
+
+    def rollback_all(failed_addr: str, failed_admin: str) -> None:
+        for addr, admin in [(failed_addr, failed_admin), *reversed(promoted)]:
+            try:
+                client_factory(admin).model_action("rollback")
+                out(f"  rolled back {addr}")
+            except (urllib.error.URLError, OSError) as exc:
+                out(f"  rollback of {addr} FAILED: {exc} — resolve by hand")
+        try:
+            router.replica_undrain(failed_addr)
+        except (urllib.error.URLError, OSError):
+            pass
+
+    for rep in replicas:
+        addr, admin = rep["addr"], rep["admin_url"]
+        out(f"deploy v{version} -> {addr}")
+        router.replica_drain(addr)
+        if not wait_state(addr, ("drained",)):
+            out(f"  {addr} never drained within {timeout_s:.0f}s; aborting")
+            rollback_all(addr, admin)
+            return 1
+        try:
+            result = client_factory(admin).model_action("promote",
+                                                        version=version)
+            live = (client_factory(admin).model_status() or {}) \
+                .get("live_version")
+            if result.get("result") != "promoted" or live != version:
+                raise ValueError(
+                    f"replica reports result={result.get('result')!r} "
+                    f"live_version={live!r}")
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            out(f"  {addr} REJECTED v{version}: {exc}")
+            rollback_all(addr, admin)
+            return 1
+        router.replica_undrain(addr)
+        if not wait_state(addr, ("active",)):
+            out(f"  {addr} did not return to active within "
+                f"{timeout_s:.0f}s; aborting")
+            rollback_all(addr, admin)
+            return 1
+        promoted.append((addr, admin))
+        out(f"  {addr} serving v{version}, back in dispatch")
+    out(f"deployed v{version} to {len(promoted)} replica(s)")
+    return 0
+
+
+def run_model(client: DetectMateClient, args) -> int:
+    """``client.py model``: drive the model lifecycle behind /admin/model."""
+    if args.action == "status":
+        status = client.model_status()
+        if status is None:
+            print("model lifecycle is not enabled on this stage",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(status, indent=2))
+        return 0
+    if args.action == "history":
+        print(json.dumps(client.model_history(limit=args.limit), indent=2))
+        return 0
+    if args.action == "deploy":
+        if args.version is None:
+            print("error: model deploy requires --version", file=sys.stderr)
+            return 2
+        return rolling_deploy(args.router or client.url, args.version,
+                              timeout_s=args.timeout)
+    try:
+        result = client.model_action(args.action, version=args.version,
+                                     block=args.block)
+    except urllib.error.HTTPError as exc:
+        print(f"model {args.action} rejected ({exc.code}): "
+              f"{exc.read().decode('utf-8', errors='replace')}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(result, indent=2))
+    return 0
 
 
 def run_profile(client: DetectMateClient, seconds: float, wait: bool,
@@ -455,6 +604,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     load_p.add_argument("--wait", action="store_true",
                         help="block until the schedule+settle completes, "
                              "stop the run, and exit non-zero on loss")
+    model_p = sub.add_parser(
+        "model", help="model lifecycle: status/history and the "
+                      "promote/rollback/pin verbs (/admin/model), plus a "
+                      "rolling fleet deploy over a replica router")
+    model_p.add_argument(
+        "action", choices=["status", "history", "promote", "rollback",
+                           "pin", "unpin", "cycle", "deploy"],
+        help="status/history read the lifecycle state; promote cuts the "
+             "shadowing candidate (or --version N from the store) over; "
+             "rollback reinstalls the previous live version; pin freezes "
+             "the served version (cycles suspend) and unpin resumes; "
+             "cycle runs one sample→fine-tune→shadow cycle now; deploy "
+             "rolls --version across a replica tier (drain → promote → "
+             "undrain per replica via the router admin plane)")
+    model_p.add_argument("--version", type=int, default=None,
+                         help="checkpoint version for promote/pin/deploy")
+    model_p.add_argument("--block", action="store_true",
+                         help="cycle: block until the shadow gate resolves")
+    model_p.add_argument("--limit", type=int, default=None,
+                         help="history: only the newest N checkpoints")
+    model_p.add_argument("--router", default=None,
+                         help="deploy: the replica router's admin URL "
+                              "(default: --url)")
+    model_p.add_argument("--timeout", type=float, default=120.0,
+                         help="deploy: per-replica drain/active wait "
+                              "(default 120 s)")
     trace = sub.add_parser(
         "trace", help="read the pipeline flight recorder (/admin/trace)")
     trace.add_argument("--chrome", action="store_true",
@@ -480,6 +655,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("error: load start requires --target", file=sys.stderr)
                 return 2
             return run_load(client, args)
+        if args.command == "model":
+            return run_model(client, args)
         if args.command == "events":
             result = client.events(limit=args.limit)
         elif args.command == "xla":

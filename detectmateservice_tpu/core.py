@@ -260,6 +260,27 @@ class Service:
         if settings.watchdog_enabled:
             self.health.start()
 
+        # model lifecycle (rollout/): continuous fine-tuning + shadow-
+        # scoring canary + zero-downtime hot-swap behind /admin/model.
+        # Built only for components exposing the rollout hooks (the jax
+        # scorer); the manager owns its own thread and versioned store.
+        self.rollout = None
+        if settings.rollout_enabled:
+            if callable(getattr(self.library_component, "install_candidate",
+                                None)):
+                from .rollout import RolloutManager
+
+                self.rollout = RolloutManager(
+                    self.library_component, settings,
+                    labels=dict(self._labels), monitor=self.health,
+                    logger=self.logger)
+                self.rollout.start()
+            else:
+                self.logger.warning(
+                    "rollout_enabled but component %r has no rollout hooks; "
+                    "model lifecycle disabled for this stage",
+                    settings.component_type)
+
         self._running_metric = m.ENGINE_RUNNING().labels(**self._labels)
         self._starts_metric = m.ENGINE_STARTS().labels(**self._labels)
         self._running_metric.state("stopped")
@@ -358,6 +379,11 @@ class Service:
         self._service_exit_event.set()
 
     def _teardown(self) -> None:
+        if self.rollout is not None:
+            try:
+                self.rollout.stop()
+            except Exception as exc:
+                self.logger.error("rollout manager stop failed: %s", exc)
         try:
             self.stop()
         except Exception as exc:

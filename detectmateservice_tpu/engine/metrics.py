@@ -345,6 +345,38 @@ ROUTER_INFLIGHT = _series(
     "router_credit_window means the replica is not draining its ingest",
     ("component_type", "component_id", "replica"))
 
+# model lifecycle (rollout/): the dmroll subsystem's observable contract.
+# Swaps count every cutover attempt by outcome (promoted / rolled_back /
+# holdback / pinned / failed); shadow divergence is the per-row |candidate
+# score - live score| while a canary shadows (the ModelCanaryDiverging
+# signal — decision flips gate promotion separately, /admin/model has
+# both); checkpoint age is computed at scrape time off the versioned
+# store's manifest (a wedged trainer looks stale, ModelCheckpointStale);
+# version info is a constant-1 gauge whose labels carry the live
+# checkpoint version + model family (the fleet-skew view: one query shows
+# which replica serves which version).
+SWAP_LABELS = ("component_type", "component_id", "result")
+MODEL_SWAPS = _series(
+    Counter, "model_swaps_total",
+    "Model hot-swap/cutover attempts by outcome: promoted, rolled_back, "
+    "holdback (canary gate refused), pinned, failed",
+    SWAP_LABELS)
+MODEL_SHADOW_DIVERGENCE = _series(
+    Histogram, "model_shadow_divergence",
+    "Per-row |candidate - live| score delta while a candidate shadows",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 25.0))
+MODEL_CHECKPOINT_AGE = _series(
+    Gauge, "model_checkpoint_age_seconds",
+    "Seconds since the rollout store's newest checkpoint was committed "
+    "(read at scrape time; ages from manager start when none exists yet)")
+MODEL_VERSION_LABELS = ("component_type", "component_id", "version", "model")
+MODEL_VERSION_INFO = _series(
+    Gauge, "model_version_info",
+    "Constant 1; the labels carry the live model checkpoint version and "
+    "model family (0 = the boot-time fit, never hot-swapped)",
+    MODEL_VERSION_LABELS)
+
 # adaptive continuous batching (library/detectors/jax_scorer.py coalescer):
 # rows held across process_batch calls toward the best-fitting warm bucket
 # under a latency budget. Depth is the current hold; releases count why

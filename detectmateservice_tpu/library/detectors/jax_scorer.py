@@ -404,6 +404,12 @@ class JaxScorerDetector(CoreDetector):
         self.health_monitor = None
         self._drained_total = 0
         self._dispatch_hb = None
+        # dmroll (rollout/): the Service-owned RolloutManager attaches a
+        # traffic sampler here; the dispatch path offers every dispatched
+        # token batch to it, and install_candidate is the
+        # pre-warm-then-hot-swap seam promoted candidates cut over through
+        self._rollout_sampler = None
+        self._model_version = 0
 
     def _validate_static_config(self) -> None:
         """Reject bad enum-ish config at CONSTRUCTION (no jax import needed):
@@ -579,8 +585,11 @@ class JaxScorerDetector(CoreDetector):
         self._obs_backend = getattr(self._device, "platform", "unknown")
         device_obs.export_hbm_gauges(self._obs_labels())
         params, opt_state = self._scorer.init(self._rng)
-        # params pinned in device memory once (HBM residency; north-star item)
+        # params pinned in device memory once (HBM residency; north-star
+        # item); construction-time, before any other thread can exist:
+        # dmlint: ignore[DM-L001] init-only write
         self._params = jax.device_put(params, self._device)
+        # dmlint: ignore[DM-L001] init-only write
         self._opt_state = jax.device_put(opt_state, self._device)
         if cfg.host_score_max_batch > 0 and self._host_scoring_possible():
             try:
@@ -630,12 +639,15 @@ class JaxScorerDetector(CoreDetector):
     def _sync_host_params(self) -> None:
         """Mirror the current params onto the host CPU backend (one transfer,
         after fit / checkpoint load) so small batches can score locally."""
+        # callers (fit, checkpoint load, candidate install) serialize:
+        # dmlint: ignore[DM-L001] ref-atomic reads
         if self._cpu_device is None or self._params is None:
             return
         import jax
         import threading
 
         try:
+            # dmlint: ignore[DM-L001] ref-atomic mirror write
             self._host_params = jax.device_put(self._params, self._cpu_device)
         except Exception:
             self._host_params = None
@@ -706,11 +718,13 @@ class JaxScorerDetector(CoreDetector):
                 self._params, self._put(tokens), self._norm_mu, self._norm_sigma)
         if self._sharded is not None:
             return self._sharded.score_device(tokens)
+        # dmlint: ignore[DM-L001] ref-atomic param swap; either generation
         return self._scorer.score(self._params, self._put(tokens))
 
     def _token_nlls_dev(self, tokens: np.ndarray):
         if self._sharded is not None:
             return self._sharded.token_nlls_device(tokens)
+        # dmlint: ignore[DM-L001] ref-atomic param swap; either generation
         return self._scorer._token_nlls(self._params, self._put(tokens))
 
     def _calibrate_position_norm(self, data: np.ndarray, bs: int) -> np.ndarray:
@@ -751,6 +765,9 @@ class JaxScorerDetector(CoreDetector):
     def _train_step(self, step_rng, batch: np.ndarray) -> float:
         if self._sharded is not None:
             return self._sharded.train_step(step_rng, batch)
+        # the boundary fit owns these trees until _finish_fit hands off;
+        # install_candidate joins the fit before swapping:
+        # dmlint: ignore[DM-L001] single-writer fit phase
         self._params, self._opt_state, loss_arr = self._scorer.train_step(
             self._params, self._opt_state, step_rng, self._put(batch)
         )
@@ -998,16 +1015,17 @@ class JaxScorerDetector(CoreDetector):
         ready: List[Optional[bytes]] = []  # outputs from drained older batches
         if detect_idx:
             n = len(detect_idx)
+            det_tokens = tokens[detect_idx]
+            det_raws = [batch[i] for i in detect_idx]
+            if self._rollout_sampler is not None:
+                self._rollout_sampler.offer_rows(det_tokens)
             coalescer = self._get_coalescer()
             if coalescer is not None:
                 # continuous batching: hold the rows toward a warm bucket;
                 # _coalesce_pump below decides what (if anything) dispatches
-                coalescer.add(tokens[detect_idx],
-                              [batch[i] for i in detect_idx],
-                              time.monotonic())
+                coalescer.add(det_tokens, det_raws, time.monotonic())
             else:
-                self._dispatch(tokens[detect_idx],
-                               [batch[i] for i in detect_idx])
+                self._dispatch(det_tokens, det_raws)
             self._count_device_lines(n)
         self._coalesce_pump()
         # event-driven drain: anything whose readback already landed goes out
@@ -1095,6 +1113,8 @@ class JaxScorerDetector(CoreDetector):
             raws = matchkern.SpanRaws(fb.blob, fb.spans[idx])
             n_ok = len(idx)
         if n_ok:
+            if self._rollout_sampler is not None:
+                self._rollout_sampler.offer_rows(tokens)
             coalescer = self._get_coalescer()
             if coalescer is not None:
                 # SpanRaws segments stay lazy inside the coalescer — no
@@ -1267,6 +1287,7 @@ class JaxScorerDetector(CoreDetector):
         self._ensure_scorer()
         n = len(tokens)
         cap = self.config.host_score_max_batch
+        # dmlint: ignore[DM-L001] ref-atomic mirror swap (see _score_host)
         if 0 < n <= cap and self._host_params is not None:
             # power-of-two host buckets keep the padding compute proportional
             # to the batch (padding everything to the cap costs ~60 ms for
@@ -1582,8 +1603,11 @@ class JaxScorerDetector(CoreDetector):
     def _score_host(self, tokens: np.ndarray):
         """Score a small batch on the CPU backend with the mirrored params."""
         if self._norm_mu is not None:
+            # dmlint: ignore[DM-L001] ref-atomic mirror swap; engine
+            # thread reads whichever params generation is current
             return self._host_normscore(self._host_params, tokens,
                                         self._norm_mu, self._norm_sigma)
+        # dmlint: ignore[DM-L001] ref-atomic mirror swap (see above)
         return self._host_score(self._host_params, tokens)
 
     def _drain_one(self) -> List[Optional[bytes]]:
@@ -1780,6 +1804,204 @@ class JaxScorerDetector(CoreDetector):
                                      max(0.0, device_s), slot.trace_id,
                                      release=slot.release)
 
+    # -- model rollout (rollout/manager.py seams) ------------------------
+    def set_rollout_sampler(self, sampler) -> None:
+        """Attach the dispatch-path traffic tap (rollout/sampler.py). One
+        ``offer_rows`` call per dispatched micro-batch — the sampler bounds
+        its own memory and does its own thinning."""
+        self._rollout_sampler = sampler
+
+    def model_version(self) -> int:
+        """The installed checkpoint version (0 = the boot-time fit)."""
+        # dmlint: ignore[DM-L001] int read; swap publishes ref-atomically
+        return self._model_version
+
+    def live_threshold(self) -> float:
+        return float(self._threshold) if self._threshold is not None \
+            else float("inf")
+
+    def rollout_ready(self) -> bool:
+        """Whether the continuous fine-tune/shadow cycle can run: a fitted,
+        single-device scorer with live params. Mesh (sharded) mode serves
+        hot-swaps of externally-built checkpoints (install_candidate /
+        load_params_checkpoint) but not in-process fine-tuning — the train
+        path donates the sharded trees in place."""
+        # dmlint: ignore[DM-L001] racy pre-check; install paths re-sync
+        return (self._fitted and self._fit_thread is None
+                and self._sharded is None
+                # dmlint: ignore[DM-L001] presence probe; cycle re-reads
+                and self._params is not None)
+
+    def rollout_fine_tune(self, rows: np.ndarray, epochs: int = 1,
+                          seed: int = 0):
+        """Fine-tune a CANDIDATE param tree off the live params on sampled
+        rows; the live tree is never touched (train_step is functional).
+        Every jit call rides the train-bucket shape the boundary fit
+        compiled, and anything new attributes to an expected
+        ``rollout_fit`` ledger context — the dispatch path keeps its
+        zero-unexpected-recompile contract while training runs on the
+        manager thread."""
+        self._ensure_scorer()
+        # dmlint: ignore[DM-L001] presence probe
+        if self._sharded is None and self._params is None:
+            raise LibraryError("scorer has no live params to fine-tune from")
+        if self._sharded is not None:
+            raise LibraryError(
+                "continuous fine-tuning is not supported in mesh (sharded) "
+                "mode; deploy externally-trained checkpoints instead")
+        import jax
+
+        cfg = self.config
+        rows = np.asarray(rows, np.int32)
+        if not len(rows):
+            raise LibraryError("no sampled rows to fine-tune on")
+        bs = min(cfg.train_batch_size, len(rows))
+        # a concurrent swap just means the candidate forks from the
+        # pre-swap generation; the shadow gate judges it against whatever
+        # is live at promote time:
+        # dmlint: ignore[DM-L001] snapshot read
+        params, opt_state = self._params, self._opt_state
+        rng = jax.random.PRNGKey(cfg.seed + 1 + seed)
+        order_rng = np.random.default_rng(cfg.seed + seed)
+        loss, steps = float("nan"), 0
+        with self._ledger.context(where="rollout_fit",
+                                  backend=self._obs_backend, expected=True):
+            for _ in range(max(1, epochs)):
+                order = order_rng.permutation(len(rows))
+                for start in range(0, len(rows) - bs + 1, bs):
+                    batch = rows[order[start:start + bs]]
+                    rng, step_rng = jax.random.split(rng)
+                    params, opt_state, loss_arr = self._scorer.train_step(
+                        params, opt_state, step_rng, self._put(batch))
+                    loss = float(loss_arr)
+                    steps += 1
+        return params, opt_state, {"steps": steps, "loss": loss,
+                                   "batch_size": bs}
+
+    def _score_with_params(self, params, tokens: np.ndarray):
+        """Score a padded chunk with an explicit param tree (None = live);
+        applies the live position-norm calibration either way so live and
+        candidate scores stay in one unit."""
+        if params is None:
+            return self._score_dev(tokens)
+        if self._norm_mu is not None:
+            return self._scorer._normscore(params, self._put(tokens),
+                                           self._norm_mu, self._norm_sigma)
+        return self._scorer.score(params, self._put(tokens))
+
+    def rollout_scores(self, params, tokens: np.ndarray) -> np.ndarray:
+        """Shadow-scoring path: [n, S] tokens → [n] fp32 scores under the
+        given params (None = live). Chunks ride the train-bucket compile
+        shape (guaranteed warm since the boundary fit) under an expected
+        ``shadow`` ledger context."""
+        self._ensure_scorer()
+        if self._sharded is not None and params is not None:
+            raise LibraryError(
+                "shadow scoring with explicit params is not supported in "
+                "mesh (sharded) mode")
+        tokens = np.asarray(tokens, np.int32)
+        n = len(tokens)
+        if n == 0:
+            return np.zeros(0, np.float32)
+        bucket = _bucket(self.config.train_batch_size, self.config.max_batch)
+        out = np.empty(n, np.float32)
+        with self._ledger.context(bucket=bucket, where="shadow",
+                                  backend=self._obs_backend, expected=True):
+            for start in range(0, n, bucket):
+                chunk = tokens[start:start + bucket]
+                real = len(chunk)
+                if real < bucket:
+                    chunk = np.concatenate([chunk, np.zeros(
+                        (bucket - real, tokens.shape[1]), np.int32)])
+                scores = np.asarray(self._score_with_params(params, chunk))
+                out[start:start + real] = scores[:real]
+        return out
+
+    def install_candidate(self, params, opt_state,
+                          version: int = 0) -> Dict[str, Any]:
+        """Zero-downtime hot-swap: pre-warm the candidate against EVERY
+        warm device bucket under an expected ``model_swap`` ledger context
+        *before* cutover, then swap the dispatch path's param refs under
+        the ``_fit_lock`` handoff. The coalescer keeps draining while the
+        warm runs on the caller's (manager) thread; because the candidate's
+        avals match the live tree every warm call is an XLA cache hit, and
+        any surprise compile is attributed expected here rather than
+        paging as a recompile storm. The host CPU twin's mirror is computed
+        pre-swap too, so small batches never score a stale model."""
+        self._ensure_scorer()
+        import jax
+
+        # land a running boundary fit first: its completion would overwrite
+        # the freshly-installed params with the pre-swap training result
+        self._finish_fit(wait=True)
+        cfg = self.config
+        warmed = sorted(self._device_warm)
+        with self._ledger.context(where="model_swap",
+                                  backend=self._obs_backend, expected=True):
+            if self._sharded is not None:
+                self._sharded.install_params(params, opt_state)
+                for b in warmed:
+                    self._sharded.warm_bucket(
+                        np.zeros((b, cfg.seq_len), np.int32))
+                with self._fit_lock:
+                    self._model_version = int(version)
+                return {"swapped": True, "version": int(version),
+                        "prewarmed_buckets": warmed, "backend": "mesh"}
+            dev_params = jax.device_put(params, self._device)
+            dev_opt = jax.device_put(opt_state, self._device)
+            for b in warmed:
+                tokens = np.zeros((b, cfg.seq_len), np.int32)
+                with self._ledger.context(bucket=b):
+                    jax.block_until_ready(
+                        self._score_with_params(dev_params, tokens))
+            host_params = None
+            # the mirror itself is recomputed from the candidate and
+            # swapped under the lock:
+            # dmlint: ignore[DM-L001] presence probe
+            if self._host_params is not None:
+                try:
+                    host_params = jax.device_put(params, self._cpu_device)
+                except Exception:
+                    host_params = None
+            with self._fit_lock:
+                self._params = dev_params
+                self._opt_state = dev_opt
+                if host_params is not None:
+                    self._host_params = host_params
+                self._model_version = int(version)
+        return {"swapped": True, "version": int(version),
+                "prewarmed_buckets": warmed,
+                "backend": self._obs_backend}
+
+    def save_params_checkpoint(self, directory: str, params,
+                               opt_state) -> None:
+        """Persist an EXPLICIT param tree (a rollout candidate) with this
+        detector's state metadata — the versioned-store twin of
+        ``save_checkpoint``, which persists the live tree."""
+        from ...utils.checkpoint import MODEL_TREE_VERSIONS, save_scorer_state
+
+        save_scorer_state(directory, params, opt_state, self.state_dict(),
+                          tree_version=MODEL_TREE_VERSIONS.get(
+                              self.config.model, 1))
+
+    def load_params_checkpoint(self, directory: str):
+        """Load a stored version's trees against the live templates WITHOUT
+        installing them (promote-by-version / rollback load through here,
+        then ``install_candidate``)."""
+        from ...utils.checkpoint import (COMPATIBLE_TREE_VERSIONS,
+                                         load_scorer_state)
+
+        self._ensure_scorer()
+        accepted = COMPATIBLE_TREE_VERSIONS.get(self.config.model, {1})
+        if self._sharded is not None:
+            return load_scorer_state(
+                directory, self._sharded.params, self._sharded.opt_state,
+                accepted_tree_versions=accepted)
+        # any live generation's tree structure restores identically:
+        # dmlint: ignore[DM-L001] template read
+        return load_scorer_state(directory, self._params, self._opt_state,
+                                 accepted_tree_versions=accepted)
+
     # -- runtime reconfigure (POST /admin/reconfigure end-to-end) --------
     def validate_reconfigure(self, new_config) -> None:
         """Veto changes that would require rebuilding the compiled model or
@@ -1866,6 +2088,8 @@ class JaxScorerDetector(CoreDetector):
                               self._sharded.opt_state, self.state_dict(),
                               tree_version=version)
         else:
+            # _finish_fit(wait=True) above ended the only racing writer:
+            # dmlint: ignore[DM-L001] post-join read
             save_scorer_state(directory, self._params, self._opt_state,
                               self.state_dict(), tree_version=version)
 
@@ -1885,6 +2109,7 @@ class JaxScorerDetector(CoreDetector):
             self._sharded.params, self._sharded.opt_state = params, opt_state
         else:
             params, opt_state, meta = load_scorer_state(
+                # dmlint: ignore[DM-L001] template read (tree structure only)
                 directory, self._params, self._opt_state,
                 accepted_tree_versions=accepted,
             )

@@ -117,6 +117,14 @@ class ShardedScorer:
     def data_parallelism(self) -> int:
         return int(self.mesh.shape.get(AXIS_DATA, 1))
 
+    def install_params(self, params, opt_state) -> None:
+        """Hot-swap the served param/opt trees (model rollout): the new
+        trees are placed with the SAME shardings the jitted executables
+        were compiled against, so every cached executable keeps hitting —
+        the swap itself is a reference assignment, never a recompile."""
+        self.params = jax.device_put(params, self._param_sharding)
+        self.opt_state = jax.device_put(opt_state, self._opt_sharding)
+
     def _traced(self, fn, *args, bucket: Optional[int] = None):
         """Invoke a jitted fn; on a seq mesh, tracing happens inside
         ring_context so the model's ``attention(impl="ring")`` resolves to

@@ -1,0 +1,22 @@
+"""dmroll — online learning + zero-downtime model rollout (ROADMAP item 4).
+
+The served model becomes a versioned, continuously refreshed artifact:
+``TrafficSampler`` taps the dispatch path, ``RolloutManager`` fine-tunes
+candidates off the live params, the ``CheckpointStore`` rotates crash-atomic
+versioned checkpoints, the ``ShadowEvaluator`` gates promotion on
+shadow-scoring divergence, and the detector hot-swaps promoted params with
+zero unexpected XLA recompiles. See docs/model_lifecycle.md.
+"""
+from .manager import RolloutError, RolloutManager
+from .sampler import TrafficSampler
+from .shadow import ShadowEvaluator
+from .store import CheckpointStore, StoreError
+
+__all__ = [
+    "CheckpointStore",
+    "RolloutError",
+    "RolloutManager",
+    "ShadowEvaluator",
+    "StoreError",
+    "TrafficSampler",
+]

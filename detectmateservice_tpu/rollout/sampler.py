@@ -1,0 +1,103 @@
+"""Reservoir sampler tapping the scorer dispatch path.
+
+The continuous fine-tuning loop (rollout/manager.py) needs a recent,
+representative slice of live traffic without holding the stream: the
+detector offers every dispatched token batch here (one call per
+micro-batch, engine thread), a seeded ratio filter thins it, and a classic
+Algorithm-R reservoir bounds memory to ``capacity`` rows no matter how long
+the service runs. Rows are stored as copies of the tokenized [S] int32
+vectors — raw bytes never enter the sampler, so its memory bound is exactly
+``capacity * seq_len * 4`` bytes.
+
+Determinism: the RNG is seeded, and both the ratio filter and the reservoir
+replacement indices are drawn from it in offer order — the same offered
+sequence always yields the same reservoir (pinned by tests/test_rollout.py).
+The clock is injected for the same reason: ``last_offer_age`` (the
+staleness the manager reports) is testable without sleeping.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class TrafficSampler:
+    """Bounded reservoir over dispatched token rows (thread-safe: the
+    engine thread offers, the rollout manager snapshots/drains)."""
+
+    def __init__(self, capacity: int, ratio: float, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if capacity <= 0:
+            raise ValueError(f"sampler capacity must be > 0 (got {capacity})")
+        if not 0.0 < ratio <= 1.0:
+            raise ValueError(f"sample ratio must be in (0, 1] (got {ratio})")
+        self.capacity = capacity
+        self.ratio = ratio
+        self._rng = np.random.default_rng(seed)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rows: List[np.ndarray] = []
+        self._seen = 0          # rows that passed the ratio filter
+        self._offered = 0       # rows offered by the dispatch path
+        self._last_offer: Optional[float] = None
+
+    def offer_rows(self, tokens: np.ndarray) -> int:
+        """Offer an [n, S] token batch from the dispatch path; returns how
+        many rows entered the reservoir. One RNG draw per offered batch for
+        the ratio filter plus one per accepted row once the reservoir is
+        full — cheap enough for the hot path's per-micro-batch cadence."""
+        n = len(tokens)
+        if n == 0:
+            return 0
+        with self._lock:
+            self._offered += n
+            self._last_offer = self._clock()
+            picked = np.flatnonzero(self._rng.random(n) < self.ratio)
+            taken = 0
+            for i in picked:
+                self._seen += 1
+                row = np.array(tokens[i], dtype=np.int32, copy=True)
+                if len(self._rows) < self.capacity:
+                    self._rows.append(row)
+                    taken += 1
+                else:
+                    # Algorithm R: row j of the filtered stream replaces a
+                    # reservoir slot with probability capacity/j
+                    slot = int(self._rng.integers(0, self._seen))
+                    if slot < self.capacity:
+                        self._rows[slot] = row
+                        taken += 1
+            return taken
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def snapshot(self) -> np.ndarray:
+        """Copy of the reservoir as one [k, S] matrix (empty → [0, 0])."""
+        with self._lock:
+            if not self._rows:
+                return np.zeros((0, 0), np.int32)
+            return np.stack(self._rows)
+
+    def last_offer_age(self) -> Optional[float]:
+        with self._lock:
+            if self._last_offer is None:
+                return None
+            return max(0.0, self._clock() - self._last_offer)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "ratio": self.ratio,
+                "held_rows": len(self._rows),
+                "rows_offered": self._offered,
+                "rows_sampled": self._seen,
+                "last_offer_age_s": (
+                    None if self._last_offer is None
+                    else round(max(0.0, self._clock() - self._last_offer), 3)),
+            }

@@ -294,6 +294,43 @@ class ServiceSettings(BaseModel):
     # supervisor poll cadence (deep health + watermark per replica)
     router_health_interval_s: float = Field(default=2.0, ge=0.05, le=300.0)
 
+    # -- model lifecycle: dmroll (rollout/, PR 10) ------------------------
+    # Turns the served model into a versioned, continuously refreshed
+    # artifact: a background trainer fine-tunes candidates on a sampled
+    # tail of live traffic, candidates shadow-score a traffic copy, and a
+    # promotion gate hot-swaps them onto the dispatch path with zero
+    # unexpected XLA recompiles (docs/model_lifecycle.md). Requires a
+    # component with the rollout hooks (jax_scorer).
+    rollout_enabled: bool = False
+    # versioned checkpoint store root (crash-atomic manifest, keep-N
+    # rotation). Point every replica of a tier at the SAME directory and
+    # `client.py model deploy` rolls one version across the fleet.
+    rollout_dir: Optional[str] = None
+    # continuous fine-tune cadence; each cycle = sample → fine-tune →
+    # checkpoint → shadow → (promote | holdback)
+    rollout_interval_s: float = Field(default=600.0, ge=0.05)
+    # dispatch-path traffic tap: fraction of dispatched rows offered to the
+    # reservoir, and the reservoir's bounded size (rows; memory bound is
+    # capacity * seq_len * 4 bytes)
+    rollout_sample_ratio: float = Field(default=0.05, gt=0.0, le=1.0)
+    rollout_sample_capacity: int = Field(default=4096, ge=16, le=262144)
+    # a cycle only fine-tunes once this many sampled rows are banked
+    rollout_min_fit_rows: int = Field(default=256, ge=1)
+    rollout_train_epochs: int = Field(default=1, ge=1, le=100)
+    # shadow-scoring canary gate: a candidate must shadow at least this
+    # many rows, then promotes only when mean |score delta| and the
+    # alert-decision flip ratio both stay under their ceilings; otherwise
+    # it is held back (structured model_canary_holdback event)
+    rollout_min_shadow_samples: int = Field(default=512, ge=1)
+    rollout_shadow_timeout_s: float = Field(default=300.0, gt=0.0)
+    rollout_max_mean_delta: float = Field(default=0.25, ge=0.0)
+    rollout_max_flip_ratio: float = Field(default=0.01, ge=0.0, le=1.0)
+    # false = candidates stop at the gate and wait for an operator
+    # POST /admin/model {"action": "promote"}
+    rollout_auto_promote: bool = True
+    # keep-N checkpoint rotation (live/pinned/newest never pruned)
+    rollout_keep_checkpoints: int = Field(default=4, ge=1, le=64)
+
     # -- self-diagnosis (engine/health.py) --------------------------------
     # "json" renders every log record as one JSON object per line (component
     # identity + message + attached structured event), for fleet log
@@ -355,6 +392,15 @@ class ServiceSettings(BaseModel):
                 "router_admin_urls must be empty or match router_replicas "
                 f"1:1 ({len(self.router_admin_urls)} urls for "
                 f"{len(self.router_replicas)} replicas)")
+        return self
+
+    # -- rollout cross-validation -----------------------------------------
+    @model_validator(mode="after")
+    def _check_rollout(self) -> "ServiceSettings":
+        if self.rollout_enabled and not self.rollout_dir:
+            raise ValueError(
+                "rollout_enabled requires rollout_dir (the versioned "
+                "checkpoint store root)")
         return self
 
     # -- TLS cross-validation (reference: settings.py:116-132) ------------

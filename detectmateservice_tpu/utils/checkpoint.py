@@ -2,11 +2,26 @@
 
 Closes the reference's checkpoint gap (SURVEY.md §5.4: detector state is
 in-memory only there; "add real model-state checkpoint (orbax-style)").
+
+Crash atomicity (PR 10): a save used to overwrite ``params/`` and
+``opt_state/`` in place and then rewrite ``meta.json`` — a crash between
+those steps left a *valid-looking* meta pointing at half-written param
+trees, which ``load_scorer_state`` would trust. Saves now write the array
+trees into fresh nonce-named directories and COMMIT by atomically replacing
+``meta.json`` (temp file + fsync + ``os.replace`` + directory fsync); the
+meta names the nonce it belongs to (``data_nonce``), so the loader can only
+ever see a fully-written generation. A crash mid-save leaves the previous
+generation untouched and at most some orphaned nonce directories, which the
+next successful save prunes. Legacy checkpoints (no ``data_nonce``) keep
+loading from the bare ``params``/``opt_state`` names.
 """
 from __future__ import annotations
 
 import json
+import os
+import shutil
 import threading
+import time
 from pathlib import Path
 from typing import Any, Dict, Tuple
 
@@ -53,16 +68,54 @@ def _checkpointer() -> "ocp.StandardCheckpointer":
     return _CKPTR
 
 
+def write_json_atomic(path: Path, doc: Dict[str, Any]) -> None:
+    """Durably replace ``path`` with ``doc``: write a temp sibling, fsync
+    it, ``os.replace`` onto the final name, fsync the directory. The
+    replace is the commit point — a reader (or a post-crash restart) sees
+    either the old document or the new one, never a torn write. Shared by
+    the checkpoint meta commit and the rollout store's manifest."""
+    path = Path(path)
+    tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
+    data = json.dumps(doc, indent=0, sort_keys=True)
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dir_fd = os.open(path.parent, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
+def _prune_stale_data(path: Path, keep_nonce: str) -> None:
+    """Remove data generations other than ``keep_nonce``: older nonce dirs,
+    orphans from crashed saves, and the legacy bare ``params``/``opt_state``
+    layout (safe only AFTER the meta commit landed)."""
+    for entry in path.iterdir():
+        name = entry.name
+        if name in ("params", "opt_state") or (
+                (name.startswith("params.") or name.startswith("opt_state."))
+                and not name.endswith(keep_nonce)):
+            shutil.rmtree(entry, ignore_errors=True)
+
+
 def save_scorer_state(directory: str, params: Any, opt_state: Any,
                       meta: Dict[str, Any], tree_version: int = 1) -> None:
     path = Path(directory).absolute()
     path.mkdir(parents=True, exist_ok=True)
+    # fresh generation per save: the previous one stays intact and trusted
+    # until the meta commit below atomically retargets the loader
+    nonce = f"{os.getpid()}-{time.time_ns():x}"
     with _SAVE_LOCK:
         ckptr = _checkpointer()
-        ckptr.save(path / "params", params, force=True)
-        ckptr.save(path / "opt_state", opt_state, force=True)
+        ckptr.save(path / f"params.{nonce}", params, force=True)
+        ckptr.save(path / f"opt_state.{nonce}", opt_state, force=True)
         ckptr.wait_until_finished()
-    (path / _META).write_text(json.dumps({**meta, "tree_version": tree_version}))
+    write_json_atomic(path / _META, {**meta, "tree_version": tree_version,
+                                     "data_nonce": nonce})
+    _prune_stale_data(path, keep_nonce=nonce)
 
 
 def load_scorer_state(directory: str, params_template: Any,
@@ -82,8 +135,13 @@ def load_scorer_state(directory: str, params_template: Any,
             "renamed), so this checkpoint cannot be restored directly — "
             "refit the scorer, or migrate the checkpoint by renaming its "
             "param keys to the new layout")
+    # the meta names the data generation it was committed with; absent =
+    # a pre-PR-10 checkpoint in the bare-name layout
+    nonce = meta.get("data_nonce")
+    params_dir = path / (f"params.{nonce}" if nonce else "params")
+    opt_dir = path / (f"opt_state.{nonce}" if nonce else "opt_state")
     with _SAVE_LOCK:  # share the serialized singleton with the save path
         ckptr = _checkpointer()
-        params = ckptr.restore(path / "params", params_template)
-        opt_state = ckptr.restore(path / "opt_state", opt_state_template)
+        params = ckptr.restore(params_dir, params_template)
+        opt_state = ckptr.restore(opt_dir, opt_state_template)
     return params, opt_state, meta

@@ -129,6 +129,17 @@ def _replicas(service, query, payload) -> Response:
     return Response(200, router.snapshot())
 
 
+def _model(service, query, payload) -> Response:
+    rollout = getattr(service, "rollout", None)
+    if rollout is None:
+        return Response(404, {"detail": "model lifecycle is not enabled on "
+                                        "this stage (rollout_enabled)"})
+    if (query.get("history") or ["0"])[0] not in ("", "0", "false"):
+        limit = _int_param(query, "limit", default=0) or None
+        return Response(200, rollout.history(limit))
+    return Response(200, rollout.status())
+
+
 def _load_status(service, query, payload) -> Response:
     from ..loadgen.generator import LOADGEN
 
@@ -234,6 +245,42 @@ def _load_control(service, query, payload) -> Response:
         return Response(409, {"detail": str(exc)})
 
 
+def _model_control(service, query, payload) -> Response:
+    from ..rollout import RolloutError, StoreError
+
+    rollout = getattr(service, "rollout", None)
+    if rollout is None:
+        return Response(404, {"detail": "model lifecycle is not enabled on "
+                                        "this stage (rollout_enabled)"})
+    payload = payload or {}
+    action = str(payload.get("action", ""))
+    version = payload.get("version")
+    if version is not None:
+        try:
+            version = int(version)
+        except (TypeError, ValueError):
+            raise ValueError("version must be an integer") from None
+    try:
+        if action == "promote":
+            return Response(200, rollout.promote(version))
+        if action == "rollback":
+            return Response(200, rollout.rollback())
+        if action == "pin":
+            return Response(200, rollout.pin(version))
+        if action == "unpin":
+            return Response(200, rollout.unpin())
+        if action == "cycle":
+            block = bool(payload.get("block", False))
+            return Response(200, rollout.run_cycle(reason="operator",
+                                                   block=block))
+    except (RolloutError, StoreError) as exc:
+        # state conflicts (nothing shadowing, unknown version, nothing to
+        # roll back to) are client errors, not server faults
+        raise ValueError(str(exc)) from exc
+    raise ValueError(f"unknown action {action!r} (expected 'promote', "
+                     "'rollback', 'pin', 'unpin', or 'cycle')")
+
+
 def _replicas_control(service, query, payload) -> Response:
     router = getattr(service.engine, "router", None)
     if router is None:
@@ -273,6 +320,8 @@ ROUTES: Tuple[Route, ...] = (
           "download the newest completed capture as a zip"),
     Route("GET", "/admin/replicas", _replicas,
           "replica-router roll-up: per-replica state/backlog/inflight"),
+    Route("GET", "/admin/model", _model,
+          "model lifecycle status (?history=1 for the checkpoint log)"),
     Route("POST", "/admin/start", _start, "start the engine"),
     Route("POST", "/admin/stop", _stop, "stop the engine"),
     Route("POST", "/admin/shutdown", _shutdown, "shut the service down"),
@@ -286,6 +335,8 @@ ROUTES: Tuple[Route, ...] = (
           "start/stop an open-loop load run against a pipeline"),
     Route("POST", "/admin/replicas", _replicas_control,
           "operator drain/undrain of one replica"),
+    Route("POST", "/admin/model", _model_control,
+          "model lifecycle verbs: promote/rollback/pin/unpin/cycle"),
 )
 
 

@@ -1,0 +1,162 @@
+"""CI smoke for the dmroll model lifecycle, end to end on CPU, driven
+through the admin plane exactly as an operator would.
+
+Boots a real Service hosting a tiny jax_scorer with ``rollout_enabled``,
+fits it on synthetic rows, then exercises the whole lifecycle over HTTP:
+
+* ``POST /admin/model {"action": "cycle", "block": true}`` — sample →
+  fine-tune → versioned checkpoint → shadow → auto-promote → hot-swap,
+  twice (v1 then v2);
+* ``POST /admin/model {"action": "rollback"}`` — back to v1 off the
+  versioned store;
+* scores keep flowing after every swap (alert-all threshold, so each
+  batch must emit), and ``GET /admin/xla`` must report ZERO unexpected
+  recompiles across all of it — the zero-downtime contract;
+* ``/metrics`` must export ``model_swaps_total`` (promoted + rolled_back),
+  ``model_version_info`` and a populated ``model_shadow_divergence``;
+* the store's ``MANIFEST.json`` is copied to ``--manifest-out`` for the
+  workflow-artifact upload.
+
+Fail-fast: every HTTP call has a 10 s timeout and each gate asserts
+immediately with the observed state in the message — no polling loops
+that can hang a runner.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import urllib.request
+
+
+def http_json(port: int, path: str, payload=None, method=None) -> dict:
+    body = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        method=method or ("POST" if payload is not None else "GET"),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--manifest-out", default="rollout-manifest.json")
+    args = ap.parse_args()
+
+    from detectmateservice_tpu.core import Service
+    from detectmateservice_tpu.engine import device_obs
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+    from detectmateservice_tpu.schemas import ParserSchema
+    from detectmateservice_tpu.settings import ServiceSettings
+
+    def msg(i: int) -> bytes:
+        return ParserSchema(
+            EventID=1, template="user <*> logged in from <*>",
+            variables=[f"u{i % 8}", f"10.0.0.{i % 16}"], logID=str(i),
+            logFormatVariables={"Time": "1700000000"}).serialize()
+
+    device_obs.get_ledger().reset()
+    tmp = tempfile.mkdtemp(prefix="rollout-smoke-")
+    detector_cfg = {"detectors": {"JaxScorerDetector": {
+        "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
+        "data_use_training": 32, "train_epochs": 1, "min_train_steps": 5,
+        "seq_len": 16, "dim": 32, "max_batch": 64, "async_fit": False,
+        "host_score_max_batch": 0, "score_threshold": -1e9,
+    }}}
+    service = Service(
+        ServiceSettings(
+            component_type="detectors.jax_scorer.JaxScorerDetector",
+            component_name="rollout-smoke",
+            engine_addr="inproc://rollout-smoke", engine_autostart=False,
+            http_port=0, log_to_file=False, watchdog_enabled=False,
+            rollout_enabled=True, rollout_dir=os.path.join(tmp, "store"),
+            rollout_interval_s=3600.0, rollout_sample_ratio=1.0,
+            rollout_sample_capacity=256, rollout_min_fit_rows=32,
+            rollout_min_shadow_samples=64, rollout_shadow_timeout_s=60.0,
+            rollout_max_mean_delta=5.0, rollout_max_flip_ratio=0.1,
+            rollout_keep_checkpoints=3),
+        component_config=detector_cfg,
+        socket_factory=InprocQueueSocketFactory())
+    assert service.rollout is not None, "RolloutManager was not built"
+    service.setup_io()
+    service.web_server.start()
+    port = service.web_server.port
+    det = service.library_component
+    try:
+        # train + fit, then bank sampled rows for the first cycle
+        assert det.process_batch([msg(i) for i in range(32)]) == []
+        det.flush_final()
+        for r in range(4):
+            det.process_batch([msg(100 + 16 * r + i) for i in range(16)])
+        det.flush()
+
+        def flow_check(tag: str, base: int) -> None:
+            outs = [o for o in det.process_batch(
+                [msg(base + i) for i in range(16)]) if o is not None]
+            outs += [o for o in det.flush() if o is not None]
+            assert outs, f"no scores flowed {tag}"
+
+        # cycle 1: fine-tune -> shadow -> auto-promote -> hot-swap (v1)
+        cycle = http_json(port, "/admin/model", {"action": "cycle",
+                                                 "block": True})
+        outcome = cycle.get("outcome") or {}
+        assert outcome.get("result") == "promoted", f"cycle 1: {cycle}"
+        status = http_json(port, "/admin/model")
+        assert status["live_version"] == 1, status
+        assert status["detector_version"] == 1, status
+        flow_check("after v1 swap", 300)
+
+        # cycle 2 -> v2, then roll back to v1 off the versioned store
+        cycle = http_json(port, "/admin/model", {"action": "cycle",
+                                                 "block": True})
+        outcome = cycle.get("outcome") or {}
+        assert outcome.get("result") == "promoted", f"cycle 2: {cycle}"
+        assert http_json(port, "/admin/model")["live_version"] == 2
+        flow_check("after v2 swap", 400)
+        rollback = http_json(port, "/admin/model", {"action": "rollback"})
+        assert rollback.get("result") == "rolled_back", rollback
+        status = http_json(port, "/admin/model")
+        assert status["live_version"] == 1, status
+        assert status["detector_version"] == 1, status
+        flow_check("after rollback", 500)
+
+        history = http_json(port, "/admin/model?history=1")
+        versions = [e["version"] for e in history["checkpoints"]]
+        assert 1 in versions and 2 in versions, history
+
+        # the zero-downtime contract: nothing across fit/shadow/swap/
+        # rollback may have compiled on the dispatch path post-warm-up
+        xla = http_json(port, "/admin/xla")
+        assert xla["totals"]["unexpected"] == 0, xla["totals"]
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            expo = resp.read().decode()
+        for needle in ('model_swaps_total{', 'result="promoted"',
+                       'result="rolled_back"', "model_version_info{",
+                       "model_shadow_divergence_count"):
+            assert needle in expo, f"{needle} missing from /metrics"
+
+        manifest = os.path.join(tmp, "store", "MANIFEST.json")
+        shutil.copyfile(manifest, args.manifest_out)
+        print(f"[rollout-smoke] PASS — live v{status['live_version']}, "
+              f"{len(versions)} versions in store, unexpected=0; manifest "
+              f"-> {args.manifest_out}")
+        return 0
+    finally:
+        if service.rollout is not None:
+            service.rollout.stop()
+        service.health.stop()
+        service.web_server.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -63,6 +63,15 @@ SCENARIOS = {
                      "router's replica_drain event, requeue_total > 0, "
                      "post-settle loss == 0, survivors' unexpected "
                      "recompiles == 0"),
+    "rollout": (("ModelCanaryDiverging",),
+                "under continued load the dmroll cycle fine-tunes a "
+                "candidate on sampled live traffic, shadows it, "
+                "auto-promotes through the gate, and hot-swaps it "
+                "mid-stream (gates: loss == 0, zero unexpected "
+                "recompiles, divergence series populated); then a "
+                "deliberately-broken candidate shadows — gated on "
+                "ModelCanaryDiverging firing and the "
+                "model_canary_holdback event"),
 }
 
 AUDIT_LOG_FORMAT = "type=<Type> msg=audit(<Time>): <Content>"
@@ -70,7 +79,7 @@ AUDIT_TEMPLATE = ("arch=<*> syscall=<*> success=<*> exit=<*> pid=<*> "
                   "uid=<*> comm=<*> exe=<*>")
 
 
-def build_settings(tmp: Path, burst: int):
+def build_settings(tmp: Path, burst: int, rollout_dir=None):
     """The three service settings + component configs of the soak pipeline.
     Frame sizes are kept uniform (engine_frame_batch == loadgen burst) so
     wire frames map ~1:1 through every stage and the FIFO trace attachment
@@ -88,11 +97,25 @@ def build_settings(tmp: Path, burst: int):
         component_id="soak-parser", trace_stage="parser",
         engine_addr="inproc://soak-parser",
         out_addr=["inproc://soak-detector"], **common)
+    rollout = {}
+    if rollout_dir is not None:
+        # the dmroll cycle, CI-sized: a generous mean-delta gate (a 1-epoch
+        # fine-tune on a tiny MLP legitimately moves scores a little; the
+        # gate semantics themselves are pinned by tests/test_rollout.py)
+        # and a huge interval — the harness drives cycles explicitly
+        rollout = dict(
+            rollout_enabled=True, rollout_dir=str(rollout_dir),
+            rollout_interval_s=3600.0, rollout_sample_ratio=1.0,
+            rollout_sample_capacity=256, rollout_min_fit_rows=64,
+            rollout_train_epochs=1, rollout_min_shadow_samples=128,
+            rollout_shadow_timeout_s=60.0, rollout_max_mean_delta=3.0,
+            rollout_max_flip_ratio=0.05, rollout_auto_promote=True,
+            rollout_keep_checkpoints=4)
     detector = ServiceSettings(
         component_type="detectors.jax_scorer.JaxScorerDetector",
         component_id="soak-detector", trace_stage="detector",
         engine_addr="inproc://soak-detector",
-        out_addr=["inproc://soak-output"], **common)
+        out_addr=["inproc://soak-output"], **rollout, **common)
     output = ServiceSettings(
         component_type="outputs.file_sink.OutputWriter",
         component_id="soak-output", trace_stage="output",
@@ -132,11 +155,12 @@ def build_settings(tmp: Path, burst: int):
             (output, output_cfg)]
 
 
-def boot_pipeline(tmp: Path, factory, burst: int):
+def boot_pipeline(tmp: Path, factory, burst: int, rollout_dir=None):
     from detectmateservice_tpu.core import Service
 
     services = []
-    for settings, config in build_settings(tmp, burst):
+    for settings, config in build_settings(tmp, burst,
+                                           rollout_dir=rollout_dir):
         service = Service(settings, component_config=config,
                           socket_factory=factory)
         service.setup_io()
@@ -196,8 +220,10 @@ def boot_replica_pipeline(tmp: Path, factory, burst: int,
 
 def teardown_pipeline(services) -> None:
     for service in reversed(services):
-        for step in (service.stop, service.health.stop,
-                     service.web_server.stop):
+        steps = [service.stop, service.health.stop, service.web_server.stop]
+        if service.rollout is not None:
+            steps.insert(0, service.rollout.stop)
+        for step in steps:
             try:
                 step()
             except Exception:
@@ -302,9 +328,11 @@ def main() -> int:
     # per-scenario fault/scale defaults: each fault must outlive its rule's
     # (scaled) detection horizon — threshold crossing + for: hold
     fault_defaults = {"none": 0.0, "stall": 45.0, "slow_sink": 45.0,
-                      "recompile": 8.0, "replica_kill": 40.0}
+                      "recompile": 8.0, "replica_kill": 40.0,
+                      "rollout": 45.0}
     scale_defaults = {"none": 6.0, "stall": 6.0, "slow_sink": 12.0,
-                      "recompile": 6.0, "replica_kill": 12.0}
+                      "recompile": 6.0, "replica_kill": 12.0,
+                      "rollout": 12.0}
     fault_s = (args.fault_seconds if args.fault_seconds is not None
                else fault_defaults[args.scenario])
     time_scale = (args.time_scale if args.time_scale is not None
@@ -379,6 +407,9 @@ def main() -> int:
     with tempfile.TemporaryDirectory() as tmp:
         if args.scenario == "replica_kill":
             services = boot_replica_pipeline(Path(tmp), factory, args.burst)
+        elif args.scenario == "rollout":
+            services = boot_pipeline(Path(tmp), factory, args.burst,
+                                     rollout_dir=Path(tmp) / "rollout")
         else:
             services = boot_pipeline(Path(tmp), factory, args.burst)
         scraper = Scraper(store, evaluator, services)
@@ -515,6 +546,34 @@ def main() -> int:
                     router_service.engine.router.replicas[victim_pos] \
                         .admin_url = (f"http://127.0.0.1:"
                                       f"{victim.web_server.port}")
+                elif args.scenario == "rollout":
+                    # phase A (healthy): one full dmroll cycle under load —
+                    # sample → fine-tune → checkpoint → shadow → promote →
+                    # hot-swap, all while the generator streams
+                    det_service = services[1]
+                    mgr = det_service.rollout
+                    info = mgr.run_cycle(reason="soak", block=True)
+                    record["rollout_cycle"] = info
+                    outcome = info.get("outcome") or {}
+                    check("rollout_promoted_mid_stream",
+                          outcome.get("result") == "promoted",
+                          f"cycle: {info.get('skipped') or outcome}")
+                    # phase B (broken canary): live params scaled 10x —
+                    # saturated logits, scores orders of magnitude off;
+                    # the gate overrides keep it shadowing (divergence
+                    # flowing) for most of the fault window, then the
+                    # shadow timeout resolves it to a holdback. The
+                    # manager thread ticks the shadow ~1/s by itself.
+                    import jax
+
+                    det = det_service.library_component
+                    broken = jax.tree_util.tree_map(lambda a: a * 10.0,
+                                                    det._params)
+                    mgr.inject_candidate(
+                        broken, det._opt_state, tag="broken-injected",
+                        min_samples=10**9,
+                        timeout_s=max(5.0, fault_s - 10.0))
+                    time.sleep(fault_s)
                 fault_held_s = time.monotonic() - fault_t0
                 generator.wait(timeout=lead_s + fault_s + tail_s
                                + fault_s + 60.0 + 60.0)
@@ -558,6 +617,43 @@ def main() -> int:
                         c for c in ledger_doc.get("compiles", [])
                         if c.get("unexpected")]
                     check("no_unexpected_recompiles_on_survivors",
+                          unexpected == 0,
+                          f"scorer_xla_recompiles_unexpected_total="
+                          f"{unexpected}")
+                if args.scenario == "rollout":
+                    # the rollout contract, gated by execution: the swap
+                    # was served, nothing was lost across it, the compile
+                    # set held, the divergence series populated, and the
+                    # broken canary was held back
+                    det_service = services[1]
+                    det = det_service.library_component
+                    status = det_service.rollout.status()
+                    record["rollout_status"] = status
+                    check("rollout_loss_zero_across_swap",
+                          chaos["scorecard"]["loss"] == 0,
+                          f"loss={chaos['scorecard']['loss']} of "
+                          f"{chaos['scorecard']['sent_frames']} frames")
+                    check("rollout_live_version_served",
+                          (status["live_version"] is not None
+                           and det.model_version()
+                           == status["live_version"]),
+                          f"detector serves v{det.model_version()}, store "
+                          f"live v{status['live_version']}")
+                    kinds = [e.get("kind") for e in
+                             det_service.events.snapshot()["events"]]
+                    check("model_canary_holdback_event",
+                          "model_canary_holdback" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    from prometheus_client import generate_latest
+                    div_count = sum(
+                        float(line.rsplit(" ", 1)[1])
+                        for line in generate_latest().decode().splitlines()
+                        if line.startswith("model_shadow_divergence_count"))
+                    check("divergence_series_populated", div_count > 0,
+                          f"model_shadow_divergence_count={div_count:.0f}")
+                    ledger_doc = device_obs.get_ledger().snapshot()
+                    unexpected = ledger_doc["totals"]["unexpected"]
+                    check("no_unexpected_recompiles_across_swap",
                           unexpected == 0,
                           f"scorer_xla_recompiles_unexpected_total="
                           f"{unexpected}")

@@ -112,6 +112,7 @@ class TestAlertRules:
         for required in ("StageScrapeDown", "EngineLoopStalled", "StageUnhealthy",
                          "OutputBackpressureSustained", "MessageDropRateHigh",
                          "RecompileStorm", "DeviceHbmPressure",
+                         "ModelCanaryDiverging", "ModelCheckpointStale",
                          "PipelineLatencyBudgetBurnFast",
                          "PipelineLatencyBudgetBurnSlow"):
             assert required in names, f"missing alert rule {required}"
