@@ -58,6 +58,8 @@ ALERT_COVERED_SERIES = (
     "router_requeue_total",
     "model_shadow_divergence",
     "model_checkpoint_age_seconds",
+    "wal_spool_depth_frames",
+    "wal_oldest_unacked_age_seconds",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

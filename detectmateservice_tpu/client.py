@@ -21,6 +21,10 @@ dmroll model lifecycle behind ``/admin/model``; ``deploy --version N``
 rolls one checkpoint across a replica tier — drain → promote → verify →
 undrain per replica via the router admin plane, rolling back on any
 rejection)
+``replay [status] [--shadow --version N] [--wal-dir D] [--limit N]``
+(re-drive a recorded WAL ingress spool through the stage behind
+``/admin/replay`` — deterministic pipeline replay/backfill, or ``--shadow``
+offline scoring of a dmroll candidate against recorded traffic),
 and ``health`` — which fans out across every stage of
 a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
 ``stages:`` mapping), prints a roll-up table, and exits non-zero when any
@@ -177,6 +181,17 @@ class DetectMateClient:
     def load_status(self) -> Any:
         """Live SLO scorecard of the load run (``GET /admin/load``)."""
         return self._request("GET", "/admin/load")
+
+    def replay_status(self) -> Any:
+        """WAL replay status + the live ingress spool's stats
+        (``GET /admin/replay``)."""
+        return self._request("GET", "/admin/replay")
+
+    def replay_start(self, payload: dict) -> Any:
+        """Start (or, with ``wait: true``, run to completion) a WAL replay
+        (``POST /admin/replay``). HTTP 409 (another replay, or pipeline
+        mode against a running engine) raises urllib.error.HTTPError."""
+        return self._request("POST", "/admin/replay", payload)
 
     def profile_start(self, seconds: float = 1.0) -> Any:
         """Start an on-demand jax.profiler capture
@@ -465,6 +480,57 @@ def run_profile(client: DetectMateClient, seconds: float, wait: bool,
     return 0
 
 
+def run_replay(client: DetectMateClient, args) -> int:
+    """``client.py replay``: re-drive a recorded WAL spool through the
+    stage behind ``/admin/replay``. ``status`` reads the manager + spool
+    state; a start without ``--no-wait`` blocks until the run completes and
+    exits non-zero when it errors. ``--shadow`` scores a dmroll candidate
+    (``--version``, or the store's newest) against the recorded traffic
+    and prints the offline divergence report."""
+    import time as _time
+
+    if args.action == "status":
+        print(json.dumps(client.replay_status(), indent=2))
+        return 0
+    payload: dict = {"mode": "shadow" if args.shadow else "pipeline",
+                     "wait": not args.no_wait}
+    if args.wal_dir:
+        payload["wal_dir"] = args.wal_dir
+    if args.limit is not None:
+        payload["limit"] = args.limit
+    if args.start_seq:
+        payload["start_seq"] = args.start_seq
+    if args.force:
+        payload["force"] = True
+    if args.shadow:
+        if args.version is not None:
+            payload["version"] = args.version
+        if args.store_dir:
+            payload["store_dir"] = args.store_dir
+    try:
+        result = client.replay_start(payload)
+    except urllib.error.HTTPError as exc:
+        print(f"replay rejected ({exc.code}): "
+              f"{exc.read().decode('utf-8', errors='replace')}",
+              file=sys.stderr)
+        return 1
+    if args.no_wait:
+        print(json.dumps(result, indent=2))
+        return 0
+    # waited runs return the finished outcome directly; poll anyway in case
+    # the server answered "started" (an older build)
+    deadline = _time.monotonic() + args.timeout
+    while (result.get("state") == "started"
+           and _time.monotonic() < deadline):
+        _time.sleep(0.5)
+        status = client.replay_status()
+        if not status.get("running") and status.get("last"):
+            result = status["last"]
+            break
+    print(json.dumps(result, indent=2))
+    return 0 if result.get("state") == "done" else 1
+
+
 def _parse_mix(spec: str) -> dict:
     """``anomaly=0.005,json=0.01,invalid_utf8=0.005`` → mix dict."""
     mix = {}
@@ -630,6 +696,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     model_p.add_argument("--timeout", type=float, default=120.0,
                          help="deploy: per-replica drain/active wait "
                               "(default 120 s)")
+    replay_p = sub.add_parser(
+        "replay", help="replay a recorded WAL spool through the stage "
+                       "(/admin/replay): deterministic pipeline re-drive, "
+                       "or --shadow offline canary scoring")
+    replay_p.add_argument("action", nargs="?", default="run",
+                          choices=["run", "status"],
+                          help="run (default) starts a replay; status "
+                               "reads the manager + spool state")
+    replay_p.add_argument("--wal-dir",
+                          help="spool directory (default: the stage's "
+                               "configured wal_dir)")
+    replay_p.add_argument("--shadow", action="store_true",
+                          help="score a dmroll candidate against the "
+                               "recorded traffic and print the divergence "
+                               "report instead of re-driving the pipeline")
+    replay_p.add_argument("--version", type=int, default=None,
+                          help="shadow: candidate checkpoint version "
+                               "(default: the store's newest)")
+    replay_p.add_argument("--store-dir",
+                          help="shadow: checkpoint store root (default: "
+                               "the stage's rollout_dir)")
+    replay_p.add_argument("--limit", type=int, default=None,
+                          help="replay at most N recorded frames")
+    replay_p.add_argument("--start-seq", type=int, default=0,
+                          help="skip records at or below this sequence")
+    replay_p.add_argument("--force", action="store_true",
+                          help="pipeline mode: replay even while the "
+                               "engine is running (interleaves!)")
+    replay_p.add_argument("--no-wait", action="store_true",
+                          help="return immediately; poll `replay status`")
+    replay_p.add_argument("--timeout", type=float, default=600.0,
+                          help="wait budget in seconds (default 600)")
     trace = sub.add_parser(
         "trace", help="read the pipeline flight recorder (/admin/trace)")
     trace.add_argument("--chrome", action="store_true",
@@ -657,6 +755,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_load(client, args)
         if args.command == "model":
             return run_model(client, args)
+        if args.command == "replay":
+            return run_replay(client, args)
         if args.command == "events":
             result = client.events(limit=args.limit)
         elif args.command == "xla":

@@ -128,6 +128,11 @@ class Engine:
         )
         self._running = False
         self._stop_event = threading.Event()
+        # crash seam (crash_abort): when set, the loop thread exits at the
+        # next check WITHOUT the drain epilogue and _send_results becomes a
+        # no-op — the closest an in-process harness gets to kill -9. The
+        # ingress_crash soak and the WAL recovery tests die through this.
+        self._abort_event = threading.Event()
         # drain-then-close deadline, set ONCE when the first blocked send
         # observes the stop flag and shared by every message drained after it
         # — an aggregate budget, so N pending messages at stop cannot stack
@@ -226,6 +231,19 @@ class Engine:
             self._close_all()
             raise
 
+        # durable ingress (wal/): with ``durable_ingress`` every received
+        # frame is appended to the WAL spool before processing; acks advance
+        # once results leave the process, and _run_loop replays the unacked
+        # suffix before accepting new traffic after a restart. None when
+        # off — the hot path then pays one attribute read per frame.
+        self._spool = None
+        self._replaying = False
+        try:
+            self._setup_spool()
+        except Exception:
+            self._close_all()
+            raise
+
     # ------------------------------------------------------------------
     def _create_ingress(self) -> EngineSocket:
         """Build the input side: one listener on ``engine_addr``, or — when
@@ -316,6 +334,37 @@ class Engine:
             self.settings, self._factory, self.logger, self._labels,
             monitor=self._health, abort_check=self._router_abort)
 
+    def _setup_spool(self) -> None:
+        """Open (or recover) the durable ingress spool and bind the dmwal
+        gauges to it at scrape time — depth/bytes/age stay readable even
+        while the engine thread is dead, which is exactly when the
+        SpoolAgeHigh alert must keep climbing."""
+        if not getattr(self.settings, "durable_ingress", False):
+            return
+        from ..wal import IngressSpool
+
+        s = self.settings
+        self._spool = IngressSpool(
+            s.wal_dir,
+            segment_bytes=s.wal_segment_bytes,
+            fsync_interval_ms=s.wal_fsync_interval_ms,
+            retain_bytes=s.wal_retain_bytes,
+            retain_age_s=s.wal_retain_age_s,
+            fsync_observer=m.WAL_FSYNC_SECONDS().labels(**self._labels).inc,
+            logger=self.logger)
+        spool = self._spool
+        m.WAL_SPOOL_DEPTH().labels(**self._labels) \
+            .set_function(spool.depth_frames)
+        m.WAL_SPOOL_BYTES().labels(**self._labels) \
+            .set_function(spool.spool_bytes)
+        m.WAL_OLDEST_UNACKED_AGE().labels(**self._labels) \
+            .set_function(spool.oldest_unacked_age_seconds)
+        self._m_wal_recovered = m.WAL_REPLAYED_FRAMES().labels(
+            mode="recovery", **self._labels)
+        self.logger.info(
+            "durable ingress armed: spool at %s (%d unacked to replay)",
+            s.wal_dir, int(spool.depth_frames()))
+
     def _router_abort(self) -> bool:
         """Stop-aware backpressure escape for the router's block mode: the
         same single shared drain window the output pump uses, so a stop with
@@ -364,11 +413,13 @@ class Engine:
                 self._setup_output_sockets()
                 self._setup_zero_copy()
                 self._setup_router()
+                self._setup_spool()
             except Exception:
                 self._close_all()
                 raise
             self._sockets_closed = False
         self._stop_event.clear()
+        self._abort_event.clear()
         self._stop_drain_deadline = None
         # re-stamp the heartbeats so a restart does not instantly trip the
         # watchdog on ages accumulated while the engine was (healthily) down
@@ -419,10 +470,41 @@ class Engine:
         if self.router is not None:
             self.router.close()
             self.router = None
+        if self._spool is not None:
+            # clean shutdown: final fsync + manifest commit, so the next
+            # start replays nothing (a CRASH never reaches here — that is
+            # the unacked suffix recovery's whole job)
+            try:
+                self._spool.close()
+            except Exception as exc:
+                self.logger.error("WAL spool close failed: %s", exc)
+            self._spool = None
+
+    def crash_abort(self) -> None:
+        """CHAOS/TEST SEAM — die like kill -9, minus the process exit: the
+        loop thread stops at its next check without the drain epilogue, no
+        processor flush runs, nothing further leaves the process
+        (_send_results is gated), the spool is neither acked nor cleanly
+        committed, and the sockets stay open. ``start()`` afterwards is the
+        "restarted process": with durable_ingress on it must replay the
+        unacked suffix. Used by the ingress_crash soak scenario and the WAL
+        recovery tests; never called by production code paths."""
+        self._abort_event.set()
+        self._running = False
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=_STOP_JOIN_S)
+        self._thread = None
 
     @property
     def running(self) -> bool:
         return self._running
+
+    @property
+    def spool(self):
+        """The durable ingress spool (None when ``durable_ingress`` is
+        off) — the admin plane reads its stats via GET /admin/replay."""
+        return self._spool
 
     # -- hot loop -------------------------------------------------------
     def _ingest_trace(self, raw: bytes, err_c) -> Optional[bytes]:
@@ -506,6 +588,8 @@ class Engine:
         its payload, not its ~40 wire bytes) and lines per contained message
         (the reference's newline rule)."""
         if not getattr(self.settings, "engine_frame_autodetect", True):
+            if self._spool is not None and not self._replaying:
+                self._spool.append(raw)
             read_b.inc(len(raw))
             read_l.inc(_count_lines(raw))
             return [raw]
@@ -513,6 +597,15 @@ class Engine:
             raw = self._resolve_shm(raw, err_c)
             if not raw:
                 return []
+        # durable ingress: record the frame BEFORE any processing — post
+        # shm-resolution (a slot reference is not durable), pre trace-strip
+        # (the recorded bytes keep their original trace id + ingest stamp,
+        # which is what makes replay byte-faithful). The tick keeps the
+        # fsync cadence honest inside long burst-collect windows, when the
+        # loop-top tick cannot run.
+        if self._spool is not None and not self._replaying:
+            self._spool.append(raw)
+            self._spool.tick()
         read_b.inc(len(raw))
         # first-byte probe before the slice compare: protobuf payloads never
         # start 0xD7, so the untraced common case pays one int compare here
@@ -640,9 +733,28 @@ class Engine:
         # redelivery) runs on THIS thread — sockets are single-threaded by
         # design; the no-work tick is one lock acquire + three scans
         router = self.router
+        # durable ingress: replay the spool's unacked suffix through the
+        # pipeline BEFORE accepting new socket traffic — the restart half
+        # of the crash-recovery contract (docs/durability.md)
+        spool = self._spool
+        if spool is not None:
+            self._replay_recovered(read_b, read_l, err_c)
         # dmlint: hot-loop
-        while self._running and not self._stop_event.is_set():
+        while (self._running and not self._stop_event.is_set()
+               and not self._abort_event.is_set()):
             self._hb_loop.beat()
+            if spool is not None:
+                # FIFO ack: everything appended before now has been handed
+                # to the processor and its immediate results dispatched;
+                # held rows (coalescer/pipelined) and unsettled router
+                # windows hold the watermark back until they drain — acks
+                # then advance at the next quiet point (at-least-once:
+                # conservative lag, never an early ack)
+                if ((pending_fn is None or pending_fn() == 0)
+                        and (router is None
+                             or router.unacked_total() == 0)):
+                    spool.ack(spool.last_appended_seq)
+                spool.tick()
             if router is not None:
                 router.tick()
             if callable(pending_fn):
@@ -692,6 +804,11 @@ class Engine:
                         nxt = self._resolve_shm(nxt, err_c)
                         if not nxt:
                             return None
+                    # durable ingress: same append point (and mid-burst
+                    # fsync tick) as _expand_frame
+                    if spool is not None:
+                        spool.append(nxt)
+                        spool.tick()
                     read_b.inc(len(nxt))
                     if self._trace_enabled or nxt.startswith(MAGIC_V2):
                         nxt = self._ingest_trace(nxt, err_c)
@@ -793,6 +910,10 @@ class Engine:
             if self._trace_pending:
                 self._finalize_traces()
 
+        # crash seam: a kill -9 runs no drain epilogue — the spool keeps its
+        # unacked suffix and the restart replays it (the recovery contract)
+        if self._abort_event.is_set():
+            return
         # loop exiting (stop requested): drain the pipeline before sockets
         # close — flush_final (when provided) also waits out work the
         # idle-time flush leaves running, e.g. a background boundary fit
@@ -807,6 +928,123 @@ class Engine:
             # last redelivery pass so frames requeued from a drained replica
             # are not abandoned in the requeue queue at stop
             router.tick()
+        if spool is not None:
+            # clean stop: the final flush drained everything the processor
+            # held, so the whole appended prefix is handed off — ack it and
+            # commit, UNLESS the router tier still holds unsettled frames
+            # (those stay unacked; a restart replays them, at-least-once)
+            if router is None or router.unacked_total() == 0:
+                spool.ack(spool.last_appended_seq)
+            spool.tick(force=True)
+
+    def _replay_recovered(self, read_b, read_l, err_c) -> None:
+        """Durable-ingress restart recovery: re-drive the spool's unacked
+        suffix through the processor before the loop touches the socket —
+        one frame at a time (recovery is a cold path; burst shaping would
+        buy nothing and cost determinism of the drain below), through the
+        same expand/trace/dispatch machinery as live traffic, with spool
+        re-appends suppressed. The suffix only acks once everything has
+        actually left: processor-held rows drained AND (router mode) the
+        replica windows watermark-settled — interrupted or incomplete
+        recovery leaves it unacked for the next start (at-least-once)."""
+        spool = self._spool
+        pending = spool.recover_unacked()
+        if not pending:
+            return
+        self.logger.warning(
+            "durable ingress: replaying %d unacked spool frames "
+            "(seq %d..%d) before accepting new traffic",
+            len(pending), pending[0][0], pending[-1][0])
+        batch_fn = getattr(self.processor, "process_batch", None)
+        frames_fn = getattr(self.processor, "process_frames", None)
+        batch_size = max(1, self.settings.engine_batch_size)
+        use_batches = batch_size > 1 and callable(batch_fn)
+        use_frames = (use_batches and callable(frames_fn)
+                      and getattr(self.settings,
+                                  "engine_frame_autodetect", True))
+        self._replaying = True
+        try:
+            for _seq, raw in pending:
+                if self._stop_event.is_set() or self._abort_event.is_set():
+                    return
+                if use_frames:
+                    read_b.inc(len(raw))
+                    if self._trace_enabled or raw.startswith(MAGIC_V2):
+                        raw = self._ingest_trace(raw, err_c)
+                    if raw:
+                        try:
+                            outs, _n, n_lines = frames_fn([raw])
+                            read_l.inc(n_lines)
+                            self._send_results(outs)
+                        except Exception as exc:
+                            err_c.inc()
+                            self.logger.error(
+                                "recovery process_frames() raised: %s", exc)
+                    self._finalize_traces()
+                    continue
+                msgs = self._expand_frame(raw, read_b, read_l, err_c)
+                for start in range(0, len(msgs), batch_size):
+                    chunk = msgs[start:start + batch_size]
+                    try:
+                        if use_batches:
+                            self._send_results(batch_fn(chunk))
+                        else:
+                            for msg in chunk:
+                                out = self.processor.process(msg)
+                                if out is not None:
+                                    self._send_results([out])
+                    except Exception as exc:
+                        err_c.inc(len(chunk))
+                        self.logger.error("recovery processing raised: %s",
+                                          exc)
+                self._finalize_traces()
+            # drain held/pipelined rows so the replayed frames are really
+            # delivered before they ack (bounded: an unhealthy processor
+            # must not wedge startup forever — the remainder stays unacked)
+            flush_fn = getattr(self.processor, "flush", None)
+            pending_fn = getattr(self.processor, "pending_count", None)
+            drain_fn = getattr(self.processor, "drain_ready", None) \
+                or flush_fn
+            if callable(flush_fn):
+                try:
+                    self._send_results(flush_fn())
+                except Exception as exc:
+                    err_c.inc()
+                    self.logger.error("recovery flush raised: %s", exc)
+            deadline = time.monotonic() + 30.0
+            while (callable(pending_fn) and pending_fn() > 0
+                   and time.monotonic() < deadline
+                   and not self._stop_event.is_set()
+                   and not self._abort_event.is_set()):
+                try:
+                    self._send_results(drain_fn())
+                except Exception as exc:
+                    err_c.inc()
+                    self.logger.error("recovery drain raised: %s", exc)
+                    break
+                time.sleep(0.005)
+            if callable(pending_fn) and pending_fn() > 0:
+                self.logger.error(
+                    "recovery: %d results still pending after the drain "
+                    "window; their frames stay unacked", pending_fn())
+                return
+            router = self.router
+            if router is not None:
+                deadline = time.monotonic() + 30.0
+                while (router.unacked_total() > 0
+                       and time.monotonic() < deadline
+                       and not self._stop_event.is_set()):
+                    router.tick()
+                    time.sleep(0.01)
+                if router.unacked_total() > 0:
+                    return
+            spool.ack(spool.last_appended_seq)
+            spool.tick(force=True)
+            self._m_wal_recovered.inc(len(pending))
+            self.logger.info("durable ingress: recovery replay complete "
+                             "(%d frames)", len(pending))
+        finally:
+            self._replaying = False
 
     # -- fan-out --------------------------------------------------------
     def _send_results(self, outs, origins=None) -> None:
@@ -827,6 +1065,11 @@ class Engine:
         map 1:1 through the stage, approximate under merging/re-chunking)
         and leaves as a v2 traced frame; replies (no outputs) never carry
         trace headers — that stage is the pipeline terminal."""
+        if self._abort_event.is_set():
+            # crash seam: a killed process sends nothing — results of the
+            # in-flight burst are lost here exactly as a real kill -9 loses
+            # them, which is what the WAL recovery replay must cover
+            return
         frame_batch = getattr(self.settings, "engine_frame_batch", 1)
         if origins is not None and len(origins) == len(outs):
             pending = [(o, origins[i]) for i, o in enumerate(outs)

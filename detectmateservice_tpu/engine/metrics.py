@@ -377,6 +377,41 @@ MODEL_VERSION_INFO = _series(
     "model family (0 = the boot-time fit, never hot-swapped)",
     MODEL_VERSION_LABELS)
 
+# durable ingress spool (wal/, PR 11): the dmwal observability contract.
+# Depth/bytes/age are computed AT SCRAPE TIME (Gauge.set_function bound to
+# the live spool — a wedged engine thread cannot freeze them, the same
+# discipline as the heartbeat ages); depth is appended-minus-acked frames,
+# age is how long the OLDEST unacked record has been waiting — the two
+# SpoolDepthHigh/SpoolAgeHigh alert signals (a growing age with a flat
+# depth means the stage stopped draining entirely: the ingress_crash soak
+# fires it during the outage). fsync seconds attribute the durability tax;
+# replayed frames count recovery replays (mode="recovery", after a crash),
+# operator pipeline replays (mode="pipeline") and offline canary scoring
+# (mode="shadow") separately.
+WAL_SPOOL_DEPTH = _series(
+    Gauge, "wal_spool_depth_frames",
+    "Frames appended to the durable ingress spool but not yet acked "
+    "(handed downstream); read at scrape time off the live spool")
+WAL_SPOOL_BYTES = _series(
+    Gauge, "wal_spool_bytes",
+    "On-disk bytes of the ingress spool's segment files (retention prunes "
+    "sealed fully-acked segments; the unacked suffix is never pruned)")
+WAL_OLDEST_UNACKED_AGE = _series(
+    Gauge, "wal_oldest_unacked_age_seconds",
+    "Age of the oldest unacked spool record; keeps growing while the "
+    "stage is down or wedged (the SpoolAgeHigh signal)")
+WAL_FSYNC_SECONDS = _series(
+    Counter, "wal_fsync_seconds_total",
+    "Wall seconds spent in WAL fsync batches (the durability tax of "
+    "wal_fsync_interval_ms)")
+WAL_REPLAY_LABELS = ("component_type", "component_id", "mode")
+WAL_REPLAYED_FRAMES = _series(
+    Counter, "wal_replayed_frames_total",
+    "Recorded frames re-driven through the pipeline, by mode: recovery "
+    "(post-crash unacked-suffix replay), pipeline (operator replay/"
+    "backfill via /admin/replay), shadow (offline dmroll canary scoring)",
+    WAL_REPLAY_LABELS)
+
 # adaptive continuous batching (library/detectors/jax_scorer.py coalescer):
 # rows held across process_batch calls toward the best-fitting warm bucket
 # under a latency budget. Depth is the current hold; releases count why

@@ -28,7 +28,8 @@ import numpy as np
 
 class ShadowEvaluator:
     def __init__(self, threshold: float, min_samples: int,
-                 max_mean_delta: float, max_flip_ratio: float) -> None:
+                 max_mean_delta: float, max_flip_ratio: float,
+                 track_top: int = 0) -> None:
         if min_samples < 1:
             raise ValueError(f"min_samples must be >= 1 (got {min_samples})")
         self.threshold = threshold
@@ -39,11 +40,19 @@ class ShadowEvaluator:
         self.delta_sum = 0.0
         self.delta_max = 0.0
         self.flips = 0
+        # bounded worst-offender ledger (offline replay triage: WHICH
+        # recorded rows moved the candidate — 0 keeps the live canary free)
+        self.track_top = max(0, int(track_top))
+        self._top: list = []        # (|delta|, row_id, live, cand) desc
 
     def observe(self, live_scores: np.ndarray,
-                cand_scores: np.ndarray) -> np.ndarray:
+                cand_scores: np.ndarray, row_ids=None) -> np.ndarray:
         """Account one shadow batch; returns the per-row ``|delta|`` array
-        so the caller can feed the ``model_shadow_divergence`` histogram."""
+        so the caller can feed the ``model_shadow_divergence`` histogram.
+        ``row_ids`` (optional, aligned) labels rows in the worst-offender
+        ledger when ``track_top`` is set — the WAL replay passes record
+        sequence numbers so an operator can pull the exact traffic back
+        out of the spool."""
         live = np.asarray(live_scores, np.float64)
         cand = np.asarray(cand_scores, np.float64)
         if live.shape != cand.shape:
@@ -56,6 +65,13 @@ class ShadowEvaluator:
         self.delta_max = max(self.delta_max, float(delta.max(initial=0.0)))
         self.flips += int(((live > self.threshold)
                            != (cand > self.threshold)).sum())
+        if self.track_top and len(delta):
+            for i in np.argsort(delta)[::-1][:self.track_top]:
+                self._top.append((float(delta[i]),
+                                  row_ids[i] if row_ids is not None else None,
+                                  float(live[i]), float(cand[i])))
+            self._top.sort(key=lambda t: t[0], reverse=True)
+            del self._top[self.track_top:]
         return delta
 
     @property
@@ -76,7 +92,7 @@ class ShadowEvaluator:
         return "hold"
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        doc = {
             "samples": self.samples,
             "min_samples": self.min_samples,
             "mean_abs_delta": round(self.mean_delta, 6),
@@ -87,3 +103,9 @@ class ShadowEvaluator:
                      "max_flip_ratio": self.max_flip_ratio},
             "verdict": self.verdict(),
         }
+        if self.track_top:
+            doc["top_divergent"] = [
+                {"abs_delta": round(d, 6), "row_id": rid,
+                 "live": round(lv, 6), "candidate": round(cv, 6)}
+                for d, rid, lv, cv in self._top]
+        return doc

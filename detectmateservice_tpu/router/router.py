@@ -177,6 +177,15 @@ class ReplicaRouter:
                     self._requeue.append((lines, wire))
             return True
 
+    def unacked_total(self) -> int:
+        """Frames dispatched but not yet watermark-settled, plus requeued
+        frames awaiting redelivery. The durable-ingress spool gates its ack
+        watermark on this hitting zero: a spool sequence only acks once the
+        replica tier holds nothing of it (wal/spool.py ack semantics)."""
+        with self._lock:
+            return (sum(len(r.window) for r in self.replicas)
+                    + len(self._requeue))
+
     def tick(self) -> None:
         """Deferred engine-thread work: re-dial recovered replicas, enforce
         drain deadlines when no supervisor polls, redeliver requeued

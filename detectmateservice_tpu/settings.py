@@ -331,6 +331,38 @@ class ServiceSettings(BaseModel):
     # keep-N checkpoint rotation (live/pinned/newest never pruned)
     rollout_keep_checkpoints: int = Field(default=4, ge=1, le=64)
 
+    # -- durable ingress: dmwal (wal/, PR 11) -----------------------------
+    # When true, the engine appends every ingress frame to a WAL-backed
+    # spool (wal/spool.py) BEFORE processing it, acks the sequence once the
+    # frame's results have left the process (router watermark settling when
+    # the replica tier is armed), and — after a crash — replays the unacked
+    # suffix through the pipeline before accepting new traffic: a parser or
+    # router kill -9 no longer loses the in-flight window
+    # (docs/durability.md). Off (the default) leaves the hot path
+    # byte-identical to the pre-WAL build.
+    durable_ingress: bool = False
+    # spool directory (segment files + crash-atomic MANIFEST.json);
+    # required when durable_ingress is on. Point replay/backfill tooling
+    # (client.py replay, POST /admin/replay) at the same directory.
+    wal_dir: Optional[str] = None
+    # roll to a new segment file once the active one exceeds this many
+    # bytes; retention prunes whole sealed segments, so smaller segments =
+    # finer-grained reclamation, more files
+    wal_segment_bytes: int = Field(default=64 * 1024 * 1024,
+                                   ge=4096, le=4 * 1024 * 1024 * 1024)
+    # fsync batching: appends are made durable at most this long after they
+    # land (0 = fsync EVERY append — the strict-durability mode; the
+    # default trades a bounded window of unsynced tail for throughput,
+    # measured by wal_fsync_seconds_total)
+    wal_fsync_interval_ms: float = Field(default=50.0, ge=0.0, le=10000.0)
+    # bounded retention: sealed, fully-acked segments are pruned from the
+    # front once the spool exceeds wal_retain_bytes, or once a sealed
+    # segment's newest record is older than wal_retain_age_s. The UNACKED
+    # suffix is never pruned by either bound — SpoolDepthHigh/SpoolAgeHigh
+    # (ops/alerts.yml) page before disk becomes the operator's problem.
+    wal_retain_bytes: int = Field(default=1024 * 1024 * 1024, ge=4096)
+    wal_retain_age_s: float = Field(default=86400.0, gt=0.0)
+
     # -- self-diagnosis (engine/health.py) --------------------------------
     # "json" renders every log record as one JSON object per line (component
     # identity + message + attached structured event), for fleet log
@@ -401,6 +433,14 @@ class ServiceSettings(BaseModel):
             raise ValueError(
                 "rollout_enabled requires rollout_dir (the versioned "
                 "checkpoint store root)")
+        return self
+
+    # -- durable-ingress cross-validation ---------------------------------
+    @model_validator(mode="after")
+    def _check_wal(self) -> "ServiceSettings":
+        if self.durable_ingress and not self.wal_dir:
+            raise ValueError(
+                "durable_ingress requires wal_dir (the WAL spool directory)")
         return self
 
     # -- TLS cross-validation (reference: settings.py:116-132) ------------

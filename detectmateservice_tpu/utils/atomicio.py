@@ -1,0 +1,42 @@
+"""Crash-atomic filesystem primitives (dependency-free).
+
+Extracted from ``utils/checkpoint.py`` so subsystems that must stay
+importable on non-jax stages (the WAL ingress spool runs inside parser
+processes) can share the proven temp+fsync+rename commit pattern without
+pulling the orbax/jax import chain. ``utils.checkpoint`` re-exports
+``write_json_atomic`` for its existing callers.
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict
+
+
+def fsync_dir(directory: Path) -> None:
+    """fsync a directory so a just-created/renamed/removed entry survives a
+    power loss (the rename itself is atomic; its *durability* needs this)."""
+    fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_json_atomic(path: Path, doc: Dict[str, Any]) -> None:
+    """Durably replace ``path`` with ``doc``: write a temp sibling, fsync
+    it, ``os.replace`` onto the final name, fsync the directory. The
+    replace is the commit point — a reader (or a post-crash restart) sees
+    either the old document or the new one, never a torn write. Shared by
+    the checkpoint meta commit, the rollout store's manifest, and the WAL
+    spool's manifest."""
+    path = Path(path)
+    tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
+    data = json.dumps(doc, indent=0, sort_keys=True)
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path.parent)

@@ -68,25 +68,10 @@ def _checkpointer() -> "ocp.StandardCheckpointer":
     return _CKPTR
 
 
-def write_json_atomic(path: Path, doc: Dict[str, Any]) -> None:
-    """Durably replace ``path`` with ``doc``: write a temp sibling, fsync
-    it, ``os.replace`` onto the final name, fsync the directory. The
-    replace is the commit point — a reader (or a post-crash restart) sees
-    either the old document or the new one, never a torn write. Shared by
-    the checkpoint meta commit and the rollout store's manifest."""
-    path = Path(path)
-    tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
-    data = json.dumps(doc, indent=0, sort_keys=True)
-    with open(tmp, "w", encoding="utf-8") as fh:
-        fh.write(data)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
-    dir_fd = os.open(path.parent, os.O_RDONLY)
-    try:
-        os.fsync(dir_fd)
-    finally:
-        os.close(dir_fd)
+# the commit primitive moved to utils/atomicio.py (dependency-free) so the
+# WAL spool can use it inside non-jax stages; re-exported here for the
+# existing checkpoint/rollout callers and the tests that monkeypatch it
+from .atomicio import write_json_atomic  # noqa: F401  (re-export)
 
 
 def _prune_stale_data(path: Path, keep_nonce: str) -> None:

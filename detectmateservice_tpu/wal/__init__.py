@@ -1,0 +1,32 @@
+"""dmwal: durable WAL-backed ingress spool + deterministic replay.
+
+Three layers (docs/durability.md):
+
+* ``segment`` — length+CRC framed, sequence-numbered frame records in
+  append-only segment files; torn-tail containment by construction.
+* ``spool`` — the engine-facing ``IngressSpool``: append before processing,
+  ack on downstream send, fsync batching, crash-atomic manifest commits,
+  bounded retention that never prunes the unacked suffix.
+* ``replay`` — ``ReplayDriver`` (byte-deterministic re-drive of a recorded
+  spool through a component) and ``shadow_replay`` (offline dmroll canary
+  divergence against recorded traffic), behind ``/admin/replay``.
+"""
+from .replay import (  # noqa: F401
+    REPLAY,
+    ReplayBusyError,
+    ReplayDriver,
+    ReplayError,
+    ReplayManager,
+    shadow_replay,
+    start_service_replay,
+)
+from .segment import (  # noqa: F401
+    Record,
+    WalError,
+    iter_records,
+    list_segments,
+    read_spool,
+    scan_segment,
+    segment_name,
+)
+from .spool import IngressSpool  # noqa: F401

@@ -1,0 +1,392 @@
+"""Deterministic replay of a recorded ingress spool.
+
+``ReplayDriver`` re-drives the frames of a WAL directory through a
+component processor exactly the way the engine would — strip the v2 trace
+header (keeping the ORIGINAL trace id and ingest stamp), expand batch
+frames, dispatch the contained messages in order, drain held/pipelined
+results at the end — and folds every emitted output into a SHA-256 digest.
+Because the recorded bytes already carry the original trace headers and no
+new hop stamps are added, two replays of the same recorded segment against
+the same detector version produce byte-identical outputs and therefore the
+same digest: that equality is the regression-bisection and
+candidate-evaluation primitive (asserted by tests/test_wal.py and
+scripts/wal_smoke.py).
+
+``shadow_replay`` is the offline twin of the dmroll shadow canary
+(rollout/shadow.py): it scores every recorded row through BOTH the live
+params and a candidate checkpoint from the versioned store and emits the
+same divergence report the live gate uses — *yesterday's real traffic*
+instead of a live sample, with zero impact on the serving path.
+
+``ReplayManager`` (the process-wide ``REPLAY`` instance) runs one replay at
+a time behind ``POST /admin/replay`` / ``GET /admin/replay`` and
+``client.py replay``, with the same one-run-per-process 409 semantics as
+the profiler and load manager.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+from ..engine.framing import FramingError, unpack_batch, unwrap_trace, wrap_trace
+from .segment import read_spool
+
+_U32 = (2 ** 32 - 1)
+
+
+class ReplayError(ValueError):
+    """Bad replay request (unknown mode, no spool, missing seams)."""
+
+
+class ReplayBusyError(RuntimeError):
+    """A replay (or the live engine, for pipeline mode) is already active."""
+
+
+class ReplayDriver:
+    """Re-drive recorded frames through one component, deterministically.
+
+    ``processor`` is a library component (or anything exposing
+    ``process_batch(list[bytes])`` / ``process(bytes)`` and optionally
+    ``flush``/``flush_final``); ``None`` echoes messages (passthrough).
+    ``deliver`` (optional) receives each output as a wire frame — wrapped
+    back into a v2 frame with the ORIGINAL trace context when the source
+    frame carried one — for backfill into a downstream stage. ``counter``
+    (optional) is called with the number of frames replayed (feeds
+    ``wal_replayed_frames_total``)."""
+
+    def __init__(self, directory: str, processor: Any, *,
+                 deliver: Optional[Callable[[bytes], None]] = None,
+                 counter: Optional[Callable[[int], None]] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
+        self.directory = Path(directory)
+        self.processor = processor
+        self.deliver = deliver
+        self.counter = counter
+        self.logger = logger or logging.getLogger("wal.replay")
+
+    # -- output accounting ----------------------------------------------
+    @staticmethod
+    def _fold(digest, trace_id: int, payload: bytes) -> None:
+        digest.update(trace_id.to_bytes(8, "big"))
+        digest.update((len(payload) & _U32).to_bytes(4, "big"))
+        digest.update(payload)
+
+    def run(self, start_seq: int = 0,
+            limit: Optional[int] = None) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        batch_fn = getattr(self.processor, "process_batch", None)
+        proc_fn = getattr(self.processor, "process", None)
+        digest = hashlib.sha256()
+        # FIFO of original trace contexts, consumed per output — the
+        # engine's attachment model, exact when outputs map 1:1 to inputs
+        ctx_fifo: List = []
+        frames = messages = outputs = trace_errors = 0
+
+        def emit(outs) -> None:
+            nonlocal outputs
+            for out in outs:
+                if out is None:
+                    continue
+                ctx = ctx_fifo.pop(0) if ctx_fifo else None
+                self._fold(digest, ctx.trace_id if ctx else 0, out)
+                outputs += 1
+                if self.deliver is not None:
+                    self.deliver(wrap_trace(out, ctx) if ctx else out)
+
+        first_seq = last_seq = None
+        for rec in read_spool(self.directory, start_seq=start_seq,
+                              limit=limit):
+            frames += 1
+            if first_seq is None:
+                first_seq = rec.seq
+            last_seq = rec.seq
+            try:
+                payload, ctx, damaged = unwrap_trace(rec.frame)
+            except FramingError:
+                trace_errors += 1
+                continue
+            if damaged:
+                trace_errors += 1
+            try:
+                msgs = unpack_batch(payload)
+            except FramingError:
+                trace_errors += 1
+                continue
+            if msgs is None:
+                msgs = [payload]
+            msgs = [msg for msg in msgs if msg]
+            if not msgs:
+                continue
+            messages += len(msgs)
+            if ctx is not None:
+                ctx_fifo.append(ctx)
+            try:
+                if callable(batch_fn):
+                    emit(batch_fn(msgs))
+                elif callable(proc_fn):
+                    emit([proc_fn(msg) for msg in msgs])
+                else:
+                    emit(msgs)                      # passthrough
+            except Exception as exc:
+                self.logger.error("replay: processor raised on seq %d: %s",
+                                  rec.seq, exc)
+                raise
+        # drain held/pipelined results exactly once, like the engine at stop
+        final_fn = (getattr(self.processor, "flush_final", None)
+                    or getattr(self.processor, "flush", None))
+        if callable(final_fn):
+            emit(final_fn())
+        if self.counter is not None and frames:
+            self.counter(frames)
+        return {
+            "mode": "pipeline",
+            "directory": str(self.directory),
+            "frames": frames,
+            "messages": messages,
+            "outputs": outputs,
+            "trace_errors": trace_errors,
+            "first_seq": first_seq,
+            "last_seq": last_seq,
+            "output_digest": digest.hexdigest(),
+            "duration_s": round(time.monotonic() - t0, 3),
+        }
+
+
+def shadow_replay(directory: str, detector: Any, *,
+                  store_dir: Optional[str] = None,
+                  version: Optional[int] = None,
+                  params: Any = None,
+                  threshold: Optional[float] = None,
+                  min_samples: int = 1,
+                  max_mean_delta: float = 0.25,
+                  max_flip_ratio: float = 0.01,
+                  start_seq: int = 0,
+                  limit: Optional[int] = None,
+                  max_rows: int = 65536,
+                  track_top: int = 8,
+                  counter: Optional[Callable[[int], None]] = None,
+                  logger: Optional[logging.Logger] = None) -> Dict[str, Any]:
+    """Score a recorded spool through the live params AND a dmroll
+    candidate; return the PR-10 divergence report (mean/max |Δscore|,
+    alert-decision flip ratio, gate verdict) computed offline.
+
+    The candidate comes from ``params`` directly, or is loaded from the
+    versioned checkpoint store at ``store_dir`` (``version`` None = the
+    newest recorded version). The recorded frames must be the DETECTOR
+    stage's ingress (serialized ParserSchema rows) — the same bytes its
+    live dispatch path featurizes."""
+    logger = logger or logging.getLogger("wal.replay")
+    if not callable(getattr(detector, "rollout_scores", None)):
+        raise ReplayError(
+            "shadow replay needs a rollout-capable detector "
+            "(rollout_scores hook — the jax scorer)")
+    import numpy as np
+
+    from ..rollout.shadow import ShadowEvaluator
+
+    t0 = time.monotonic()
+    meta: Dict[str, Any] = {}
+    if params is None:
+        if not store_dir:
+            raise ReplayError(
+                "shadow replay needs a candidate: pass params, or store_dir "
+                "(+ optional version) naming the rollout checkpoint store")
+        from ..rollout.store import CheckpointStore
+
+        store = CheckpointStore(store_dir)
+        if version is None:
+            history = store.history(limit=1)
+            if not history:
+                raise ReplayError(f"checkpoint store {store_dir} is empty")
+            version = int(history[0]["version"])
+        params, _opt_state, meta = detector.load_params_checkpoint(
+            str(store.version_dir(version)))
+    if threshold is None:
+        threshold = detector.live_threshold()
+    evaluator = ShadowEvaluator(threshold, max(1, min_samples),
+                                max_mean_delta, max_flip_ratio,
+                                track_top=track_top)
+
+    frames = rows = skipped_rows = 0
+    first_seq = last_seq = None
+    pending: List[bytes] = []
+    row_seqs: List[int] = []
+
+    def score_pending() -> None:
+        nonlocal rows, skipped_rows, pending, row_seqs
+        if not pending:
+            return
+        tokens, ok = detector._featurize_raw_batch(pending)
+        keep = np.flatnonzero(ok)
+        skipped_rows += len(pending) - len(keep)
+        if len(keep):
+            kept = tokens[keep]
+            live = detector.rollout_scores(None, kept)
+            cand = detector.rollout_scores(params, kept)
+            evaluator.observe(live, cand,
+                              row_ids=[row_seqs[i] for i in keep])
+            rows += len(keep)
+        pending = []
+        row_seqs = []
+
+    for rec in read_spool(directory, start_seq=start_seq, limit=limit):
+        frames += 1
+        if first_seq is None:
+            first_seq = rec.seq
+        last_seq = rec.seq
+        try:
+            payload, _ctx, _damaged = unwrap_trace(rec.frame)
+            msgs = unpack_batch(payload)
+        except FramingError:
+            continue
+        if msgs is None:
+            msgs = [payload]
+        for msg in msgs:
+            if msg:
+                pending.append(msg)
+                row_seqs.append(rec.seq)
+        if len(pending) >= 512:
+            score_pending()
+        if rows >= max_rows:
+            logger.warning("shadow replay: row cap %d reached at seq %d — "
+                           "report covers a prefix of the spool",
+                           max_rows, rec.seq)
+            break
+    score_pending()
+    if counter is not None and frames:
+        counter(frames)
+    report = evaluator.stats()
+    report.update({
+        "mode": "shadow",
+        "directory": str(directory),
+        "candidate_version": version,
+        "candidate_meta": {k: meta[k] for k in ("model", "saved_unix")
+                          if k in meta},
+        "threshold": float(threshold),
+        "frames": frames,
+        "rows_scored": rows,
+        "rows_skipped": skipped_rows,
+        "first_seq": first_seq,
+        "last_seq": last_seq,
+        "duration_s": round(time.monotonic() - t0, 3),
+    })
+    return report
+
+
+class ReplayManager:
+    """One replay per process, run on its own thread behind the admin
+    plane; ``status()`` serves the live/last run (GET /admin/replay)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._running_info: Optional[Dict[str, Any]] = None
+        self._last: Optional[Dict[str, Any]] = None
+
+    def start(self, info: Dict[str, Any],
+              runner: Callable[[], Dict[str, Any]],
+              wait: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                raise ReplayBusyError(
+                    "a replay is already running (one per process); poll "
+                    "GET /admin/replay until it completes")
+            self._running_info = dict(info, state="running",
+                                      started_unix=round(time.time(), 3))
+            thread = threading.Thread(target=self._run, args=(runner,),
+                                      name="wal-replay", daemon=True)
+            self._thread = thread
+        thread.start()
+        if wait:
+            thread.join()
+            with self._lock:
+                return dict(self._last or {})
+        return dict(info, state="started")
+
+    def _run(self, runner: Callable[[], Dict[str, Any]]) -> None:
+        with self._lock:
+            info = dict(self._running_info or {})
+        try:
+            result = runner()
+            outcome = dict(info, state="done", result=result)
+        except Exception as exc:          # surfaced via status, not a crash
+            outcome = dict(info, state="error", error=str(exc))
+        with self._lock:
+            self._last = outcome
+            self._running_info = None
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            running = self._thread is not None and self._thread.is_alive()
+            return {
+                "running": running,
+                "current": dict(self._running_info) if running
+                           and self._running_info else None,
+                "last": dict(self._last) if self._last else None,
+            }
+
+
+REPLAY = ReplayManager()
+
+
+def start_service_replay(service: Any, payload: Dict[str, Any],
+                         ) -> Dict[str, Any]:
+    """The ``POST /admin/replay`` implementation: validate the request
+    against THIS service's settings/component, build the runner, and hand
+    it to the process-wide manager. Raises ``ReplayError`` (HTTP 400) on a
+    bad request and ``ReplayBusyError`` (HTTP 409) on state conflicts."""
+    from ..engine import metrics as m
+
+    payload = payload or {}
+    mode = str(payload.get("mode", "pipeline"))
+    wal_dir = payload.get("wal_dir") or getattr(service.settings, "wal_dir",
+                                                None)
+    if not wal_dir:
+        raise ReplayError("no spool to replay: pass wal_dir or configure "
+                          "the stage with durable_ingress + wal_dir")
+    if not Path(wal_dir).is_dir():
+        raise ReplayError(f"wal_dir {wal_dir} does not exist")
+    start_seq = int(payload.get("start_seq", 0))
+    limit = payload.get("limit")
+    limit = int(limit) if limit is not None else None
+    wait = bool(payload.get("wait", False))
+    labels = dict(component_type=service.settings.component_type,
+                  component_id=service.settings.component_id or "unknown")
+    counter = m.WAL_REPLAYED_FRAMES().labels(mode=mode, **labels).inc
+
+    if mode == "pipeline":
+        if service.engine.running and not payload.get("force"):
+            raise ReplayBusyError(
+                "the engine is running: a pipeline replay drives the "
+                "component directly and must not interleave with live "
+                "dispatch — POST /admin/stop first (or pass force:true "
+                "for a stage whose component tolerates it)")
+        driver = ReplayDriver(wal_dir, service.library_component,
+                              counter=counter, logger=service.logger)
+        info = {"mode": mode, "wal_dir": str(wal_dir),
+                "start_seq": start_seq, "limit": limit}
+        return REPLAY.start(info, lambda: driver.run(start_seq=start_seq,
+                                                     limit=limit), wait=wait)
+    if mode == "shadow":
+        detector = service.library_component
+        settings = service.settings
+        store_dir = payload.get("store_dir") or getattr(settings,
+                                                        "rollout_dir", None)
+        version = payload.get("version")
+        version = int(version) if version is not None else None
+        info = {"mode": mode, "wal_dir": str(wal_dir), "version": version,
+                "store_dir": store_dir, "start_seq": start_seq,
+                "limit": limit}
+        return REPLAY.start(info, lambda: shadow_replay(
+            wal_dir, detector, store_dir=store_dir, version=version,
+            min_samples=1,
+            max_mean_delta=getattr(settings, "rollout_max_mean_delta", 0.25),
+            max_flip_ratio=getattr(settings, "rollout_max_flip_ratio", 0.01),
+            start_seq=start_seq, limit=limit, counter=counter,
+            logger=service.logger), wait=wait)
+    raise ReplayError(f"unknown replay mode {mode!r} "
+                      "(expected 'pipeline' or 'shadow')")

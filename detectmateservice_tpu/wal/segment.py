@@ -1,0 +1,187 @@
+"""WAL segment files: length+CRC framed, sequence-numbered frame records.
+
+One segment is an append-only file of records:
+
+    u32 LE body_len | u32 LE crc32(body) | body
+    body = varint seq | varint append_unix_ns | frame bytes
+
+``frame`` is the raw ingress wire frame exactly as the engine received it
+(post shm-resolution, pre trace-strip) — a v1 batch frame, a v2 traced
+frame, or a plain single message. Because the v2 trace header is part of
+the recorded bytes, a replay re-drives *yesterday's* traffic with its
+original trace ids and ingest stamps by construction; nothing has to be
+reconstructed.
+
+Torn-write containment is the whole point of the framing: a crash mid-append
+leaves at most one partial record at the file tail. A reader stops at the
+first record whose header is incomplete, whose declared body runs past EOF,
+or whose CRC does not match — everything before that point is intact by
+checksum, everything after it is unreachable garbage the writer truncates
+away on reopen. Records are never rewritten, so a record that was ever
+readable stays readable (single-fault disk damage in a sealed segment is
+reported, not silently skipped).
+
+Segment files are named ``seg-<first_seq, zero-padded>.wal`` so a plain
+sorted directory listing *is* the sequence order; the spool's manifest
+(wal/spool.py) carries only the ack watermark and retention metadata — the
+directory scan, not the manifest, is the recovery truth for which records
+exist (a crash between creating a segment file and committing the manifest
+must not hide the segment).
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from pathlib import Path
+from typing import Iterator, List, NamedTuple, Optional, Tuple
+
+_HEADER = struct.Struct("<II")          # body_len, crc32(body)
+# a declared body larger than this is treated as tail damage, not a record:
+# no single ingress frame approaches it, and honoring a garbage length would
+# make one flipped bit swallow the rest of the segment as "one record"
+_MAX_BODY = 256 * 1024 * 1024
+
+SEGMENT_PREFIX = "seg-"
+SEGMENT_SUFFIX = ".wal"
+
+
+class WalError(RuntimeError):
+    """Unrecoverable WAL damage (never raised for an ordinary torn tail)."""
+
+
+def segment_name(first_seq: int) -> str:
+    return f"{SEGMENT_PREFIX}{first_seq:020d}{SEGMENT_SUFFIX}"
+
+
+def list_segments(directory: Path) -> List[Path]:
+    """Segment files of ``directory`` in sequence order (name-sorted; the
+    zero-padded first-seq name makes lexicographic == numeric order)."""
+    return sorted(Path(directory).glob(
+        f"{SEGMENT_PREFIX}*{SEGMENT_SUFFIX}"))
+
+
+def _put_varint(out: bytearray, value: int) -> None:
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return
+
+
+def _get_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise WalError("truncated varint in WAL record body")
+        byte = data[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise WalError("varint overflow in WAL record body")
+
+
+class Record(NamedTuple):
+    """One recovered record: its sequence number, the wall-clock append
+    stamp (epoch ns — feeds the oldest-unacked age after a restart), the
+    recorded frame bytes, and the file offset of the NEXT record (i.e. the
+    end of this one — the writer's truncate-to point when this is the last
+    valid record)."""
+
+    seq: int
+    append_ns: int
+    frame: bytes
+    end_offset: int
+
+
+def pack_record(seq: int, append_ns: int, frame: bytes) -> bytes:
+    body = bytearray()
+    _put_varint(body, seq)
+    _put_varint(body, append_ns)
+    body += frame
+    return _HEADER.pack(len(body), zlib.crc32(body)) + bytes(body)
+
+
+def _parse_body(body: bytes) -> Tuple[int, int, bytes]:
+    seq, pos = _get_varint(body, 0)
+    append_ns, pos = _get_varint(body, pos)
+    return seq, append_ns, body[pos:]
+
+
+class SegmentScan(NamedTuple):
+    """Result of validating one segment file: its intact records' seq span,
+    the byte offset where validity ends (== file size when clean), and
+    whether a torn/damaged tail was found after it."""
+
+    first_seq: Optional[int]
+    last_seq: Optional[int]
+    valid_end: int
+    torn: bool
+    records: int
+
+
+def iter_records(path: Path, start_offset: int = 0) -> Iterator[Record]:
+    """Yield the intact records of one segment, stopping (silently) at the
+    first torn/damaged record — the caller decides whether that is a
+    routine crash tail (last segment) or reportable damage (sealed one).
+    Reads the whole segment into memory: segments are bounded by
+    ``wal_segment_bytes`` and replay/recovery are cold paths."""
+    data = Path(path).read_bytes()
+    pos = start_offset
+    while True:
+        if pos + _HEADER.size > len(data):
+            return                      # clean EOF or torn header
+        body_len, crc = _HEADER.unpack_from(data, pos)
+        body_start = pos + _HEADER.size
+        body_end = body_start + body_len
+        if body_len == 0 or body_len > _MAX_BODY or body_end > len(data):
+            return                      # garbage length or torn body
+        body = data[body_start:body_end]
+        if zlib.crc32(body) != crc:
+            return                      # torn or damaged record
+        try:
+            seq, append_ns, frame = _parse_body(body)
+        except WalError:
+            return                      # CRC-valid but unparseable: treat
+        pos = body_end                  # as damage, stop like a torn tail
+        yield Record(seq, append_ns, frame, pos)
+
+
+def scan_segment(path: Path) -> SegmentScan:
+    first = last = None
+    end = 0
+    count = 0
+    for rec in iter_records(path):
+        if first is None:
+            first = rec.seq
+        last = rec.seq
+        end = rec.end_offset
+        count += 1
+    size = Path(path).stat().st_size
+    return SegmentScan(first, last, end, torn=end != size, records=count)
+
+
+def read_spool(directory: Path, start_seq: int = 0,
+               limit: Optional[int] = None) -> Iterator[Record]:
+    """Iterate every intact record of a spool directory with ``seq >
+    start_seq`` in sequence order — the replay harness's read path, which
+    must work against a spool no writer has open (an archived copy, another
+    stage's directory). Duplicate seqs across a crash-torn boundary are
+    collapsed (first occurrence wins)."""
+    seen = start_seq
+    yielded = 0
+    for path in list_segments(Path(directory)):
+        for rec in iter_records(path):
+            if rec.seq <= seen:
+                continue
+            seen = rec.seq
+            yield rec
+            yielded += 1
+            if limit is not None and yielded >= limit:
+                return

@@ -1,0 +1,393 @@
+"""IngressSpool: the durable WAL-backed ingress buffer behind
+``durable_ingress``.
+
+Write path (engine hot loop, single-threaded by design — every mutator runs
+on the engine thread, like the router's socket ops): ``append`` buffers one
+record into the active segment, ``ack`` advances the in-memory watermark
+when the frame's results have left the process, and ``tick`` — called once
+per engine loop iteration — batches the durability work: an fsync every
+``wal_fsync_interval_ms``, a manifest commit (the crash-atomic
+temp+fsync+rename pattern shared with ``utils/checkpoint.write_json_atomic``)
+whenever the persisted ack watermark lags, segment roll bookkeeping, and
+bounded retention.
+
+Crash semantics, by construction:
+
+* segment writes are UNBUFFERED (``buffering=0``): once ``append`` returns,
+  the record is in the kernel — a process kill (kill -9) loses nothing
+  appended; only a POWER loss can take the un-fsynced tail, and never as a
+  *torn* record surviving recovery (length+CRC framing stops the reader at
+  the damage; the writer truncates it away on reopen);
+* a crash between fsync and manifest commit loses at most the acks since
+  the last commit — those records replay exactly once per crash
+  (at-least-once, never at-most-once: the watermark only moves FORWARD of
+  reality on disk, never ahead of it);
+* a crash between segment-file creation and manifest commit hides nothing:
+  recovery scans the directory, not the manifest, for segments.
+
+Retention prunes whole *sealed* segments from the front once the spool
+exceeds ``wal_retain_bytes`` or a sealed segment's newest record exceeds
+``wal_retain_age_s`` — but NEVER a segment still holding unacked records:
+the unacked suffix is the crash-recovery contract and outlives any size or
+age bound (the ``SpoolDepthHigh``/``SpoolAgeHigh`` alerts page long before
+an operator has to think about disk).
+
+Observability reads (``depth_frames``/``spool_bytes``/
+``oldest_unacked_age_seconds``) come from scrape threads via
+``Gauge.set_function`` and are single-int/tuple reads — lock-free on
+purpose, same discipline as the heartbeat gauges.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from pathlib import Path
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..utils.atomicio import fsync_dir, write_json_atomic
+from .segment import (
+    Record,
+    WalError,
+    iter_records,
+    list_segments,
+    pack_record,
+    scan_segment,
+    segment_name,
+)
+
+_MANIFEST = "MANIFEST.json"
+_SCHEMA = "dmwal-v1"
+
+
+class _Segment:
+    """In-memory bookkeeping for one on-disk segment file."""
+
+    __slots__ = ("path", "first_seq", "last_seq", "bytes", "created_unix",
+                 "newest_append_unix", "sealed")
+
+    def __init__(self, path: Path, first_seq: int, last_seq: Optional[int],
+                 nbytes: int, created_unix: float,
+                 newest_append_unix: float, sealed: bool) -> None:
+        self.path = path
+        self.first_seq = first_seq
+        self.last_seq = last_seq
+        self.bytes = nbytes
+        self.created_unix = created_unix
+        self.newest_append_unix = newest_append_unix
+        self.sealed = sealed
+
+    def doc(self) -> Dict:
+        return {"file": self.path.name, "first_seq": self.first_seq,
+                "last_seq": self.last_seq, "bytes": self.bytes,
+                "created_unix": round(self.created_unix, 3),
+                "sealed": self.sealed}
+
+
+class IngressSpool:
+    def __init__(self, directory: str, *,
+                 segment_bytes: int = 64 * 1024 * 1024,
+                 fsync_interval_ms: float = 50.0,
+                 retain_bytes: int = 1024 * 1024 * 1024,
+                 retain_age_s: float = 86400.0,
+                 fsync_observer: Optional[Callable[[float], None]] = None,
+                 logger: Optional[logging.Logger] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.segment_bytes = max(4096, int(segment_bytes))
+        self.fsync_interval_s = max(0.0, float(fsync_interval_ms)) / 1000.0
+        self.retain_bytes = int(retain_bytes)
+        self.retain_age_s = float(retain_age_s)
+        self._fsync_observer = fsync_observer
+        self.logger = logger or logging.getLogger("wal")
+        self._clock = clock                     # wall clock (ages, stamps)
+
+        self._acked = self._load_manifest_ack()
+        self._segments: List[_Segment] = []
+        self._last_appended = self._acked
+        # (seq, append_unix) of every unacked record, oldest first — the
+        # oldest-unacked-age gauge and the exact-age retention both read
+        # the head; rebuilt from the recorded append stamps on reopen
+        self._unacked_times: deque = deque()
+        self._scan_existing()
+
+        self._fh = None                         # active segment handle
+        self._active: Optional[_Segment] = None
+        self._open_active()
+
+        self._dirty_bytes = 0                   # appended since last fsync
+        self._last_fsync = time.monotonic()
+        self._manifest_dirty = True             # commit once at open
+        # manifest commits (ack persistence + retention) are a json write
+        # plus two fsyncs — batched on their own, coarser cadence: a crash
+        # then replays at most this window's acks, once (at-least-once)
+        self._manifest_interval_s = max(self.fsync_interval_s, 1.0)
+        self._last_manifest = 0.0
+        self._closed = False
+        self.tick(force=True)
+
+    # -- recovery scan --------------------------------------------------
+    def _load_manifest_ack(self) -> int:
+        path = self.directory / _MANIFEST
+        if not path.exists():
+            return 0
+        import json
+
+        try:
+            doc = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as exc:
+            # write_json_atomic makes a torn manifest impossible; an
+            # unreadable one is real damage — fail loud, silently starting
+            # from ack 0 would replay the whole retained spool
+            raise WalError(f"unreadable WAL manifest {path}: {exc}")
+        if doc.get("schema") != _SCHEMA:
+            raise WalError(
+                f"WAL manifest {path} has schema {doc.get('schema')!r}, "
+                f"this build reads {_SCHEMA!r}")
+        return int(doc.get("acked_seq", 0))
+
+    def _scan_existing(self) -> None:
+        """Rebuild segment bookkeeping from the directory (the recovery
+        truth), truncating a torn tail off the NEWEST segment so the append
+        handle continues from a clean record boundary. Damage in a sealed
+        (non-last) segment is reported, never repaired — its readable
+        prefix stays served."""
+        paths = list_segments(self.directory)
+        for i, path in enumerate(paths):
+            scan = scan_segment(path)
+            last = i == len(paths) - 1
+            if scan.torn:
+                if last:
+                    self.logger.warning(
+                        "WAL %s: torn tail truncated at byte %d "
+                        "(%d intact records)", path.name, scan.valid_end,
+                        scan.records)
+                    with open(path, "rb+") as fh:
+                        fh.truncate(scan.valid_end)
+                        fh.flush()
+                        os.fsync(fh.fileno())
+                else:
+                    self.logger.error(
+                        "WAL %s: damaged record inside a SEALED segment — "
+                        "serving the intact prefix (%d records)", path.name,
+                        scan.records)
+            if scan.first_seq is None:
+                if last:
+                    # an empty newest segment (crash right after roll):
+                    # reuse it as the active segment under its name
+                    first = int(path.name[4:-4])
+                    self._segments.append(_Segment(
+                        path, first, None, 0, path.stat().st_mtime,
+                        path.stat().st_mtime, sealed=False))
+                continue
+            stat = path.stat()
+            self._segments.append(_Segment(
+                path, scan.first_seq, scan.last_seq, scan.valid_end,
+                stat.st_mtime, stat.st_mtime, sealed=not last))
+            self._last_appended = max(self._last_appended, scan.last_seq)
+        # exact unacked append stamps from the records themselves
+        if self._last_appended > self._acked:
+            for rec in self._iter_from(self._acked):
+                self._unacked_times.append((rec.seq, rec.append_ns / 1e9))
+
+    def _iter_from(self, after_seq: int) -> Iterator[Record]:
+        for seg in self._segments:
+            if seg.last_seq is not None and seg.last_seq <= after_seq:
+                continue
+            for rec in iter_records(seg.path):
+                if rec.seq > after_seq:
+                    yield rec
+
+    def _open_active(self) -> None:
+        if self._segments and not self._segments[-1].sealed \
+                and self._segments[-1].bytes < self.segment_bytes:
+            self._active = self._segments[-1]
+        else:
+            if self._segments:
+                self._segments[-1].sealed = True
+            first = self._last_appended + 1
+            path = self.directory / segment_name(first)
+            path.touch()
+            fsync_dir(self.directory)
+            now = self._clock()
+            self._active = _Segment(path, first, None, 0, now, now,
+                                    sealed=False)
+            self._segments.append(self._active)
+        # buffering=0: every append write() reaches the KERNEL immediately,
+        # so a plain kill -9 loses nothing that append() returned for — only
+        # a power loss can take the un-fsynced tail. A user-space buffer
+        # here would silently widen the crash window to everything since the
+        # last tick (caught live: a SIGKILL during a long burst collect ate
+        # the whole burst's appends out of the Python file buffer).
+        self._fh = open(self._active.path, "ab", buffering=0)
+
+    # -- write path (engine thread only) --------------------------------
+    def append(self, frame: bytes) -> int:
+        """Durably (after the next fsync tick) record one ingress frame;
+        returns its sequence number."""
+        if self._closed:
+            raise WalError("append on a closed spool")
+        seq = self._last_appended + 1
+        now = self._clock()
+        rec = pack_record(seq, int(now * 1e9), frame)
+        if self._active.bytes and \
+                self._active.bytes + len(rec) > self.segment_bytes:
+            self._roll()
+        self._fh.write(rec)
+        self._active.bytes += len(rec)
+        self._active.last_seq = seq
+        self._active.newest_append_unix = now
+        self._last_appended = seq
+        self._unacked_times.append((seq, now))
+        self._dirty_bytes += len(rec)
+        if self.fsync_interval_s == 0.0:
+            self._fsync()
+        return seq
+
+    def ack(self, seq: int) -> None:
+        """Advance the ack watermark: every record with ``seq`` at or below
+        it has been handed downstream and will not replay after a clean
+        restart (a crash may still replay the acks not yet committed to the
+        manifest — once per crash, the at-least-once bound)."""
+        if seq <= self._acked:
+            return
+        self._acked = min(seq, self._last_appended)
+        times = self._unacked_times
+        while times and times[0][0] <= self._acked:
+            times.popleft()
+        self._manifest_dirty = True
+
+    def _roll(self) -> None:
+        """Seal the active segment and open the next: fsync the sealed data
+        first (its records must be durable before the manifest can claim
+        the segment is sealed), then cut over."""
+        self._fsync()
+        self._fh.close()
+        self._active.sealed = True
+        self._manifest_dirty = True
+        first = self._last_appended + 1
+        path = self.directory / segment_name(first)
+        path.touch()
+        fsync_dir(self.directory)
+        now = self._clock()
+        self._active = _Segment(path, first, None, 0, now, now, sealed=False)
+        self._segments.append(self._active)
+        self._fh = open(path, "ab", buffering=0)  # see _open_active
+
+    def _fsync(self) -> None:
+        if self._fh is None:
+            return
+        t0 = time.monotonic()
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._dirty_bytes = 0
+        self._last_fsync = time.monotonic()
+        if self._fsync_observer is not None:
+            self._fsync_observer(self._last_fsync - t0)
+
+    def tick(self, force: bool = False) -> None:
+        """One batched-durability step: fsync when the interval elapsed (or
+        ``force``), commit the manifest when the ack watermark or segment
+        set moved, apply retention. Called once per engine loop iteration —
+        the no-work case is two int compares."""
+        now = time.monotonic()
+        if self._dirty_bytes and (
+                force or now - self._last_fsync >= self.fsync_interval_s):
+            self._fsync()
+        if self._manifest_dirty and (
+                force or now - self._last_manifest
+                >= self._manifest_interval_s):
+            self._retain()
+            self._commit_manifest()
+            self._last_manifest = now
+
+    def _commit_manifest(self) -> None:
+        write_json_atomic(self.directory / _MANIFEST, {
+            "schema": _SCHEMA,
+            "acked_seq": self._acked,
+            "last_appended_seq": self._last_appended,
+            "committed_unix": round(self._clock(), 3),
+            "segments": [seg.doc() for seg in self._segments],
+        })
+        self._manifest_dirty = False
+
+    def _retain(self) -> None:
+        """Prune sealed, fully-acked segments from the front while the spool
+        exceeds its byte bound, or while the head segment's newest record
+        exceeds the age bound. The unacked suffix is untouchable."""
+        while len(self._segments) > 1:
+            head = self._segments[0]
+            if not head.sealed or head is self._active:
+                return
+            if head.last_seq is None or head.last_seq > self._acked:
+                return                      # unacked suffix: never pruned
+            over_bytes = self.spool_bytes() > self.retain_bytes
+            over_age = (self._clock() - head.newest_append_unix
+                        > self.retain_age_s)
+            if not (over_bytes or over_age):
+                return
+            try:
+                head.path.unlink()
+            except OSError as exc:
+                self.logger.error("WAL retention cannot remove %s: %s",
+                                  head.path.name, exc)
+                return
+            self._segments.pop(0)
+            self._manifest_dirty = True
+
+    def close(self) -> None:
+        """Clean shutdown: final fsync + manifest commit (so a clean
+        restart replays nothing), then release the handle."""
+        if self._closed:
+            return
+        self._closed = True
+        self.tick(force=True)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- recovery / observability ---------------------------------------
+    def recover_unacked(self) -> List[Tuple[int, bytes]]:
+        """The unacked suffix, oldest first — what the engine must replay
+        through the pipeline before accepting new traffic after a
+        restart."""
+        self._fsync()                       # make the scan read-consistent
+        return [(rec.seq, rec.frame) for rec in self._iter_from(self._acked)]
+
+    @property
+    def acked_seq(self) -> int:
+        return self._acked
+
+    @property
+    def last_appended_seq(self) -> int:
+        return self._last_appended
+
+    def depth_frames(self) -> float:
+        return float(self._last_appended - self._acked)
+
+    def spool_bytes(self) -> float:
+        return float(sum(seg.bytes for seg in self._segments))
+
+    def oldest_unacked_age_seconds(self) -> float:
+        times = self._unacked_times
+        if not times:
+            return 0.0
+        try:
+            _seq, t = times[0]
+        except IndexError:          # raced a concurrent ack pop: empty now
+            return 0.0
+        return max(0.0, self._clock() - t)
+
+    def stats(self) -> Dict:
+        return {
+            "directory": str(self.directory),
+            "acked_seq": self._acked,
+            "last_appended_seq": self._last_appended,
+            "depth_frames": int(self.depth_frames()),
+            "spool_bytes": int(self.spool_bytes()),
+            "oldest_unacked_age_seconds":
+                round(self.oldest_unacked_age_seconds(), 3),
+            "segments": [seg.doc() for seg in self._segments],
+        }

@@ -146,6 +146,16 @@ def _load_status(service, query, payload) -> Response:
     return Response(200, LOADGEN.status())
 
 
+def _replay_status(service, query, payload) -> Response:
+    from ..wal.replay import REPLAY
+
+    status = REPLAY.status()
+    spool = getattr(service.engine, "spool", None)
+    status["spool"] = spool.stats() if spool is not None else None
+    status["wal_dir"] = getattr(service.settings, "wal_dir", None)
+    return Response(200, status)
+
+
 def _profile_status(service, query, payload) -> Response:
     from ..utils.profiling import PROFILER
 
@@ -281,6 +291,20 @@ def _model_control(service, query, payload) -> Response:
                      "'rollback', 'pin', 'unpin', or 'cycle')")
 
 
+def _replay_control(service, query, payload) -> Response:
+    from ..wal.replay import ReplayBusyError, ReplayError, start_service_replay
+
+    try:
+        return Response(200, start_service_replay(service, payload or {}))
+    except ReplayError as exc:
+        raise ValueError(str(exc)) from exc          # HTTP 400
+    except ReplayBusyError as exc:
+        # one replay per process, and pipeline mode must not interleave
+        # with a running engine — state conflicts, same semantics as
+        # /admin/profile and /admin/load
+        return Response(409, {"detail": str(exc)})
+
+
 def _replicas_control(service, query, payload) -> Response:
     router = getattr(service.engine, "router", None)
     if router is None:
@@ -322,6 +346,8 @@ ROUTES: Tuple[Route, ...] = (
           "replica-router roll-up: per-replica state/backlog/inflight"),
     Route("GET", "/admin/model", _model,
           "model lifecycle status (?history=1 for the checkpoint log)"),
+    Route("GET", "/admin/replay", _replay_status,
+          "WAL replay status + the live ingress spool's stats"),
     Route("POST", "/admin/start", _start, "start the engine"),
     Route("POST", "/admin/stop", _stop, "stop the engine"),
     Route("POST", "/admin/shutdown", _shutdown, "shut the service down"),
@@ -337,6 +363,9 @@ ROUTES: Tuple[Route, ...] = (
           "operator drain/undrain of one replica"),
     Route("POST", "/admin/model", _model_control,
           "model lifecycle verbs: promote/rollback/pin/unpin/cycle"),
+    Route("POST", "/admin/replay", _replay_control,
+          "replay a recorded WAL spool: pipeline re-drive or offline "
+          "shadow-scoring of a dmroll candidate"),
 )
 
 

@@ -72,6 +72,17 @@ SCENARIOS = {
                 "deliberately-broken candidate shadows — gated on "
                 "ModelCanaryDiverging firing and the "
                 "model_canary_holdback event"),
+    "ingress_crash": (("SpoolAgeHigh",),
+                      "the parser (durable_ingress on) wedges mid-burst "
+                      "with frames banked unacked in its WAL spool, then "
+                      "dies cold (crash_abort: no drain, no acks, results "
+                      "of the in-flight burst lost exactly as kill -9 "
+                      "loses them) and stays down for the fault window; "
+                      "gates: SpoolAgeHigh actually firing during the "
+                      "outage, restart recovery replaying the unacked "
+                      "suffix (wal_replayed recovery > 0), zero "
+                      "unique-frame loss end-to-end, and the spool fully "
+                      "acked (depth 0) after the settle window"),
 }
 
 AUDIT_LOG_FORMAT = "type=<Type> msg=audit(<Time>): <Content>"
@@ -79,7 +90,7 @@ AUDIT_TEMPLATE = ("arch=<*> syscall=<*> success=<*> exit=<*> pid=<*> "
                   "uid=<*> comm=<*> exe=<*>")
 
 
-def build_settings(tmp: Path, burst: int, rollout_dir=None):
+def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None):
     """The three service settings + component configs of the soak pipeline.
     Frame sizes are kept uniform (engine_frame_batch == loadgen burst) so
     wire frames map ~1:1 through every stage and the FIFO trace attachment
@@ -92,11 +103,18 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None):
         engine_batch_size=max(512, 2 * burst), engine_batch_timeout_ms=5.0,
         engine_frame_batch=burst, engine_recv_timeout=50,
     )
+    wal = {}
+    if wal_dir is not None:
+        # durable ingress on the pipeline's front stage: a fast fsync tick
+        # (CI-sized) and a small segment so the scenario exercises a roll
+        wal = dict(durable_ingress=True, wal_dir=str(wal_dir),
+                   wal_fsync_interval_ms=20.0,
+                   wal_segment_bytes=4 * 1024 * 1024)
     parser = ServiceSettings(
         component_type="parsers.template_matcher.MatcherParser",
         component_id="soak-parser", trace_stage="parser",
         engine_addr="inproc://soak-parser",
-        out_addr=["inproc://soak-detector"], **common)
+        out_addr=["inproc://soak-detector"], **wal, **common)
     rollout = {}
     if rollout_dir is not None:
         # the dmroll cycle, CI-sized: a generous mean-delta gate (a 1-epoch
@@ -155,12 +173,14 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None):
             (output, output_cfg)]
 
 
-def boot_pipeline(tmp: Path, factory, burst: int, rollout_dir=None):
+def boot_pipeline(tmp: Path, factory, burst: int, rollout_dir=None,
+                  wal_dir=None):
     from detectmateservice_tpu.core import Service
 
     services = []
     for settings, config in build_settings(tmp, burst,
-                                           rollout_dir=rollout_dir):
+                                           rollout_dir=rollout_dir,
+                                           wal_dir=wal_dir):
         service = Service(settings, component_config=config,
                           socket_factory=factory)
         service.setup_io()
@@ -285,6 +305,28 @@ def install_stall(services, flag: threading.Event) -> None:
     parser.process_frames = stalled
 
 
+def install_crash_stall(services, flag: threading.Event) -> None:
+    """The ingress_crash wedge: like ``install_stall``, but abort-aware —
+    ``crash_abort`` must be able to kill the engine thread while it sits
+    INSIDE the wedged component call (the frames of that burst are exactly
+    the in-flight state a dying process loses). On abort the wrapper
+    raises (the engine counts the error and the loop exits); on a later
+    restart the cleared flags make it a plain passthrough, so recovery
+    replays through the REAL parser."""
+    parser = services[0].library_component
+    engine = services[0].engine
+    original = parser.process_frames
+
+    def stalled(frames):
+        while flag.is_set() and not engine._abort_event.is_set():
+            time.sleep(0.02)
+        if engine._abort_event.is_set():
+            raise RuntimeError("crash_abort mid-process (ingress_crash)")
+        return original(frames)
+
+    parser.process_frames = stalled
+
+
 def inject_recompiles(n: int = 4, spacing_s: float = 0.5) -> None:
     """Feed post-warm-up dispatch-path compiles into the XLA ledger (the
     same injection seam tests/test_device_obs.py uses): each one is what a
@@ -329,10 +371,10 @@ def main() -> int:
     # (scaled) detection horizon — threshold crossing + for: hold
     fault_defaults = {"none": 0.0, "stall": 45.0, "slow_sink": 45.0,
                       "recompile": 8.0, "replica_kill": 40.0,
-                      "rollout": 45.0}
+                      "rollout": 45.0, "ingress_crash": 45.0}
     scale_defaults = {"none": 6.0, "stall": 6.0, "slow_sink": 12.0,
                       "recompile": 6.0, "replica_kill": 12.0,
-                      "rollout": 12.0}
+                      "rollout": 12.0, "ingress_crash": 12.0}
     fault_s = (args.fault_seconds if args.fault_seconds is not None
                else fault_defaults[args.scenario])
     time_scale = (args.time_scale if args.time_scale is not None
@@ -410,6 +452,9 @@ def main() -> int:
         elif args.scenario == "rollout":
             services = boot_pipeline(Path(tmp), factory, args.burst,
                                      rollout_dir=Path(tmp) / "rollout")
+        elif args.scenario == "ingress_crash":
+            services = boot_pipeline(Path(tmp), factory, args.burst,
+                                     wal_dir=Path(tmp) / "wal")
         else:
             services = boot_pipeline(Path(tmp), factory, args.burst)
         scraper = Scraper(store, evaluator, services)
@@ -503,6 +548,8 @@ def main() -> int:
                       f"({fault_s:.0f} s, time scale {time_scale:g})")
                 if args.scenario == "stall":
                     install_stall(services, stall_flag)
+                elif args.scenario == "ingress_crash":
+                    install_crash_stall(services, stall_flag)
                 lead_s, tail_s = 5.0, 20.0
                 generator = new_generator(
                     factory, lead_s + fault_s + tail_s,
@@ -546,6 +593,29 @@ def main() -> int:
                     router_service.engine.router.replicas[victim_pos] \
                         .admin_url = (f"http://127.0.0.1:"
                                       f"{victim.web_server.port}")
+                elif args.scenario == "ingress_crash":
+                    # wedge first so ingress frames bank UNACKED in the
+                    # parser's spool (appended at recv, ack blocked behind
+                    # the stalled component call), then die cold inside
+                    # the wedge: no drain epilogue, no acks, no clean
+                    # manifest commit — the in-flight burst's results are
+                    # gone exactly as kill -9 loses them. The outage then
+                    # runs with the engine thread dead while the
+                    # scrape-time spool-age gauge keeps climbing.
+                    parser_service = services[0]
+                    stall_flag.set()
+                    time.sleep(4.0)      # bank unacked frames in the wedge
+                    parser_service.engine.crash_abort()
+                    stall_flag.clear()
+                    crash_spool = parser_service.engine.spool
+                    record["wal_at_crash"] = crash_spool.stats()
+                    print(f"[soak] parser crashed with "
+                          f"{record['wal_at_crash']['depth_frames']} "
+                          "unacked spool frames; outage begins")
+                    time.sleep(max(0.0, fault_s - 4.0))
+                    # "restarted process": recovery must replay the
+                    # unacked suffix before accepting the banked backlog
+                    parser_service.start()
                 elif args.scenario == "rollout":
                     # phase A (healthy): one full dmroll cycle under load —
                     # sample → fine-tune → checkpoint → shadow → promote →
@@ -620,6 +690,35 @@ def main() -> int:
                           unexpected == 0,
                           f"scorer_xla_recompiles_unexpected_total="
                           f"{unexpected}")
+                if args.scenario == "ingress_crash":
+                    # the durability contract, gated by execution: frames
+                    # were banked unacked at the crash, recovery actually
+                    # replayed them, the collector saw every unique trace
+                    # id end-to-end, and the spool drained back to acked
+                    parser_service = services[0]
+                    spool = parser_service.engine.spool
+                    record["wal"] = spool.stats()
+                    check("wal_unacked_at_crash",
+                          record["wal_at_crash"]["depth_frames"] > 0,
+                          f"{record['wal_at_crash']['depth_frames']} "
+                          "frames banked unacked when the parser died")
+                    replayed = parser_service.engine \
+                        ._m_wal_recovered._value.get()
+                    check("wal_recovery_replayed",
+                          replayed > 0,
+                          "wal_replayed_frames_total{mode='recovery'}="
+                          f"{replayed:.0f}")
+                    check("post_settle_loss_zero",
+                          chaos["scorecard"]["loss"] == 0,
+                          f"loss={chaos['scorecard']['loss']} of "
+                          f"{chaos['scorecard']['sent_frames']} frames "
+                          "(unique trace ids; recovery duplicates "
+                          "collapse)")
+                    check("wal_spool_drained",
+                          record["wal"]["depth_frames"] == 0,
+                          f"depth={record['wal']['depth_frames']} acked="
+                          f"{record['wal']['acked_seq']} of "
+                          f"{record['wal']['last_appended_seq']}")
                 if args.scenario == "rollout":
                     # the rollout contract, gated by execution: the swap
                     # was served, nothing was lost across it, the compile

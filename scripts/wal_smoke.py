@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""CI smoke for dmwal: record → kill -9 → recover → replay → byte-compare.
+
+Four fail-fast phases, all on CPU without jax, inside ~10 s (mirrors the
+rollout-smoke shape — every gate asserts immediately, no pollable hangs):
+
+1. **record + kill**: a child process appends audit-log wire frames to a
+   spool at full speed (fsync batching + ack watermark + manifest commits
+   all racing) and is SIGKILLed mid-write;
+2. **recover**: the parent reopens the spool and gates the crash
+   invariants — no torn record served, recovered sequences contiguous and
+   strictly increasing, the persisted-ack prefix never replayed;
+3. **replay + byte-compare**: the recovered spool is re-driven through a
+   real ``MatcherParser`` (integration gate: every recorded line parses),
+   then TWICE through a deterministic featurizer-shaped processor whose
+   two runs must produce the same SHA-256 output digest — the
+   byte-determinism contract (docs/durability.md; the parser itself
+   stamps fresh ``parsedLogID``/``parsedTimestamp`` per row by schema
+   design, so determinism is asserted where it is promised: on
+   deterministic components like the detector's fixed-params score path);
+4. **engine crash/recover**: an ``Engine`` with ``durable_ingress`` takes
+   traffic over inproc sockets, dies via the crash seam with frames
+   banked, restarts, and must deliver every unique frame downstream.
+
+Writes the recovered spool's manifest to ``--manifest-out`` for the
+workflow-artifact upload.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+AUDIT_LINE = (b"type=SYSCALL msg=audit(1700000000.%03d:%d): arch=c000003e "
+              b"syscall=59 success=yes exit=0 pid=%d uid=0 comm=cron "
+              b"exe=/usr/sbin/cron")
+
+_CHILD = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from detectmateservice_tpu.wal import IngressSpool
+
+spool = IngressSpool({wal!r}, segment_bytes=16384, fsync_interval_ms=2)
+seq = 0
+while True:
+    line = b"type=SYSCALL msg=audit(1700000000.%03d:%d): arch=c000003e " \
+           b"syscall=59 success=yes exit=0 pid=%d uid=0 comm=cron " \
+           b"exe=/usr/sbin/cron" % (seq % 1000, seq, seq % 32768)
+    seq = spool.append(line)
+    if seq % 7 == 0:
+        spool.ack(seq - 5)
+    spool.tick()
+    if seq == 5:
+        print("ready", flush=True)
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--manifest-out", default="wal-manifest.json")
+    args = ap.parse_args()
+
+    import tempfile
+
+    t0 = time.monotonic()
+    tmp = Path(tempfile.mkdtemp(prefix="wal-smoke-"))
+    wal = tmp / "wal"
+
+    def gate(name: str, ok: bool, detail: str) -> None:
+        print(f"[wal-smoke] {'PASS' if ok else 'FAIL'} {name}: {detail}")
+        if not ok:
+            raise SystemExit(f"wal-smoke failed at {name}")
+
+    # -- phase 1: record at full speed, then kill -9 mid-write ------------
+    child = subprocess.Popen([sys.executable, "-c",
+                              _CHILD.format(repo=str(REPO), wal=str(wal))],
+                             stdout=subprocess.PIPE)
+    assert child.stdout.readline().strip() == b"ready"
+    time.sleep(0.3)
+    os.kill(child.pid, signal.SIGKILL)
+    child.wait(timeout=10)
+    gate("record_killed", True, "spool writer SIGKILLed mid-append")
+
+    # -- phase 2: recovery invariants -------------------------------------
+    from detectmateservice_tpu.wal import IngressSpool
+
+    manifest_doc = json.loads((wal / "MANIFEST.json").read_text())
+    persisted_ack = manifest_doc["acked_seq"]
+    spool = IngressSpool(wal, fsync_interval_ms=0)
+    recovered = spool.recover_unacked()
+    seqs = [seq for seq, _ in recovered]
+    gate("recovered_nonempty", len(recovered) > 0,
+         f"{len(recovered)} unacked frames (last seq "
+         f"{spool.last_appended_seq}, persisted ack {persisted_ack})")
+    gate("no_ack_replayed", all(seq > persisted_ack for seq in seqs),
+         "persisted-ack prefix excluded from replay")
+    gate("suffix_contiguous", seqs == list(range(seqs[0], seqs[-1] + 1)),
+         f"seq {seqs[0]}..{seqs[-1]} with no holes")
+    gate("no_torn_record",
+         all(frame.startswith(b"type=SYSCALL") for _, frame in recovered),
+         "every recovered frame intact by CRC + content check")
+    spool.close()
+
+    # -- phase 3: byte-deterministic replay through a REAL parser ----------
+    from detectmateservice_tpu.library.parsers.template_matcher import (
+        MatcherParser,
+        MatcherParserConfig,
+    )
+    from detectmateservice_tpu.wal import ReplayDriver
+
+    templates = tmp / "templates.txt"
+    templates.write_text("arch=<*> syscall=<*> success=<*> exit=<*> "
+                         "pid=<*> uid=<*> comm=<*> exe=<*>\n",
+                         encoding="utf-8")
+
+    def parser():
+        return MatcherParser(config=MatcherParserConfig(
+            method_type="matcher_parser", auto_config=False,
+            log_format="type=<Type> msg=audit(<Time>): <Content>",
+            accept_raw_lines=True,
+            params={"path_templates": str(templates)}))
+
+    parsed = ReplayDriver(wal, parser()).run(limit=2000)
+    gate("replay_outputs", parsed["outputs"] == parsed["messages"],
+         f"{parsed['frames']} frames -> {parsed['outputs']} parsed "
+         "outputs through a real MatcherParser")
+
+    import hashlib
+
+    class Featurize:
+        """Deterministic stand-in for the detector's fixed-params score
+        path: content-keyed output, no wall-clock or random stamps."""
+
+        def process_batch(self, batch):
+            return [hashlib.sha256(d).digest() + d[:32] for d in batch]
+
+    r1 = ReplayDriver(wal, Featurize()).run()
+    r2 = ReplayDriver(wal, Featurize()).run()
+    gate("replay_byte_deterministic",
+         r1["output_digest"] == r2["output_digest"] and r1["outputs"] > 0,
+         f"digest {r1['output_digest'][:16]}… identical across two runs "
+         f"({r1['outputs']} outputs)")
+
+    # -- phase 4: engine crash seam + recovery, zero unique loss -----------
+    from detectmateservice_tpu.engine import Engine
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+    from detectmateservice_tpu.settings import ServiceSettings
+
+    class Echo:
+        def process(self, data):
+            return data
+
+    factory = InprocQueueSocketFactory(maxsize=4096)
+    settings = ServiceSettings(
+        component_type="core", component_id="wal-smoke",
+        engine_addr="inproc://wal-smoke-in",
+        out_addr=["inproc://wal-smoke-out"],
+        durable_ingress=True, wal_dir=str(tmp / "wal-engine"),
+        wal_fsync_interval_ms=0, engine_recv_timeout=20,
+        log_to_file=False, log_to_console=False)
+    engine = Engine(settings, Echo(), socket_factory=factory)
+    sink = factory.create("inproc://wal-smoke-out")
+    sink.recv_timeout = 50
+    sender = factory.create_output("inproc://wal-smoke-in")
+
+    def drain():
+        out = []
+        try:
+            while True:
+                out.append(sink.recv())
+        except Exception:
+            return out
+
+    engine.start()
+    expect = set()
+    for i in range(40):
+        frame = b"smoke-%03d" % i
+        expect.add(frame)
+        sender.send(frame)
+        if i == 30:
+            time.sleep(0.2)               # let a prefix flow end to end
+    engine.crash_abort()
+    delivered = drain()
+    gate("engine_crashed", not engine.running,
+         f"crash seam hit with {len(delivered)}/40 delivered, spool depth "
+         f"{engine._spool.depth_frames():.0f}")
+    engine.start()
+    deadline = time.monotonic() + 10
+    while engine._spool.depth_frames() > 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    delivered += drain()
+    missing = expect - set(delivered)
+    gate("zero_unique_loss", not missing,
+         f"{len(set(delivered) & expect)}/40 unique frames delivered "
+         f"({len(delivered) - len(set(delivered))} duplicate(s), "
+         "at-least-once)")
+    engine.stop()
+
+    # -- artifact ----------------------------------------------------------
+    out = Path(args.manifest_out)
+    out.write_text(json.dumps({
+        "schema": "wal-smoke-v1",
+        "recovered_frames": len(recovered),
+        "persisted_ack": persisted_ack,
+        "replay_digest": r1["output_digest"],
+        "replay": {k: r1[k] for k in ("frames", "messages", "outputs")},
+        "manifest": manifest_doc,
+    }, indent=2) + "\n", encoding="utf-8")
+    print(f"[wal-smoke] PASS all gates in "
+          f"{time.monotonic() - t0:.1f}s -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
