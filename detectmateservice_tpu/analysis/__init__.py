@@ -18,7 +18,14 @@ sandbox where the mirrored wheel hooks cannot install:
   compilation, or blocking sleeps),
 * :mod:`contracts` — DM-C: REGISTERED_SERIES ↔ ops/alerts.yml ↔
   ops/grafana_dashboard.json ↔ docs/prometheus.md, and ServiceSettings ↔
-  docs/configuration.md ↔ examples/*settings*.yaml,
+  docs/configuration.md ↔ examples/*settings*.yaml — plus DM-E: the
+  structured-event contract (engine/health.py EVENT_KINDS ↔ every literal
+  emit site ↔ docs ↔ the kinds scripts/soak.py gates on),
+* :mod:`affinity`  — DM-A: whole-program thread affinity from
+  ``# dmlint: thread(...)`` ownership pragmas and the known thread entry
+  points (runtime twin: utils/threadcheck.assert_affinity),
+* :mod:`durability` — DM-D: crash-durability discipline in the persistence
+  modules (atomic commits, fsync'd renames, unbuffered WAL appends),
 * :mod:`markers`   — DM-T: every ``@pytest.mark.<m>`` used in tests/ must be
   registered in pyproject.toml,
 * :mod:`cli`       — the ``detectmate-lint`` entry point that runs them all,

@@ -1,0 +1,572 @@
+"""DM-A: whole-program thread-affinity analysis.
+
+PAPER §0's one-thread-per-stage engine model makes thread affinity *the*
+central correctness contract of this architecture: every replica socket is
+engine-thread-only, the WAL spool's write path is engine-thread-only, the
+supervisor thread does blocking HTTP and state handoffs but never touches a
+socket. Before this analyzer those seams were enforced by comments
+("engine thread only") and reviewer vigilance — and the PR 9 review bugs
+were precisely off-thread socket/state mutations a machine should have
+caught.
+
+The contract is declared with one pragma::
+
+    # dmlint: thread(engine)
+    def dispatch(self, wire, lines):
+        ...
+
+on (or above) a ``def`` — the method is owned by that thread domain — or on
+an ``__init__`` attribute assignment — the attribute is owned by it. The
+canonical domains are ``engine``, ``supervisor``, ``admin``, ``watchdog``,
+``rollout``, ``loadgen``; ``any`` declares a deliberately thread-safe
+surface (checked against nothing, but machine-readable intent).
+
+From the declarations and a table of **known thread entry points** (the
+engine ``_run_loop``, the watchdog tick, the supervisor poll, the
+RolloutManager thread, the LoadGenerator sender/collector threads, and —
+parsed from ``web/router.py``'s ROUTES table — every admin route handler)
+the analyzer builds a call graph: a method's *resolved domain* flows from
+an entry point along ``self.method()`` calls; receiver types of
+``self.attr.method()`` calls are inferred from ``self.attr = ClassName(...)``
+assignments, annotated ``__init__`` parameters, and simple local aliases
+(``router = self.router``). Unresolvable calls are silently skipped — the
+analyzer only reports what it can prove.
+
+Rules:
+
+  DM-A001  a method with resolved concrete domain D calls a method whose
+           declared owner is a different concrete domain (the PR 9 class of
+           bug: the supervisor calling an engine-owned socket path).
+  DM-A002  an attribute written outside ``__init__`` and touched from two
+           or more distinct concrete domains with no guarding lock — no
+           ``with self._lock`` region around any access, no
+           ``# dmlint: guarded-by(...)`` declaration, and no owning
+           ``thread(...)`` pragma violation already reported.
+  DM-A003  a socket or WAL-spool write-path call (``.send/.recv/...`` on a
+           ``*sock*`` attribute, ``append/ack/tick`` on an IngressSpool)
+           reachable from a control-plane entry point (supervisor, admin,
+           watchdog, rollout — the engine owns the data-plane sockets and
+           the loadgen client threads own their own).
+
+The runtime twin is :func:`detectmateservice_tpu.utils.threadcheck
+.assert_affinity` — a no-op unless ``DM_THREADCHECK=1`` — so the static
+claim is also dynamically audited in tests.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, PragmaIndex, scan_pragmas
+from .locks import LOCK_CTORS, _MUTATORS, _call_name, _self_attr
+
+ANY = "any"
+DOMAINS = ("engine", "supervisor", "admin", "watchdog", "rollout",
+           "loadgen", ANY)
+
+# (class, method) → domain: the thread entry points this tree spawns.
+# Extending the thread topology? docs/static_analysis.md has the recipe:
+# add the entry point here AND give the method a `# dmlint: thread(...)`
+# pragma (the pragma alone also works — this table is the safety net for
+# the seams that predate the pragma vocabulary).
+KNOWN_ENTRY_POINTS: Dict[Tuple[str, str], str] = {
+    ("Engine", "_run_loop"): "engine",
+    ("HealthMonitor", "_run"): "watchdog",
+    ("ReplicaSupervisor", "run"): "supervisor",
+    ("ReplicaSupervisor", "poll_once"): "supervisor",
+    ("RolloutManager", "_run"): "rollout",
+    ("LoadGenerator", "_sender_loop"): "loadgen",
+    ("LoadGenerator", "_collector_loop"): "loadgen",
+}
+
+# socket write-path method names for DM-A003 (the engine's single-threaded
+# transport contract); `close` is deliberately absent — teardown runs on
+# the stopping thread after the engine thread is joined
+_SOCKET_OPS = {"send", "sendall", "sendto", "send_many", "recv", "recv_many",
+               "recv_timeout", "recvfrom", "accept", "connect"}
+_SPOOL_OPS = {"append", "ack", "tick"}
+_SPOOL_TYPES = {"IngressSpool"}
+# DM-A003 constrains the control-plane threads: the engine owns the data
+# plane's sockets, and the loadgen client threads own their OWN sockets by
+# design (a load generator IS a socket client) — the rule exists to stop
+# the supervisor/admin/watchdog/rollout threads from reaching the pipeline
+# transport (the PR 9 class of bug)
+_A003_EXEMPT_DOMAINS = {"engine", "loadgen", ANY}
+
+
+def _sock_like(name: str) -> bool:
+    lower = name.lower()
+    return "sock" in lower or lower == "socket"
+
+
+@dataclass
+class _Method:
+    cls: str
+    name: str
+    line: int
+    declared: Optional[str] = None          # thread(...) pragma domain
+    self_calls: List[Tuple[str, int]] = field(default_factory=list)
+    # (attr-or-local receiver, method, line) for X.m() calls
+    recv_calls: List[Tuple[str, str, int]] = field(default_factory=list)
+    # socket-ish write-path call sites: (dotted name, line)
+    socket_ops: List[Tuple[str, int]] = field(default_factory=list)
+    # local name → class name (annotated params + `x = self.attr` aliases)
+    recv_types: Dict[str, str] = field(default_factory=dict)
+    # self.<attr> accesses: (attr, line, is_write, under_lock)
+    accesses: List[Tuple[str, int, bool, bool]] = field(default_factory=list)
+
+
+@dataclass
+class _Class:
+    name: str
+    rel: str
+    line: int
+    methods: Dict[str, _Method] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    attr_domains: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    guarded_attrs: Set[str] = field(default_factory=set)
+    lock_attrs: Set[str] = field(default_factory=set)
+    init_only_attrs: Set[str] = field(default_factory=set)
+
+
+def _annotation_class(node: Optional[ast.AST]) -> Optional[str]:
+    """Best-effort simple class name of an annotation (handles Optional[X],
+    "X" string forms, and dotted names)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+        for wrap in ("Optional[", "optional["):
+            if text.startswith(wrap) and text.endswith("]"):
+                text = text[len(wrap):-1]
+        return text.rsplit(".", 1)[-1].strip('"\' ') or None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):    # Optional[X] / List[X] → X
+        return _annotation_class(node.slice)
+    return None
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Collect one method's call sites, attribute accesses, and the local
+    aliases of typed ``self.attr`` values."""
+
+    def __init__(self, method: _Method, cls: _Class,
+                 module_scope: bool = False) -> None:
+        self.method = method
+        self.cls = cls
+        # in a module-level function, bare f() calls resolve against the
+        # module's other functions; in a method they resolve to module
+        # scope, which the pseudo-class does not see — skip them there
+        self.module_scope = module_scope
+        self.local_types: Dict[str, str] = {}    # local name → class name
+        self._lock_depth = 0
+
+    # -- aliases / attr types --------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        # self.attr = ClassName(...) — receiver-type inference
+        if isinstance(value, ast.Call):
+            cls_name = _call_name(value.func).rsplit(".", 1)[-1]
+            if cls_name and cls_name[:1].isupper():
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        self.cls.attr_types.setdefault(attr, cls_name)
+        # local = self.attr — alias inherits the attr's inferred type;
+        # self.attr = param — attr inherits an annotated param's type
+        if isinstance(value, ast.Name) and value.id in self.local_types:
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    self.cls.attr_types.setdefault(
+                        attr, self.local_types[value.id])
+        attr = _self_attr(value)
+        if attr is not None and attr in self.cls.attr_types:
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.local_types[target.id] = self.cls.attr_types[attr]
+        self.generic_visit(node)
+
+    # -- lock regions ----------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        locked = False
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in self.cls.lock_attrs:
+                locked = True
+        if locked:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self._lock_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs run on some other thread later; skip (the closure's
+        # body is analyzed where its thread target is declared)
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- calls / accesses ------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if self.module_scope:
+                self.method.self_calls.append((func.id, node.lineno))
+        elif isinstance(func, ast.Attribute):
+            recv = func.value
+            attr = _self_attr(func)
+            if attr is not None:
+                self.method.self_calls.append((attr, node.lineno))
+            else:
+                # X.m(...) — record the receiver when it is a self.attr, a
+                # typed local, or a dotted path ending in an attribute name
+                recv_name = None
+                recv_attr = _self_attr(recv)
+                if recv_attr is not None:
+                    recv_name = recv_attr
+                elif isinstance(recv, ast.Name):
+                    recv_name = recv.id
+                elif isinstance(recv, ast.Attribute):
+                    recv_name = recv.attr
+                if recv_name is not None:
+                    self.method.recv_calls.append(
+                        (recv_name, func.attr, node.lineno))
+                # DM-A003 candidates: <...sock...>.send(...) etc.
+                if func.attr in _SOCKET_OPS and recv_name is not None \
+                        and _sock_like(recv_name):
+                    self.method.socket_ops.append(
+                        (_call_name(func), node.lineno))
+            # container mutation through the attribute is a WRITE to the
+            # shared state behind it (same modeling as the lock analyzer)
+            if func.attr in _MUTATORS:
+                target = _self_attr(func.value)
+                if target is not None \
+                        and target not in self.cls.lock_attrs:
+                    self.method.accesses.append(
+                        (target, node.lineno, True, self._lock_depth > 0))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and attr not in self.cls.lock_attrs:
+            self.method.accesses.append(
+                (attr, node.lineno, isinstance(node.ctx, ast.Store),
+                 self._lock_depth > 0))
+        self.generic_visit(node)
+
+
+def _collect_class(rel: str, node: ast.ClassDef,
+                   pragmas: PragmaIndex) -> _Class:
+    cls = _Class(node.name, rel, node.lineno)
+    # pass 1: lock attributes (needed before walking method bodies)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            if _call_name(sub.value.func).rsplit(".", 1)[-1] in LOCK_CTORS:
+                for target in sub.targets:
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        cls.lock_attrs.add(attr)
+    # pass 2: methods
+    for stmt in node.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        method = _Method(cls.name, stmt.name, stmt.lineno,
+                         declared=pragmas.thread_domain(stmt.lineno))
+        walker = _MethodWalker(method, cls)
+        # annotated parameters type their matching self.attr assignments
+        for arg in stmt.args.args + stmt.args.kwonlyargs:
+            typed = _annotation_class(arg.annotation)
+            if typed is not None and typed[:1].isupper():
+                walker.local_types[arg.arg] = typed
+        for body_stmt in stmt.body:
+            walker.visit(body_stmt)
+        method.recv_types = dict(walker.local_types)
+        cls.methods[stmt.name] = method
+        if stmt.name == "__init__":
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for target in sub.targets:
+                    attr = _self_attr(target)
+                    if attr is None:
+                        continue
+                    domain = pragmas.thread_domain(sub.lineno)
+                    if domain is not None:
+                        cls.attr_domains[attr] = (domain, sub.lineno)
+                    lock = (pragmas.guarded_by.get(sub.lineno)
+                            or pragmas.guarded_by.get(sub.lineno - 1))
+                    if lock is not None:
+                        cls.guarded_attrs.add(attr)
+    # pass 3: attribute guard inference + init-only detection
+    writers: Dict[str, Set[str]] = {}
+    for method in cls.methods.values():
+        for attr, _line, is_write, under_lock in method.accesses:
+            if under_lock:
+                cls.guarded_attrs.add(attr)
+            if is_write and method.name != "__init__":
+                writers.setdefault(attr, set()).add(method.name)
+    all_attrs = {a for m in cls.methods.values()
+                 for a, _l, _w, _u in m.accesses}
+    cls.init_only_attrs = {a for a in all_attrs if a not in writers}
+    return cls
+
+
+def _routes_handlers(repo: Path) -> Set[str]:
+    """Names of the admin route handlers declared in web/router.py ROUTES —
+    each one is an ``admin``-domain entry point."""
+    router_py = repo / "detectmateservice_tpu" / "web" / "router.py"
+    handlers: Set[str] = set()
+    if not router_py.exists():
+        return handlers
+    try:
+        tree = ast.parse(router_py.read_text(encoding="utf-8"))
+    except SyntaxError:
+        return handlers
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else getattr(func, "attr", "")
+        if name != "Route" or len(node.args) < 3:
+            continue
+        handler = node.args[2]
+        if isinstance(handler, ast.Name):
+            handlers.add(handler.id)
+    return handlers
+
+
+@dataclass
+class _Project:
+    classes: List[_Class] = field(default_factory=list)
+    pragmas: Dict[str, PragmaIndex] = field(default_factory=dict)
+    # class name → {method: declared domain} (ambiguous names dropped)
+    ownership: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+
+def _build_project(files: Iterable[Tuple[str, str]],
+                   admin_handlers: Set[str]) -> _Project:
+    project = _Project()
+    dup: Set[str] = set()
+    for rel, source in files:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue  # DM-B005 owns unparseable files
+        pragmas = scan_pragmas(source)
+        project.pragmas[rel] = pragmas
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = _collect_class(rel, node, pragmas)
+                project.classes.append(cls)
+                if cls.name in project.ownership:
+                    dup.add(cls.name)
+                project.ownership[cls.name] = {
+                    m.name: m.declared for m in cls.methods.values()
+                    if m.declared is not None}
+        # module-level functions form a pseudo-class so route handlers (and
+        # any pragma-declared module function) participate: handlers named
+        # in the ROUTES table are admin-domain entry points
+        mod_cls = _Class(f"<module {rel}>", rel, 1)
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared = pragmas.thread_domain(node.lineno)
+            if declared is None and node.name in admin_handlers:
+                declared = "admin"
+            method = _Method(mod_cls.name, node.name, node.lineno,
+                             declared=declared)
+            walker = _MethodWalker(method, mod_cls, module_scope=True)
+            for arg in node.args.args + node.args.kwonlyargs:
+                typed = _annotation_class(arg.annotation)
+                if typed is not None and typed[:1].isupper():
+                    walker.local_types[arg.arg] = typed
+            for body_stmt in node.body:
+                walker.visit(body_stmt)
+            method.recv_types = dict(walker.local_types)
+            mod_cls.methods[node.name] = method
+        if mod_cls.methods:
+            project.classes.append(mod_cls)
+    # a class name defined twice with different ownership maps is ambiguous
+    # for name-based receiver typing — keep the union only where consistent
+    for name in dup:
+        maps = [
+            {m.name: m.declared for m in c.methods.values()
+             if m.declared is not None}
+            for c in project.classes if c.name == name]
+        merged: Dict[str, str] = {}
+        for mapping in maps:
+            for meth, domain in mapping.items():
+                if merged.get(meth, domain) != domain:
+                    merged.pop(meth, None)
+                else:
+                    merged[meth] = domain
+        project.ownership[name] = merged
+    return project
+
+
+def _resolve_domains(cls: _Class) -> Dict[str, str]:
+    """Entry-point + pragma domains, propagated along self-calls to
+    undeclared methods; a method reachable from two different concrete
+    domains resolves to ``any`` (its calls are checked against nothing)."""
+    resolved: Dict[str, str] = {}
+    for method in cls.methods.values():
+        domain = (method.declared
+                  or KNOWN_ENTRY_POINTS.get((cls.name, method.name)))
+        if domain is not None:
+            resolved[method.name] = domain
+    for _ in range(len(cls.methods) + 1):
+        changed = False
+        for method in cls.methods.values():
+            caller = resolved.get(method.name)
+            if caller is None or caller == ANY:
+                continue
+            for callee, _line in method.self_calls:
+                target = cls.methods.get(callee)
+                if target is None or target.declared is not None:
+                    continue
+                prev = resolved.get(callee)
+                if prev is None:
+                    resolved[callee] = caller
+                    changed = True
+                elif prev not in (caller, ANY):
+                    resolved[callee] = ANY      # ambiguous: shared helper
+                    changed = True
+        if not changed:
+            break
+    return resolved
+
+
+def check_project(files: Sequence[Tuple[str, str]],
+                  admin_handlers: Optional[Set[str]] = None) -> List[Finding]:
+    """Run DM-A001..003 over a whole set of ``(rel_path, source)`` modules
+    (affinity is a whole-program property — receiver types and ownership
+    declarations cross file boundaries)."""
+    project = _build_project(files, admin_handlers or set())
+    findings: List[Finding] = []
+    for cls in project.classes:
+        pragmas = project.pragmas[cls.rel]
+        resolved = _resolve_domains(cls)
+        for method in cls.methods.values():
+            domain = resolved.get(method.name)
+            if domain is None or domain == ANY:
+                continue
+
+            # -- DM-A001: calls into foreign-owned methods ----------------
+            def _check_call(owner: Optional[str], target_desc: str,
+                            line: int, key: str) -> None:
+                if owner is None or owner in (domain, ANY):
+                    return
+                if pragmas.is_ignored("DM-A001", line):
+                    return
+                findings.append(Finding(
+                    "DM-A001", cls.rel, line,
+                    f"{cls.name}.{method.name}() runs on the {domain} "
+                    f"thread but calls {target_desc}, owned by the "
+                    f"{owner} thread",
+                    hint="hand the work to the owning thread (queue + "
+                         "tick), or re-declare the ownership pragma",
+                    key=key))
+
+            for callee, line in method.self_calls:
+                target = cls.methods.get(callee)
+                if target is not None:
+                    _check_call(
+                        target.declared, f"self.{callee}()", line,
+                        f"{cls.name}.{method.name}->{callee}")
+            for recv, callee, line in method.recv_calls:
+                recv_type = (method.recv_types.get(recv)
+                             or cls.attr_types.get(recv))
+                if recv_type is None:
+                    continue
+                owner = project.ownership.get(recv_type, {}).get(callee)
+                _check_call(
+                    owner, f"{recv_type}.{callee}()", line,
+                    f"{cls.name}.{method.name}->{recv_type}.{callee}")
+
+            # -- DM-A003: socket/spool write path off-engine --------------
+            if domain not in _A003_EXEMPT_DOMAINS:
+                for label, line in method.socket_ops:
+                    if pragmas.is_ignored("DM-A003", line):
+                        continue
+                    findings.append(Finding(
+                        "DM-A003", cls.rel, line,
+                        f"socket write-path call {label}() reachable from "
+                        f"the {domain} thread in {cls.name}.{method.name}() "
+                        "(sockets are engine-thread-only)",
+                        hint="move the socket op to the engine tick (set a "
+                             "flag, let dispatch/tick act on it)",
+                        key=f"{cls.name}.{method.name}:{label}"))
+                for recv, callee, line in method.recv_calls:
+                    if callee not in _SPOOL_OPS:
+                        continue
+                    recv_type = (method.recv_types.get(recv)
+                                 or cls.attr_types.get(recv))
+                    if recv_type not in _SPOOL_TYPES:
+                        continue
+                    if pragmas.is_ignored("DM-A003", line):
+                        continue
+                    findings.append(Finding(
+                        "DM-A003", cls.rel, line,
+                        f"WAL spool write-path call {recv}.{callee}() "
+                        f"reachable from the {domain} thread in "
+                        f"{cls.name}.{method.name}() (the spool write path "
+                        "is engine-thread-only)",
+                        hint="only the engine loop may append/ack/tick the "
+                             "spool",
+                        key=f"{cls.name}.{method.name}:spool.{callee}"))
+
+        # -- DM-A002: unguarded attributes shared across domains ----------
+        touched: Dict[str, Dict[str, Tuple[int, bool]]] = {}
+        written: Set[str] = set()
+        for method in cls.methods.values():
+            if method.name == "__init__":
+                continue
+            domain = resolved.get(method.name)
+            if domain is None or domain == ANY:
+                continue
+            for attr, line, is_write, _under in method.accesses:
+                touched.setdefault(attr, {}).setdefault(
+                    domain, (line, is_write))
+                if is_write:
+                    written.add(attr)
+        for attr, by_domain in sorted(touched.items()):
+            if len(by_domain) < 2 or attr not in written:
+                continue
+            if attr in cls.guarded_attrs or attr in cls.init_only_attrs:
+                continue
+            lines = [line for line, _w in by_domain.values()]
+            if any(pragmas.is_ignored("DM-A002", line) for line in lines):
+                continue
+            declared_owner = cls.attr_domains.get(attr)
+            owner_note = (f" (declared thread({declared_owner[0]}))"
+                          if declared_owner else "")
+            domains = ", ".join(sorted(by_domain))
+            findings.append(Finding(
+                "DM-A002", cls.rel, min(lines),
+                f"{cls.name}.{attr} is shared across affinity domains "
+                f"({domains}) with no guarding lock{owner_note}",
+                hint="guard it with a lock (or declare guarded-by / pragma "
+                     "the benign race with a reason)",
+                key=f"{cls.name}.{attr}:shared"))
+    return findings
+
+
+def check_repo(repo: Path, files: Iterable[Path]) -> List[Finding]:
+    """Repo-entry wrapper: read the sources, parse the admin-handler table,
+    run :func:`check_project`."""
+    sources: List[Tuple[str, str]] = []
+    for path in files:
+        rel = path.resolve().relative_to(repo).as_posix()
+        if not rel.startswith("detectmateservice_tpu/"):
+            continue  # affinity domains are a package-internal contract
+        try:
+            sources.append((rel, path.read_text(encoding="utf-8")))
+        except OSError:
+            continue
+    return check_project(sources, admin_handlers=_routes_handlers(repo))

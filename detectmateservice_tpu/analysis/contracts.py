@@ -25,6 +25,20 @@ YAMLs. These rules hold them in sync, in both directions:
            is not documented in docs/benchmarks.md (the soak-record reader
            cannot interpret the verdict)
 
+and — the DM-E family — the structured-event contract, anchored on the
+canonical ``EVENT_KINDS`` registry in ``engine/health.py``:
+
+  DM-E001  an emit site uses a literal event kind the registry does not
+           declare (the event ships but nothing downstream can rely on it)
+  DM-E002  a registered kind is emitted nowhere (registry rot — or the
+           emit site was renamed without the registry)
+  DM-E003  a registered kind is not documented in the docs/prometheus.md
+           event-kind reference (the operator reading /admin/events cannot
+           interpret it)
+  DM-E004  an event kind a scripts/soak.py scenario gates on is never
+           emitted (the scenario can only ever FAIL — exactly how a rename
+           silently breaks a soak verdict)
+
 Everything is parsed statically — the series registry and the settings
 fields are read from the AST, not by importing the package — so the checker
 runs in environments where jax/pydantic/prometheus_client are absent. YAML
@@ -38,7 +52,7 @@ import ast
 import json
 import re
 from pathlib import Path
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from .findings import Finding
 
@@ -347,6 +361,167 @@ def check_soak_contract(repo: Path) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# DM-E: the structured-event contract
+# ---------------------------------------------------------------------------
+# files whose dict-literal "kind" keys are event payloads (the emit
+# surface); everything else under the package is still scanned for the
+# wrapper idioms, which are unambiguous
+_EVENT_PACKAGE_DIRS = ("detectmateservice_tpu",)
+# wrapper call names whose first positional argument IS the event kind
+_KIND_WRAPPERS = {"_event", "_note"}
+
+
+def declared_event_kinds(health_path: Path) -> Dict[str, int]:
+    """Parse ``engine/health.py`` for the ``EVENT_KINDS = {...}`` registry →
+    {kind: line}. AST-only: no package import."""
+    tree = ast.parse(health_path.read_text(encoding="utf-8"))
+    kinds: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "EVENT_KINDS" not in targets or not isinstance(node.value, ast.Dict):
+            continue
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                kinds[key.value] = key.lineno
+    return kinds
+
+
+def _literal_strings(node: ast.AST) -> List[str]:
+    """The literal string value(s) an expression can take: a constant, or
+    an ``a if c else b`` conditional over constants (the idiom emit sites
+    use instead of f-strings, precisely so this extraction works)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _literal_strings(node.body) + _literal_strings(node.orelse)
+    return []
+
+
+def emitted_event_kinds(repo: Path) -> Dict[str, Tuple[str, int]]:
+    """AST-walk every package module for literal event kinds at the emit
+    sites → {kind: (rel file, line)}. Three idioms are recognized: a dict
+    literal with a ``"kind"`` key, ``dict(..., kind="...")``, and the
+    ``self._event("kind", ...)`` / ``self._note("kind", ...)`` wrappers."""
+    kinds: Dict[str, Tuple[str, int]] = {}
+    for base in _EVENT_PACKAGE_DIRS:
+        root = repo / base
+        if not root.is_dir():
+            continue
+        for path in sorted(root.rglob("*.py")):
+            if "__pycache__" in path.parts or path.name == "schemas_pb2.py":
+                continue
+            if "analysis" in path.parts:
+                continue  # the analyzer/SARIF code is not an emit surface
+            rel = path.relative_to(repo).as_posix()
+            try:
+                tree = ast.parse(path.read_text(encoding="utf-8"))
+            except (SyntaxError, OSError):
+                continue
+            for node in ast.walk(tree):
+                found: List[str] = []
+                if isinstance(node, ast.Dict):
+                    for key, value in zip(node.keys, node.values):
+                        if (isinstance(key, ast.Constant)
+                                and key.value == "kind"):
+                            found = _literal_strings(value)
+                elif isinstance(node, ast.Call):
+                    name = node.func.id if isinstance(node.func, ast.Name) \
+                        else getattr(node.func, "attr", "")
+                    if name == "dict":
+                        for kw in node.keywords:
+                            if kw.arg == "kind":
+                                found = _literal_strings(kw.value)
+                    elif name in _KIND_WRAPPERS and node.args:
+                        found = _literal_strings(node.args[0])
+                for kind in found:
+                    kinds.setdefault(kind, (rel, node.lineno))
+    return kinds
+
+
+def soak_gated_kinds(soak_path: Path) -> Dict[str, int]:
+    """Literal event kinds scripts/soak.py scenarios gate on — the
+    ``"<kind>" in kinds`` membership tests → {kind: line}."""
+    tree = ast.parse(soak_path.read_text(encoding="utf-8"))
+    gated: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], ast.In):
+            continue
+        right = node.comparators[0]
+        right_name = right.id if isinstance(right, ast.Name) \
+            else getattr(right, "attr", "")
+        if "kind" not in right_name:
+            continue
+        if isinstance(node.left, ast.Constant) \
+                and isinstance(node.left.value, str):
+            gated[node.left.value] = node.lineno
+    return gated
+
+
+def check_events_contract(repo: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    health_py = repo / "detectmateservice_tpu" / "engine" / "health.py"
+    if not health_py.exists():
+        return findings
+    registry = declared_event_kinds(health_py)
+    if not registry:
+        return findings  # pre-registry tree: nothing to hold together
+    emitted = emitted_event_kinds(repo)
+    health_rel = "detectmateservice_tpu/engine/health.py"
+
+    # DM-E001: every emitted kind is registered
+    for kind, (rel, line) in sorted(emitted.items()):
+        if kind not in registry:
+            findings.append(Finding(
+                "DM-E001", rel, line,
+                f"emitted event kind {kind!r} is not declared in "
+                "engine/health.py EVENT_KINDS",
+                hint="register the kind (and document it) or fix the "
+                     "emit site's literal",
+                key=f"emit:{kind}"))
+
+    # DM-E002: every registered kind is emitted somewhere
+    for kind, line in sorted(registry.items()):
+        if kind not in emitted:
+            findings.append(Finding(
+                "DM-E002", health_rel, line,
+                f"registered event kind {kind!r} is emitted nowhere",
+                hint="delete the registry entry, or restore the emit "
+                     "site's literal kind",
+                key=f"registry:{kind}"))
+
+    # DM-E003: every registered kind is documented
+    prom_doc = repo / "docs" / "prometheus.md"
+    doc_text = prom_doc.read_text(encoding="utf-8") if prom_doc.exists() else ""
+    if doc_text:
+        for kind, line in sorted(registry.items()):
+            if not re.search(rf"`{re.escape(kind)}`", doc_text):
+                findings.append(Finding(
+                    "DM-E003", health_rel, line,
+                    f"registered event kind {kind!r} is not documented in "
+                    "docs/prometheus.md",
+                    hint="add a row to the event-kind reference table",
+                    key=f"event-doc:{kind}"))
+
+    # DM-E004: every soak-gated kind is actually emitted
+    soak_py = repo / "scripts" / "soak.py"
+    if soak_py.exists():
+        for kind, line in sorted(soak_gated_kinds(soak_py).items()):
+            if kind not in emitted:
+                findings.append(Finding(
+                    "DM-E004", "scripts/soak.py", line,
+                    f"soak scenario gates on event kind {kind!r}, which is "
+                    "never emitted — the scenario can only FAIL",
+                    hint="restore the emit site (or fix the gated literal)",
+                    key=f"gated:{kind}"))
+    return findings
+
+
 def check_all(repo: Path) -> List[Finding]:
     return (check_metrics_contract(repo) + check_settings_contract(repo)
-            + check_routes_contract(repo) + check_soak_contract(repo))
+            + check_routes_contract(repo) + check_soak_contract(repo)
+            + check_events_contract(repo))

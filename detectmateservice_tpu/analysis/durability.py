@@ -1,0 +1,233 @@
+"""DM-D: durability discipline in the persistence modules.
+
+The crash-atomicity story of this tree rests on exactly two proven
+patterns: ``utils/atomicio.write_json_atomic`` (temp sibling + fsync +
+``os.replace`` + directory fsync) for every manifest/meta commit, and the
+WAL's unbuffered append + batched-fsync segment protocol. A bare
+``json.dump`` into a final path, a rename with no fsync, or a buffered
+append handle silently re-opens the crash windows those patterns closed —
+and nothing at review time looks wrong. These rules make the discipline
+mechanical, in the modules where durability is the contract:
+
+  DM-D001  a bare write to a non-temp final path — ``json.dump(...)``,
+           ``open(path, "w"/"wb")``, or ``Path.write_text/write_bytes`` —
+           outside the temp+fsync+rename commit pattern. The write must go
+           through ``write_json_atomic`` or land in a temp/nonce sibling
+           that a later fsync'd rename commits.
+  DM-D002  ``os.rename``/``os.replace`` in a function that never fsyncs:
+           the rename is atomic but NOT durable — a power loss can undo a
+           commit the process already acted on. The committing function
+           must fsync the file before the rename or the directory after.
+  DM-D003  a buffered append handle on a WAL segment path:
+           ``open(..., "ab")`` without ``buffering=0`` widens the kill -9
+           loss window from "nothing" to "everything since the last flush"
+           (caught live in PR 11 — a SIGKILL mid-burst ate the whole
+           burst's appends out of the Python file buffer).
+
+Scope: only the modules whose job is persistence (:data:`PERSISTENCE_PATHS`
+— ``wal/``, ``rollout/store.py``, ``utils/checkpoint.py``,
+``utils/atomicio.py``). Elsewhere a throwaway ``open(.., "w")`` (a bench
+record, a test fixture) is fine and stays unflagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Iterator, List, Optional
+
+from .findings import Finding, PragmaIndex, scan_pragmas
+from .locks import _call_name
+
+PERSISTENCE_PATHS = (
+    "detectmateservice_tpu/wal/",
+    "detectmateservice_tpu/rollout/store.py",
+    "detectmateservice_tpu/utils/checkpoint.py",
+    "detectmateservice_tpu/utils/atomicio.py",
+)
+
+# WAL append paths get the unbuffered-handle rule on top
+_WAL_PATHS = ("detectmateservice_tpu/wal/",)
+
+_TEMP_MARKERS = ("tmp", "temp", "nonce", "partial", "devnull")
+
+
+def is_persistence_path(rel: str) -> bool:
+    return any(rel.startswith(p) for p in PERSISTENCE_PATHS)
+
+
+def _expr_text(node: ast.AST) -> str:
+    """Best-effort source-ish rendering of a path expression for the
+    temp-name heuristic (names, attributes, f-string literal parts,
+    string constants)."""
+    parts: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            parts.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            parts.append(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            parts.append(sub.value)
+    return " ".join(parts).lower()
+
+
+def _looks_temp(node: ast.AST) -> bool:
+    text = _expr_text(node)
+    return any(marker in text for marker in _TEMP_MARKERS)
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    if len(call.args) >= 2:
+        mode = call.args[1]
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if call.args or call.keywords:
+        return "r"      # open() defaults to read when the mode is omitted
+    return None
+
+
+def _buffering_zero(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "buffering":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value == 0)
+    if len(call.args) >= 3:
+        arg = call.args[2]
+        return isinstance(arg, ast.Constant) and arg.value == 0
+    return False
+
+
+def _enclosing_functions(tree: ast.Module) -> Iterator[Any]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def check_module(rel: str, source: str,
+                 tree: Optional[ast.Module] = None,
+                 pragmas: Optional[PragmaIndex] = None) -> List[Finding]:
+    """Run the DM-D rules over one persistence module (no-op for files
+    outside :data:`PERSISTENCE_PATHS` — the CLI calls this on every file)."""
+    if not is_persistence_path(rel):
+        return []
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # DM-B005 owns unparseable files
+    if pragmas is None:
+        pragmas = scan_pragmas(source)
+
+    findings: List[Finding] = []
+    wal_scope = any(rel.startswith(p) for p in _WAL_PATHS)
+
+    # map each call to its enclosing function (for the fsync requirement
+    # and the commit-pattern exemption)
+    enclosing: dict = {}
+    func_calls: dict = {}
+    for func in _enclosing_functions(tree):
+        names = set()
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Call):
+                enclosing.setdefault(id(sub), func)
+                names.add(_call_name(sub.func))
+        func_calls.setdefault(func.name, set()).update(names)
+
+    def _fn_of(call: ast.Call) -> Optional[Any]:
+        return enclosing.get(id(call))
+
+    def _fn_calls(call: ast.Call) -> set:
+        func = _fn_of(call)
+        if func is None:          # module level: look at the whole module
+            return {_call_name(c.func) for c in ast.walk(tree)
+                    if isinstance(c, ast.Call)}
+        return func_calls.get(func.name, set())
+
+    def _has_fsync(names: set) -> bool:
+        return any("fsync" in name.rsplit(".", 1)[-1] for name in names)
+
+    def _has_commit_rename(names: set) -> bool:
+        return any(name.rsplit(".", 1)[-1] in ("replace", "rename")
+                   for name in names)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        tail = name.rsplit(".", 1)[-1]
+
+        # -- DM-D001: bare final-path writes ------------------------------
+        if name == "json.dump":
+            if not pragmas.is_ignored("DM-D001", node.lineno):
+                findings.append(Finding(
+                    "DM-D001", rel, node.lineno,
+                    "bare json.dump to a file handle in a persistence "
+                    "module (not crash-atomic: a crash mid-write leaves a "
+                    "torn document at the final path)",
+                    hint="use utils.atomicio.write_json_atomic (temp + "
+                         "fsync + os.replace + dir fsync)",
+                    key=f"json.dump:L{node.lineno}"))
+        elif tail in ("write_text", "write_bytes") \
+                and isinstance(node.func, ast.Attribute):
+            target = node.func.value
+            if not _looks_temp(target) \
+                    and not pragmas.is_ignored("DM-D001", node.lineno):
+                findings.append(Finding(
+                    "DM-D001", rel, node.lineno,
+                    f"bare Path.{tail} to a non-temp path in a persistence "
+                    "module (not crash-atomic)",
+                    hint="write through write_json_atomic, or write a temp "
+                         "sibling and commit with an fsync'd rename",
+                    key=f"{tail}:L{node.lineno}"))
+        elif name == "open" or name.endswith(".open"):
+            mode = _open_mode(node)
+            if mode is None:
+                continue
+            writing = "w" in mode
+            appending = "a" in mode
+            if writing and node.args:
+                path_arg = node.args[0]
+                names = _fn_calls(node)
+                committed = (_has_commit_rename(names)
+                             and _has_fsync(names))
+                if not _looks_temp(path_arg) and not committed \
+                        and not pragmas.is_ignored("DM-D001", node.lineno):
+                    findings.append(Finding(
+                        "DM-D001", rel, node.lineno,
+                        f"open(..., {mode!r}) writes a non-temp final path "
+                        "in a persistence module with no fsync'd-rename "
+                        "commit in the same function",
+                        hint="write a temp/nonce sibling, fsync it, then "
+                             "os.replace onto the final name (or use "
+                             "write_json_atomic)",
+                        key=f"open-w:L{node.lineno}"))
+            # -- DM-D003: buffered WAL appends ----------------------------
+            if appending and wal_scope and not _buffering_zero(node) \
+                    and not pragmas.is_ignored("DM-D003", node.lineno):
+                findings.append(Finding(
+                    "DM-D003", rel, node.lineno,
+                    f"buffered append handle open(..., {mode!r}) on a WAL "
+                    "segment path (a kill -9 loses the Python file "
+                    "buffer's entire content)",
+                    hint="open append handles with buffering=0 so every "
+                         "write() reaches the kernel",
+                    key=f"open-a:L{node.lineno}"))
+
+        # -- DM-D002: rename with no fsync --------------------------------
+        elif name in ("os.rename", "os.replace"):
+            names = _fn_calls(node)
+            if not _has_fsync(names - {name}) \
+                    and not pragmas.is_ignored("DM-D002", node.lineno):
+                func = _fn_of(node)
+                where = f"{func.name}()" if func is not None else "<module>"
+                findings.append(Finding(
+                    "DM-D002", rel, node.lineno,
+                    f"{name} in {where} with no fsync of the file before "
+                    "or the directory after (atomic but NOT durable: a "
+                    "power loss can undo the committed rename)",
+                    hint="fsync the temp file before the rename and "
+                         "fsync_dir(parent) after it",
+                    key=f"rename:{where}"))
+    return findings

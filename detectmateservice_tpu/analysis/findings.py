@@ -17,6 +17,12 @@ Pragmas (one comment grammar for all analyzers):
   treats the declaration exactly like an inferred guard.
 * ``# dmlint: hot-loop`` — mark the loop starting on this (or the next)
   line for the hot-loop purity rules.
+* ``# dmlint: thread(<domain>)`` — declare, on (or above) a ``def`` or an
+  ``__init__`` attribute assignment, the thread-affinity domain that owns
+  the method/attribute (``engine``, ``supervisor``, ``admin``,
+  ``watchdog``, ``rollout``, ``loadgen``, or ``any``). The affinity
+  analyzer (DM-A) checks calls and shared state against these
+  declarations; ``utils/threadcheck.assert_affinity`` is the runtime twin.
 
 Baseline (``dmlint-baseline.json`` at the repo root): a checked-in list of
 ``{"fingerprint", "rule", "justification"}`` entries. Every entry MUST carry
@@ -38,6 +44,7 @@ BASELINE_NAME = "dmlint-baseline.json"
 _PRAGMA_RE = re.compile(r"#\s*dmlint:\s*(?P<body>.+?)\s*$")
 _IGNORE_RE = re.compile(r"ignore\[(?P<rules>[A-Za-z0-9_,\-\s]+)\]\s*(?P<why>.*)")
 _GUARDED_RE = re.compile(r"guarded-by\((?P<lock>[A-Za-z_][A-Za-z0-9_.]*)\)")
+_THREAD_RE = re.compile(r"thread\((?P<domain>[a-z_][a-z0-9_]*)\)")
 
 
 @dataclass(frozen=True)
@@ -77,6 +84,7 @@ class PragmaIndex:
     ignores: Dict[int, Tuple[Set[str], str]] = field(default_factory=dict)
     guarded_by: Dict[int, str] = field(default_factory=dict)   # line -> lock name
     hot_loops: Set[int] = field(default_factory=set)           # marker lines
+    threads: Dict[int, str] = field(default_factory=dict)      # line -> domain
     bare_ignores: List[int] = field(default_factory=list)      # no justification
 
     # an `ignore` pragma covers the line it sits on and the line below it
@@ -90,6 +98,11 @@ class PragmaIndex:
 
     def marks_hot_loop(self, line: int) -> bool:
         return line in self.hot_loops or (line - 1) in self.hot_loops
+
+    # a `thread(...)` pragma sits on the declaration line or its own line
+    # just above (same convention as `ignore` / `guarded-by`)
+    def thread_domain(self, line: int) -> Optional[str]:
+        return self.threads.get(line) or self.threads.get(line - 1)
 
 
 def scan_pragmas(source: str) -> PragmaIndex:
@@ -111,6 +124,10 @@ def scan_pragmas(source: str) -> PragmaIndex:
         guarded = _GUARDED_RE.match(body)
         if guarded is not None:
             index.guarded_by[lineno] = guarded.group("lock")
+            continue
+        thread = _THREAD_RE.match(body)
+        if thread is not None:
+            index.threads[lineno] = thread.group("domain")
             continue
         if body.strip() == "hot-loop":
             index.hot_loops.add(lineno)

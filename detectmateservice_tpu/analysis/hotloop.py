@@ -26,7 +26,7 @@ and nested function definitions are skipped (they execute elsewhere).
 from __future__ import annotations
 
 import ast
-from typing import List, Optional
+from typing import Iterator, List, Optional
 
 from .findings import Finding, PragmaIndex
 from .locks import _call_name, _looks_like_thread_join
@@ -34,7 +34,8 @@ from .locks import _call_name, _looks_like_thread_join
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Enum", "Summary", "Info"}
 
 
-def _iter_hot_loops(tree: ast.AST, pragmas: PragmaIndex):
+def _iter_hot_loops(tree: ast.AST,
+                    pragmas: PragmaIndex) -> Iterator[ast.AST]:
     for node in ast.walk(tree):
         if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
             if pragmas.marks_hot_loop(node.lineno):

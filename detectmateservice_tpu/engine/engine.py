@@ -397,6 +397,9 @@ class Engine:
                 self.logger.error("cannot dial output %s: %s (continuing)", addr, exc)
 
     # -- lifecycle ------------------------------------------------------
+    # admin/main lifecycle verbs; start() spawns the engine thread,
+    # stop() joins it before any teardown touches its state
+    # dmlint: thread(any)
     def start(self) -> str:
         """Start (or restart) the engine loop thread; returns a status string.
 
@@ -435,6 +438,7 @@ class Engine:
         self.logger.info("engine started")
         return "engine started"
 
+    # dmlint: thread(any) — joins the engine thread before teardown
     def stop(self) -> None:
         if not self._running and self._thread is None:
             self._close_all()
@@ -665,6 +669,9 @@ class Engine:
         if saved_timeout is not None:
             self._pair_sock.recv_timeout = saved_timeout
 
+    # THE engine thread entry point: every replica socket, spool
+    # append/ack/tick, and output send descends from here
+    # dmlint: thread(engine)
     def _run_loop(self) -> None:
         read_b = m.DATA_READ_BYTES().labels(**self._labels)
         read_l = m.DATA_READ_LINES().labels(**self._labels)

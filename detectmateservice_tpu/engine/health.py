@@ -55,6 +55,33 @@ _SEVERITY = {PASS: 0, DEGRADED: 1, UNHEALTHY: 2}
 
 HEALTHY = "healthy"  # roll-up name for "every check passes"
 
+# The canonical registry of structured-event ``kind``s — every
+# ``emit_event`` payload's kind MUST be declared here. dmlint's DM-E rules
+# (analysis/contracts.py) parse this table and hold it in both directions
+# against the literal kinds at the emit sites, the event-kind reference in
+# docs/prometheus.md, and the kinds scripts/soak.py scenarios gate on — an
+# event renamed at its emit site but not here (or vice versa) fails the
+# gate instead of silently breaking a soak scenario's verdict.
+# tests/test_health.py derives its known-kind set from this registry, the
+# same pattern test_observability.py uses for REGISTERED_SERIES.
+EVENT_KINDS = {
+    "health_transition": "a watchdog check (or the roll-up state) changed",
+    "log": "a WARNING+ log record mirrored into the event ring",
+    "thread_exception": "an uncaught exception in any thread",
+    "unexpected_recompile": "XLA compiled a bucket believed warm",
+    "replica_drain": "router stopped dispatching to a failing replica",
+    "replica_drained": "a draining replica settled (clean or by timeout)",
+    "replica_recovering": "a drained replica's probe recovered; re-dialing",
+    "replica_restarted": "a replica process restart observed between polls",
+    "replica_undrain": "a recovered replica resumed dispatch",
+    "model_candidate_ready": "a rollout cycle produced a shadow candidate",
+    "model_promoted": "a candidate passed the gate and was hot-swapped in",
+    "model_rolled_back": "the previous live model version was restored",
+    "model_canary_holdback": "the shadow gate rejected a candidate",
+    "model_pinned": "an operator pinned the served model version",
+    "model_unpinned": "an operator lifted the model pin",
+}
+
 
 class Heartbeat:
     """A loop's liveness stamp. ``beat()`` is the whole hot-path cost: one
@@ -380,6 +407,8 @@ class HealthMonitor:
             report = self._last_report
         return report or self.evaluate()
 
+    # safe from any thread (admin ?deep=1, watchdog, tests): every
+    # dmlint: thread(any) — mutation below runs under self._lock
     def evaluate(self) -> Dict[str, Any]:
         """Run every check once, apply hysteresis, update the metrics, emit
         transition events, and return the full report."""
@@ -446,6 +475,7 @@ class HealthMonitor:
         self._effective[name] = status
         return status, detail
 
+    # dmlint: thread(any) — takes no monitor lock (see docstring)
     def emit_event(self, event: Dict[str, Any],
                    level: int = logging.WARNING) -> Dict[str, Any]:
         """Public seam for subsystems (e.g. the device-observability compile
@@ -496,6 +526,7 @@ class HealthMonitor:
                              extra={"dm_event": event})
 
     # -- watchdog thread -------------------------------------------------
+    # dmlint: thread(any)
     def start(self, interval_s: Optional[float] = None) -> None:
         if interval_s is not None:
             self._interval_s = interval_s
@@ -513,6 +544,7 @@ class HealthMonitor:
             thread.join(timeout=2.0)
         self._thread = None
 
+    # dmlint: thread(watchdog)
     def _run(self) -> None:
         # dmlint: hot-loop
         while not self._stop.wait(self._interval_s):

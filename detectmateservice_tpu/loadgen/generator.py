@@ -219,6 +219,7 @@ class LoadGenerator:
         }
 
     # -- sender ----------------------------------------------------------
+    # dmlint: thread(loadgen)
     def _sender_loop(self) -> None:
         profile = self.profile
         try:
@@ -294,6 +295,7 @@ class LoadGenerator:
                 self.logger.warning("loadgen warmup send failed: %s", exc)
 
     # -- collector -------------------------------------------------------
+    # dmlint: thread(loadgen)
     def _collector_loop(self) -> None:
         while not self._stop.is_set():
             if self.collector_pause.is_set():

@@ -178,6 +178,7 @@ class RolloutManager:
         with self._lock:
             return self._shadow
 
+    # dmlint: thread(rollout)
     def _run(self) -> None:
         interval = max(0.05, float(self.settings.rollout_interval_s))
         tick = min(1.0, interval / 4)
@@ -413,7 +414,11 @@ class RolloutManager:
         result = "promoted" if action == "promote" else "rolled_back"
         self._count_swap(result)
         self._set_version_info(version)
-        self._note(f"model_{result}", level=logging.INFO, version=version,
+        # literal kinds (not f"model_{result}") so the DM-E event-contract
+        # analyzer can extract both from the AST
+        self._note("model_promoted" if action == "promote"
+                   else "model_rolled_back",
+                   level=logging.INFO, version=version,
                    action=action, swap=swap)
         outcome = {"result": result, "version": version, "swap": swap}
         with self._lock:

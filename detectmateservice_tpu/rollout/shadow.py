@@ -21,7 +21,7 @@ tests/test_rollout.py).
 """
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
@@ -46,7 +46,8 @@ class ShadowEvaluator:
         self._top: list = []        # (|delta|, row_id, live, cand) desc
 
     def observe(self, live_scores: np.ndarray,
-                cand_scores: np.ndarray, row_ids=None) -> np.ndarray:
+                cand_scores: np.ndarray,
+                row_ids: Optional[Sequence[int]] = None) -> np.ndarray:
         """Account one shadow batch; returns the per-row ``|delta|`` array
         so the caller can feed the ``model_shadow_divergence`` histogram.
         ``row_ids`` (optional, aligned) labels rows in the worst-offender

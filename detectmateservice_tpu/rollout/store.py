@@ -29,7 +29,7 @@ import shutil
 import threading
 import time
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.checkpoint import write_json_atomic
 
@@ -43,7 +43,7 @@ class StoreError(RuntimeError):
 
 class CheckpointStore:
     def __init__(self, root: str, keep: int = 4,
-                 clock=time.time) -> None:
+                 clock: Callable[[], float] = time.time) -> None:
         if keep < 1:
             raise ValueError(f"keep must be >= 1 (got {keep})")
         self.root = Path(root).absolute()

@@ -25,7 +25,7 @@ policies hold no locks and allocate nothing beyond what the choice needs.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 
 class RoundRobinPolicy:
@@ -34,7 +34,7 @@ class RoundRobinPolicy:
     def __init__(self) -> None:
         self._next = 0
 
-    def pick(self, replicas: Sequence, trace_id: Optional[int]):
+    def pick(self, replicas: Sequence, trace_id: Optional[int]) -> Optional[Any]:
         if not replicas:
             return None
         choice = replicas[self._next % len(replicas)]
@@ -48,7 +48,7 @@ class LeastBacklogPolicy:
     def __init__(self) -> None:
         self._next = 0
 
-    def pick(self, replicas: Sequence, trace_id: Optional[int]):
+    def pick(self, replicas: Sequence, trace_id: Optional[int]) -> Optional[Any]:
         if not replicas:
             return None
         # rotating start index breaks ties fairly without a second pass
@@ -79,7 +79,7 @@ class StickyTracePolicy:
         # untraced frames (no v2 header) cannot stick — rotate them
         self._fallback = RoundRobinPolicy()
 
-    def pick(self, replicas: Sequence, trace_id: Optional[int]):
+    def pick(self, replicas: Sequence, trace_id: Optional[int]) -> Optional[Any]:
         if not replicas:
             return None
         if trace_id is None:
@@ -102,7 +102,7 @@ _POLICIES = {
 POLICY_NAMES: List[str] = sorted(_POLICIES)
 
 
-def make_policy(name: str):
+def make_policy(name: str) -> Any:
     try:
         return _POLICIES[name]()
     except KeyError:

@@ -31,12 +31,13 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Any, Callable, List, Optional
 
 from ..engine import metrics as m
 from ..engine.framing import peek_trace_id
 from ..engine.socket import TransportAgain, TransportError
 from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
+from ..utils.threadcheck import assert_affinity
 from .balancer import StickyTracePolicy, make_policy
 from .supervisor import (
     RECOVERY_POLLS,
@@ -57,10 +58,10 @@ class ReplicaRouter:
     def __init__(
         self,
         settings: ServiceSettings,
-        factory,
+        factory: Any,
         logger: Optional[logging.Logger] = None,
         labels: Optional[dict] = None,
-        monitor=None,
+        monitor: Optional[Any] = None,
         probe: Optional[Callable[[Replica], ProbeResult]] = None,
         abort_check: Optional[Callable[[], bool]] = None,
     ) -> None:
@@ -113,7 +114,7 @@ class ReplicaRouter:
             self._drain_timeout_s,
             "on" if self._supervisor is not None else "send-failure only")
 
-    def _dial(self, addr: str):
+    def _dial(self, addr: str) -> Any:
         is_tls = addr.startswith(TLS_SCHEME_PREFIXES)
         return self._factory.create_output(
             addr, self.logger,
@@ -121,12 +122,14 @@ class ReplicaRouter:
             dial_timeout=self.settings.out_dial_timeout,
             buffer_size=self.settings.engine_buffer_size)
 
-    # -- engine-thread API -----------------------------------------------
+    # -- engine-thread API (machine-checked: # dmlint: thread pragmas) ----
+    # dmlint: thread(engine)
     def dispatch(self, wire: bytes, lines: int) -> bool:
         """Deliver one wire frame to one replica. True when it left the
         process; False when it had to be dropped (no dispatchable replica
         within the backpressure budget). Runs on the engine hot path: one
         lock acquire per pick, sends outside the lock."""
+        assert_affinity("engine")
         trace_id = peek_trace_id(wire) if self._sticky else None
         retries = 0
         tried: set = set()
@@ -177,6 +180,7 @@ class ReplicaRouter:
                     self._requeue.append((lines, wire))
             return True
 
+    # dmlint: thread(any) — one lock acquire + two scans, no socket
     def unacked_total(self) -> int:
         """Frames dispatched but not yet watermark-settled, plus requeued
         frames awaiting redelivery. The durable-ingress spool gates its ack
@@ -186,11 +190,13 @@ class ReplicaRouter:
             return (sum(len(r.window) for r in self.replicas)
                     + len(self._requeue))
 
+    # dmlint: thread(engine)
     def tick(self) -> None:
         """Deferred engine-thread work: re-dial recovered replicas, enforce
         drain deadlines when no supervisor polls, redeliver requeued
         frames. Called once per engine loop iteration — the no-work path is
         one lock acquire and three cheap scans."""
+        assert_affinity("engine")
         with self._lock:
             redials = [r for r in self.replicas if r.needs_redial]
             work = bool(self._requeue) or bool(redials) or any(
@@ -266,6 +272,8 @@ class ReplicaRouter:
                     # still land: at-least-once tolerates the duplicate)
                     self._requeue.append((lines, wire))
 
+    # teardown runs on the stopping thread after the engine thread is
+    # dmlint: thread(any) — joined (the join is the happens-before edge)
     def close(self) -> None:
         if self._supervisor is not None:
             self._supervisor.stop()
@@ -279,6 +287,9 @@ class ReplicaRouter:
                     pass
 
     # -- supervision inputs (supervisor thread / engine thread) ----------
+    # state machine under the lock, no socket ops; designed to run from
+    # the supervisor poll, the engine tick, and tests
+    # dmlint: thread(any)
     def apply_probe(self, replica: Replica, result: ProbeResult) -> None:
         events: list = []
         with self._lock:
@@ -357,6 +368,7 @@ class ReplicaRouter:
                                             f"{result.detail}")
         self._emit(events)
 
+    # dmlint: thread(any) — same contract as apply_probe
     def process_drains(self, now: Optional[float] = None) -> None:
         """Settle or expire draining replicas: an emptied window is a clean
         drain; a window still unacked at the deadline moves to the requeue
@@ -405,6 +417,7 @@ class ReplicaRouter:
             drain_timeout_s=self._drain_timeout_s))
 
     # -- admin-plane API --------------------------------------------------
+    # dmlint: thread(admin)
     def drain(self, addr: str) -> dict:
         """Operator drain: stop dispatching to ``addr`` now; in-flight
         frames settle (or requeue at the deadline) exactly like a
@@ -421,6 +434,7 @@ class ReplicaRouter:
         with self._lock:
             return replica.snapshot()
 
+    # dmlint: thread(admin)
     def undrain(self, addr: str) -> dict:
         replica = self._find(addr)
         events: list = []
@@ -444,6 +458,7 @@ class ReplicaRouter:
         with self._lock:
             return replica.snapshot()
 
+    # dmlint: thread(any) — reads under the lock only
     def snapshot(self) -> dict:
         with self._lock:
             replicas = [r.snapshot() for r in self.replicas]
@@ -468,7 +483,7 @@ class ReplicaRouter:
                          f"{[r.addr for r in self.replicas]}")
 
     # -- events ------------------------------------------------------------
-    def _event(self, kind: str, replica: Replica, **extra) -> dict:
+    def _event(self, kind: str, replica: Replica, **extra: Any) -> dict:
         doc = {"kind": kind, "replica": replica.addr,
                "state": STATE_NAMES[replica.state]}
         doc.update(extra)

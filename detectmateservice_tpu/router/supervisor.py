@@ -52,9 +52,12 @@ import urllib.error
 import urllib.request
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Any, Callable, List, Optional, Tuple
 
 from ..engine import metrics as m
+
+if TYPE_CHECKING:  # the annotation types the seam for mypy AND dmlint's
+    from .router import ReplicaRouter  # affinity receiver inference
 
 STATE_DRAINED = 0
 STATE_DRAINING = 1
@@ -103,7 +106,8 @@ class Replica:
         self.addr = addr
         self.admin_url = admin_url.rstrip("/") if admin_url else None
         self.id_hash = _fnv64(addr)          # rendezvous-hash identity
-        self.sock = None                     # engine thread only
+        # dmlint: thread(engine)
+        self.sock = None
         self.state = STATE_ACTIVE
         self.state_detail = "never probed"
         self.backlog = 0.0
@@ -172,7 +176,7 @@ class Replica:
             self.window_head_lines += lines
         self._m_inflight.set(len(self.window))
 
-    def note_restart(self):
+    def note_restart(self) -> List[Tuple[int, bytes]]:
         """The probe observed a process restart (start-time change): every
         in-flight frame is gone with the old process, and the read counter
         restarted — possibly already past the old baseline, which is why
@@ -183,7 +187,7 @@ class Replica:
         self.read_base = None
         return taken
 
-    def take_window(self):
+    def take_window(self) -> List[Tuple[int, bytes]]:
         """Move every unacked frame out (drain timeout): the caller
         redelivers them to healthy peers."""
         taken = list(self.window)
@@ -260,11 +264,12 @@ class HttpProbe:
                            started_unix=(float(started)
                                          if started is not None else None))
 
-    def _get_json(self, url: str):
+    def _get_json(self, url: str) -> Any:
         with urllib.request.urlopen(url, timeout=self._timeout) as resp:
             return json.loads(resp.read())
 
-    def _watermark(self, replica: Replica, cid: Optional[str]):
+    def _watermark(self, replica: Replica, cid: Optional[str]
+                   ) -> Tuple[Optional[float], Optional[float]]:
         if not cid:
             return None, None
         try:
@@ -291,7 +296,7 @@ class ReplicaSupervisor(threading.Thread):
     probe that raises is itself an ``unreachable`` verdict — the supervisor
     must outlive a misbehaving replica admin plane."""
 
-    def __init__(self, router, interval_s: float,
+    def __init__(self, router: "ReplicaRouter", interval_s: float,
                  probe: Optional[Callable[[Replica], ProbeResult]] = None,
                  logger: Optional[logging.Logger] = None) -> None:
         super().__init__(name="ReplicaSupervisor", daemon=True)
@@ -301,6 +306,9 @@ class ReplicaSupervisor(threading.Thread):
         self._logger = logger or logging.getLogger("router.supervisor")
         self._halt = threading.Event()
 
+    # blocking HTTP + state handoffs only; this supervision thread NEVER
+    # touches a socket (DM-A003 enforces it)
+    # dmlint: thread(supervisor)
     def poll_once(self) -> None:
         for replica in self._router.replicas:
             try:
@@ -310,6 +318,7 @@ class ReplicaSupervisor(threading.Thread):
             self._router.apply_probe(replica, result)
         self._router.process_drains()
 
+    # dmlint: thread(supervisor)
     def run(self) -> None:
         # dmlint: hot-loop
         while not self._halt.wait(self._interval):

@@ -1,0 +1,110 @@
+"""Runtime twin of the DM-A static thread-affinity analyzer.
+
+The static analyzer proves what it can from the AST; this module audits the
+same contract dynamically: a seam declared ``# dmlint: thread(engine)``
+also calls :func:`assert_affinity` (``"engine"``), which — **only** when
+``DM_THREADCHECK=1`` (tests arm it in ``tests/conftest.py``) — verifies the
+calling thread actually belongs to that domain. Disarmed, the whole cost is
+one module-global bool check, cheap enough for the engine hot path.
+
+A thread's domain comes from, in order:
+
+* an explicit :func:`bind_thread` call (the loop entry points bind
+  themselves — the authoritative source), or
+* its ``threading.Thread`` name via :data:`NAME_DOMAINS` (``EngineLoop`` →
+  ``engine``, ``ReplicaSupervisor`` → ``supervisor``, …), so the
+  production thread topology is covered with zero per-loop code.
+
+A thread with **no** domain (pytest's MainThread, an ad-hoc helper) passes
+every assert: the contract constrains the known production threads, not
+test harnesses driving seams directly — that is what keeps the whole suite
+green under ``DM_THREADCHECK=1`` while a supervisor thread calling an
+engine-owned spool method still trips the assert immediately.
+
+Dependency-free on purpose (the WAL spool imports this inside non-jax
+parser stages).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+__all__ = ["ThreadAffinityError", "assert_affinity", "bind_thread",
+           "unbind_thread", "current_domain", "arm", "armed"]
+
+# thread-name prefix → domain: the production topology's spawned threads
+NAME_DOMAINS = {
+    "EngineLoop": "engine",
+    "ReplicaSupervisor": "supervisor",
+    "HealthWatchdog": "watchdog",
+    "ModelRollout": "rollout",
+    "loadgen-sender": "loadgen",
+    "loadgen-collector": "loadgen",
+    "WebServerThread": "admin",
+}
+
+_ARMED = os.environ.get("DM_THREADCHECK", "") == "1"
+_LOCK = threading.Lock()
+_BINDINGS: Dict[int, str] = {}      # thread ident → bound domain
+
+
+class ThreadAffinityError(AssertionError):
+    """A thread crossed a declared affinity seam (only ever raised while
+    armed — production runs never pay or see this)."""
+
+
+def arm(enabled: bool = True) -> None:
+    """Programmatic arm/disarm (tests use this; production uses the env)."""
+    global _ARMED
+    _ARMED = enabled
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def bind_thread(domain: str, ident: Optional[int] = None) -> None:
+    """Declare the current (or given) thread a member of ``domain`` —
+    authoritative over the name map. No-op overhead concerns: binding
+    happens once per thread lifetime, not per iteration."""
+    key = ident if ident is not None else threading.get_ident()
+    with _LOCK:
+        _BINDINGS[key] = domain
+
+
+def unbind_thread(ident: Optional[int] = None) -> None:
+    key = ident if ident is not None else threading.get_ident()
+    with _LOCK:
+        _BINDINGS.pop(key, None)
+
+
+def current_domain() -> Optional[str]:
+    """The calling thread's domain: explicit binding first, then the
+    thread-name map, else None (unclassified — passes every assert)."""
+    ident = threading.get_ident()
+    with _LOCK:
+        bound = _BINDINGS.get(ident)
+    if bound is not None:
+        return bound
+    name = threading.current_thread().name
+    for prefix, domain in NAME_DOMAINS.items():
+        if name.startswith(prefix):
+            return domain
+    return None
+
+
+def assert_affinity(domain: str) -> None:
+    """Assert the calling thread belongs to ``domain``. A no-op unless
+    armed (``DM_THREADCHECK=1`` or :func:`arm`); unclassified threads and
+    ``"any"`` seams always pass."""
+    if not _ARMED or domain == "any":
+        return
+    actual = current_domain()
+    if actual is None or actual == domain:
+        return
+    raise ThreadAffinityError(
+        f"thread {threading.current_thread().name!r} (domain {actual}) "
+        f"crossed a seam owned by the {domain} thread — the static "
+        "declaration (# dmlint: thread(...)) and the runtime are out of "
+        "agreement")

@@ -30,7 +30,7 @@ import logging
 import threading
 import time
 from pathlib import Path
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..engine.framing import FramingError, unpack_batch, unwrap_trace, wrap_trace
 from .segment import read_spool
@@ -70,7 +70,8 @@ class ReplayDriver:
 
     # -- output accounting ----------------------------------------------
     @staticmethod
-    def _fold(digest, trace_id: int, payload: bytes) -> None:
+    def _fold(digest: "hashlib._Hash", trace_id: int,
+              payload: bytes) -> None:
         digest.update(trace_id.to_bytes(8, "big"))
         digest.update((len(payload) & _U32).to_bytes(4, "big"))
         digest.update(payload)
@@ -86,7 +87,7 @@ class ReplayDriver:
         ctx_fifo: List = []
         frames = messages = outputs = trace_errors = 0
 
-        def emit(outs) -> None:
+        def emit(outs: Sequence[Optional[bytes]]) -> None:
             nonlocal outputs
             for out in outs:
                 if out is None:

@@ -47,6 +47,7 @@ from pathlib import Path
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..utils.atomicio import fsync_dir, write_json_atomic
+from ..utils.threadcheck import assert_affinity
 from .segment import (
     Record,
     WalError,
@@ -223,10 +224,12 @@ class IngressSpool:
         # the whole burst's appends out of the Python file buffer).
         self._fh = open(self._active.path, "ab", buffering=0)
 
-    # -- write path (engine thread only) --------------------------------
+    # -- write path (machine-checked: engine thread only) ----------------
+    # dmlint: thread(engine)
     def append(self, frame: bytes) -> int:
         """Durably (after the next fsync tick) record one ingress frame;
         returns its sequence number."""
+        assert_affinity("engine")
         if self._closed:
             raise WalError("append on a closed spool")
         seq = self._last_appended + 1
@@ -246,11 +249,13 @@ class IngressSpool:
             self._fsync()
         return seq
 
+    # dmlint: thread(engine)
     def ack(self, seq: int) -> None:
         """Advance the ack watermark: every record with ``seq`` at or below
         it has been handed downstream and will not replay after a clean
         restart (a crash may still replay the acks not yet committed to the
         manifest — once per crash, the at-least-once bound)."""
+        assert_affinity("engine")
         if seq <= self._acked:
             return
         self._acked = min(seq, self._last_appended)
@@ -287,11 +292,13 @@ class IngressSpool:
         if self._fsync_observer is not None:
             self._fsync_observer(self._last_fsync - t0)
 
+    # dmlint: thread(engine)
     def tick(self, force: bool = False) -> None:
         """One batched-durability step: fsync when the interval elapsed (or
         ``force``), commit the manifest when the ack watermark or segment
         set moved, apply retention. Called once per engine loop iteration —
         the no-work case is two int compares."""
+        assert_affinity("engine")
         now = time.monotonic()
         if self._dirty_bytes and (
                 force or now - self._last_fsync >= self.fsync_interval_s):
@@ -337,6 +344,8 @@ class IngressSpool:
             self._segments.pop(0)
             self._manifest_dirty = True
 
+    # runs on the stopping thread, AFTER the engine thread is joined
+    # dmlint: thread(any) — the join is the happens-before edge
     def close(self) -> None:
         """Clean shutdown: final fsync + manifest commit (so a clean
         restart replays nothing), then release the handle."""
@@ -349,6 +358,7 @@ class IngressSpool:
             self._fh = None
 
     # -- recovery / observability ---------------------------------------
+    # dmlint: thread(engine)
     def recover_unacked(self) -> List[Tuple[int, bytes]]:
         """The unacked suffix, oldest first — what the engine must replay
         through the pipeline before accepting new traffic after a
@@ -380,6 +390,8 @@ class IngressSpool:
             return 0.0
         return max(0.0, self._clock() - t)
 
+    # lock-free single-int/tuple reads by design (scrape threads via
+    # dmlint: thread(any) — Gauge.set_function, like the gauge methods
     def stats(self) -> Dict:
         return {
             "directory": str(self.directory),

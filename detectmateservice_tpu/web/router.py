@@ -6,7 +6,11 @@ truth for the admin API surface. dmlint's cross-artifact contract DM-C007/8
 (analysis/contracts.py) parses the ``Route(...)`` declarations below and
 holds them in sync with the route table in ``docs/usage.md`` in both
 directions: an undocumented route and a documented-but-phantom route both
-fail the gate.
+fail the gate. The thread-affinity analyzer (DM-A) also parses this table:
+every handler named in ROUTES is an ``admin``-domain thread entry point,
+so a handler reaching an engine-owned seam (a replica socket, the WAL
+spool write path) is a build-breaking finding — the state-mutating POST
+handlers additionally carry explicit ``# dmlint: thread(admin)`` pragmas.
 
 Handlers take ``(service, query, payload)`` — ``query`` is the parsed query
 string (``parse_qs`` shape), ``payload`` the decoded JSON body (``{}`` for
@@ -181,21 +185,25 @@ def _profile_latest(service, query, payload) -> Response:
 
 
 # -- POST handlers ----------------------------------------------------------
+# dmlint: thread(admin)
 def _start(service, query, payload) -> Response:
     return Response(200, {"detail": service.start()})
 
 
+# dmlint: thread(admin)
 def _stop(service, query, payload) -> Response:
     service.stop()
     return Response(200, {"detail": "engine stopped"})
 
 
+# dmlint: thread(admin)
 def _shutdown(service, query, payload) -> Response:
     # the reply must leave before run() unparks and tears the server down
     return Response(200, {"detail": "service shutting down"},
                     after=service.shutdown)
 
 
+# dmlint: thread(admin)
 def _reconfigure(service, query, payload) -> Response:
     config = (payload or {}).get("config") or {}
     persist = bool((payload or {}).get("persist", False))
@@ -203,10 +211,12 @@ def _reconfigure(service, query, payload) -> Response:
     return Response(200, {"detail": "reconfigured", "config": updated})
 
 
+# dmlint: thread(admin)
 def _checkpoint(service, query, payload) -> Response:
     return Response(200, service.checkpoint())
 
 
+# dmlint: thread(admin)
 def _profile_start(service, query, payload) -> Response:
     from ..utils.profiling import PROFILER, ProfileBusyError
 
@@ -228,6 +238,7 @@ def _profile_start(service, query, payload) -> Response:
     return Response(200, info)
 
 
+# dmlint: thread(admin)
 def _load_control(service, query, payload) -> Response:
     from ..loadgen.generator import (
         LOADGEN,
@@ -255,6 +266,7 @@ def _load_control(service, query, payload) -> Response:
         return Response(409, {"detail": str(exc)})
 
 
+# dmlint: thread(admin)
 def _model_control(service, query, payload) -> Response:
     from ..rollout import RolloutError, StoreError
 
@@ -291,6 +303,7 @@ def _model_control(service, query, payload) -> Response:
                      "'rollback', 'pin', 'unpin', or 'cycle')")
 
 
+# dmlint: thread(admin)
 def _replay_control(service, query, payload) -> Response:
     from ..wal.replay import ReplayBusyError, ReplayError, start_service_replay
 
@@ -305,6 +318,7 @@ def _replay_control(service, query, payload) -> Response:
         return Response(409, {"detail": str(exc)})
 
 
+# dmlint: thread(admin)
 def _replicas_control(service, query, payload) -> Response:
     router = getattr(service.engine, "router", None)
     if router is None:

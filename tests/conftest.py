@@ -6,6 +6,13 @@ the env vars must be set before jax is first imported anywhere.
 """
 import os
 
+# arm the runtime thread-affinity asserts (utils/threadcheck) for every
+# test run: a production thread crossing a `# dmlint: thread(...)` seam
+# fails loudly here instead of racing silently in the field. Must be set
+# before any package module imports threadcheck. An explicit DM_THREADCHECK
+# value from the environment (e.g. =0 to bisect) wins.
+os.environ.setdefault("DM_THREADCHECK", "1")
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

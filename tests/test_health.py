@@ -21,6 +21,7 @@ import pytest
 
 from detectmateservice_tpu.core import Service
 from detectmateservice_tpu.engine.health import (
+    EVENT_KINDS,
     EventLog,
     Heartbeat,
     HealthMonitor,
@@ -28,6 +29,19 @@ from detectmateservice_tpu.engine.health import (
     install_thread_excepthook,
     remove_excepthook_sink,
 )
+
+# the known event-kind set is DERIVED from the canonical registry (the
+# REGISTERED_SERIES pattern): a new event kind must land in EVENT_KINDS to
+# be assertable here, and dmlint's DM-E rules hold the registry to the emit
+# sites/docs/soak gates — so an unregistered kind can't ship
+KNOWN_EVENT_KINDS = set(EVENT_KINDS)
+assert "health_transition" in KNOWN_EVENT_KINDS  # registry sanity anchor
+
+
+def assert_registered_kinds(events: "EventLog") -> None:
+    """Every kind in an event ring snapshot is a registered kind."""
+    kinds = {e.get("kind") for e in events.snapshot()["events"]}
+    assert kinds <= KNOWN_EVENT_KINDS, kinds - KNOWN_EVENT_KINDS
 from detectmateservice_tpu.settings import ServiceSettings
 
 from conftest import wait_until
@@ -184,6 +198,7 @@ class TestWatchdogChecks:
         monitor.trace_recorder = recorder
         time.sleep(0.08)
         monitor.evaluate()
+        assert_registered_kinds(events)
         transitions = [e for e in events.snapshot()["events"]
                        if e["kind"] == "health_transition"]
         assert transitions, "no transition events emitted"

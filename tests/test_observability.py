@@ -185,3 +185,36 @@ class TestComposeHealthchecks:
             depends = doc["services"][stage]["depends_on"]
             assert depends[upstream]["condition"] == "service_healthy", (
                 f"{stage} -> {upstream} is not health-gated")
+
+
+class TestEventKindContract:
+    """The structured-event registry (engine/health.py EVENT_KINDS) is the
+    canonical kind set — derived here, never restated as ad-hoc literals
+    (the REGISTERED_SERIES pattern), so a new event can't ship
+    unregistered or undocumented."""
+
+    def test_registry_is_nonempty_and_covers_the_core_kinds(self):
+        from detectmateservice_tpu.engine.health import EVENT_KINDS
+
+        assert {"health_transition", "log", "thread_exception",
+                "replica_drain", "model_canary_holdback"} <= set(EVENT_KINDS)
+        # every entry carries a human description (the /admin/events
+        # operator contract)
+        assert all(isinstance(v, str) and v for v in EVENT_KINDS.values())
+
+    def test_every_registered_kind_is_documented(self):
+        from detectmateservice_tpu.engine.health import EVENT_KINDS
+
+        doc = (OPS.parent / "docs" / "prometheus.md").read_text()
+        missing = [k for k in EVENT_KINDS if f"`{k}`" not in doc]
+        assert not missing, f"kinds undocumented in docs/prometheus.md: {missing}"
+
+    def test_soak_gated_kinds_are_registered(self):
+        """A soak scenario can only gate on a registered kind — the gate
+        literal rotting is exactly the failure DM-E004 exists for."""
+        from detectmateservice_tpu.analysis.contracts import soak_gated_kinds
+        from detectmateservice_tpu.engine.health import EVENT_KINDS
+
+        gated = soak_gated_kinds(OPS.parent / "scripts" / "soak.py")
+        assert gated, "soak.py gates on no event kinds (extraction rotted?)"
+        assert set(gated) <= set(EVENT_KINDS)

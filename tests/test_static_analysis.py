@@ -21,8 +21,21 @@ from pathlib import Path
 
 import pytest
 
-from detectmateservice_tpu.analysis import basic, contracts, hotloop, locks, markers
-from detectmateservice_tpu.analysis.cli import default_repo_root, main, run
+from detectmateservice_tpu.analysis import (
+    affinity,
+    basic,
+    contracts,
+    durability,
+    hotloop,
+    locks,
+    markers,
+)
+from detectmateservice_tpu.analysis.cli import (
+    default_repo_root,
+    main,
+    run,
+    to_sarif,
+)
 from detectmateservice_tpu.analysis.findings import (
     load_baseline,
     scan_pragmas,
@@ -265,6 +278,212 @@ class Loop:
 
 
 # ---------------------------------------------------------------------------
+# known-bad corpus: thread affinity (DM-A)
+# ---------------------------------------------------------------------------
+class TestAffinityKnownBad:
+    def test_cross_thread_call_fires_once(self):
+        """The PR 9 review bug, distilled: the supervisor thread reaching an
+        engine-owned router method through a typed seam."""
+        router = """
+class MiniRouter:
+    # dmlint: thread(engine)
+    def tick(self):
+        pass
+
+    # dmlint: thread(any)
+    def apply_probe(self, result):
+        pass
+"""
+        supervisor = """
+class MiniSupervisor:
+    def __init__(self, router: "MiniRouter"):
+        self._router = router
+
+    # dmlint: thread(supervisor)
+    def poll_once(self):
+        self._router.apply_probe(None)   # any-owned: fine
+        self._router.tick()              # engine-owned: the bug
+"""
+        found = [f for f in affinity.check_project([
+            ("detectmateservice_tpu/a.py", router),
+            ("detectmateservice_tpu/b.py", supervisor)])
+            if f.rule == "DM-A001"]
+        assert len(found) == 1
+        assert "MiniRouter.tick" in found[0].message
+        assert "supervisor" in found[0].message
+
+    def test_shared_unguarded_attribute_fires_once(self):
+        src = """
+class Shared:
+    def __init__(self):
+        self._count = 0
+
+    # dmlint: thread(engine)
+    def bump(self):
+        self._count += 1
+
+    # dmlint: thread(admin)
+    def read(self):
+        return self._count
+"""
+        found = [f for f in affinity.check_project(
+            [("detectmateservice_tpu/c.py", src)]) if f.rule == "DM-A002"]
+        assert len(found) == 1
+        assert "Shared._count" in found[0].message
+
+    def test_off_thread_socket_write_fires_once(self):
+        """Modeled directly on the PR 9 review finding: supervisor code
+        mutating a replica's socket."""
+        src = """
+class BadSupervisor:
+    # dmlint: thread(supervisor)
+    def poll(self, replica):
+        replica.sock.send(b"probe")
+"""
+        found = [f for f in affinity.check_project(
+            [("detectmateservice_tpu/d.py", src)]) if f.rule == "DM-A003"]
+        assert len(found) == 1
+        assert "supervisor" in found[0].message
+
+    def test_spool_write_path_off_engine_fires_once(self):
+        src = """
+class IngressSpool:
+    # dmlint: thread(engine)
+    def append(self, frame):
+        pass
+
+
+class BadAdmin:
+    def __init__(self):
+        self._spool = IngressSpool()
+
+    # dmlint: thread(admin)
+    def handler(self, frame):
+        self._spool.append(frame)
+"""
+        found = affinity.check_project([("detectmateservice_tpu/e.py", src)])
+        # the call is BOTH a foreign-owned call (A001) and a spool
+        # write-path reach (A003); assert the spool rule fires exactly once
+        spool_hits = [f for f in found if f.rule == "DM-A003"]
+        assert len(spool_hits) == 1
+        assert "spool" in spool_hits[0].message.lower()
+
+
+# ---------------------------------------------------------------------------
+# known-bad corpus: durability discipline (DM-D)
+# ---------------------------------------------------------------------------
+class TestDurabilityKnownBad:
+    def test_bare_json_dump_manifest_write_fires_once(self):
+        src = """
+import json
+
+
+def commit_manifest(fh, doc):
+    json.dump(doc, fh)
+"""
+        found = durability.check_module("detectmateservice_tpu/wal/m.py", src)
+        assert [f.rule for f in found] == ["DM-D001"]
+
+    def test_bare_final_path_open_fires_once(self):
+        src = """
+def save(path, data):
+    with open(path, "w") as fh:
+        fh.write(data)
+"""
+        found = durability.check_module("detectmateservice_tpu/wal/s.py", src)
+        assert [f.rule for f in found] == ["DM-D001"]
+
+    def test_rename_without_fsync_fires_once(self):
+        src = """
+import os
+
+
+def commit(tmp, final):
+    os.replace(tmp, final)
+"""
+        found = durability.check_module("detectmateservice_tpu/wal/r.py", src)
+        assert [f.rule for f in found] == ["DM-D002"]
+
+    def test_buffered_wal_append_fires_once(self):
+        src = """
+def open_segment(path):
+    return open(path, "ab")
+"""
+        found = durability.check_module("detectmateservice_tpu/wal/a.py", src)
+        assert [f.rule for f in found] == ["DM-D003"]
+
+    def test_non_persistence_paths_are_out_of_scope(self):
+        src = "import json\n\n\ndef f(fh):\n    json.dump({}, fh)\n"
+        assert durability.check_module(
+            "detectmateservice_tpu/engine/engine.py", src) == []
+
+
+# ---------------------------------------------------------------------------
+# known-bad corpus: event contract (DM-E, both directions)
+# ---------------------------------------------------------------------------
+class TestEventContractKnownBad:
+    @staticmethod
+    def _make_event_repo(tmp_path, registry, emit_kind, gated=None,
+                         documented=None):
+        pkg = tmp_path / "detectmateservice_tpu"
+        (pkg / "engine").mkdir(parents=True)
+        entries = "\n".join(f'    "{k}": "doc",' for k in registry)
+        (pkg / "engine" / "health.py").write_text(
+            "EVENT_KINDS = {\n" + entries + "\n}\n")
+        (pkg / "emitter.py").write_text(
+            "def emit(monitor):\n"
+            f'    monitor.emit_event({{"kind": "{emit_kind}"}})\n')
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        documented = registry if documented is None else documented
+        (docs / "prometheus.md").write_text(
+            "\n".join(f"| `{k}` | doc |" for k in documented) + "\n")
+        scripts = tmp_path / "scripts"
+        scripts.mkdir()
+        gates = "\n".join(
+            f'    check("{k}", "{k}" in kinds)' for k in (gated or []))
+        (scripts / "soak.py").write_text(
+            "def gate(kinds, check):\n" + (gates or "    pass") + "\n")
+
+    def test_unregistered_emitted_kind_fires_once(self, tmp_path):
+        self._make_event_repo(tmp_path, registry=["known_kind"],
+                              emit_kind="ghost_kind",
+                              documented=["known_kind", "ghost_kind"])
+        found = contracts.check_events_contract(tmp_path)
+        e001 = [f for f in found if f.rule == "DM-E001"]
+        assert len(e001) == 1 and "ghost_kind" in e001[0].message
+
+    def test_registered_but_never_emitted_kind_fires_once(self, tmp_path):
+        self._make_event_repo(tmp_path,
+                              registry=["emitted_kind", "rotted_kind"],
+                              emit_kind="emitted_kind")
+        found = contracts.check_events_contract(tmp_path)
+        e002 = [f for f in found if f.rule == "DM-E002"]
+        assert len(e002) == 1 and "rotted_kind" in e002[0].message
+
+    def test_undocumented_kind_fires_once(self, tmp_path):
+        self._make_event_repo(tmp_path, registry=["emitted_kind"],
+                              emit_kind="emitted_kind", documented=[])
+        found = contracts.check_events_contract(tmp_path)
+        e003 = [f for f in found if f.rule == "DM-E003"]
+        assert len(e003) == 1 and "emitted_kind" in e003[0].message
+
+    def test_gated_but_never_emitted_kind_fires_once(self, tmp_path):
+        self._make_event_repo(tmp_path, registry=["emitted_kind"],
+                              emit_kind="emitted_kind",
+                              gated=["emitted_kind", "never_emitted"])
+        found = contracts.check_events_contract(tmp_path)
+        e004 = [f for f in found if f.rule == "DM-E004"]
+        assert len(e004) == 1 and "never_emitted" in e004[0].message
+
+    def test_clean_event_repo_is_clean(self, tmp_path):
+        self._make_event_repo(tmp_path, registry=["emitted_kind"],
+                              emit_kind="emitted_kind",
+                              gated=["emitted_kind"])
+        assert contracts.check_events_contract(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
 # analyzer precision: the clean corpus produces zero findings
 # ---------------------------------------------------------------------------
 class TestCleanCorpus:
@@ -360,6 +579,97 @@ class Worker:
         found = lock_findings(src, "DM-L001")
         assert len(found) == 1 and "read" in found[0].message
 
+    AFFINITY_CLEAN = """
+import threading
+
+
+class CleanRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requeue = []
+        self._policy = "round_robin"
+
+    # dmlint: thread(engine)
+    def dispatch(self, sock, wire):
+        sock.send(wire)             # engine-owned socket op: fine
+        self._push(wire)            # propagation: _push inherits engine
+
+    def _push(self, wire):
+        with self._lock:
+            self._requeue.append(wire)
+
+    # dmlint: thread(supervisor)
+    def apply(self, result):
+        with self._lock:            # lock-guarded cross-domain state: fine
+            self._requeue.append(result)
+
+    # dmlint: thread(any)
+    def snapshot(self):
+        with self._lock:
+            return list(self._requeue)
+
+    # dmlint: thread(supervisor)
+    def read_policy(self):
+        return self._policy         # init-only binding: no guard needed
+"""
+
+    def test_zero_affinity_findings_on_clean_corpus(self):
+        assert affinity.check_project(
+            [("detectmateservice_tpu/clean.py", self.AFFINITY_CLEAN)]) == []
+
+    def test_affinity_ignore_pragma_suppresses(self):
+        src = """
+class Shared:
+    def __init__(self):
+        self._count = 0
+
+    # dmlint: thread(engine)
+    def bump(self):
+        self._count += 1
+
+    # dmlint: thread(admin)
+    def read(self):
+        # dmlint: ignore[DM-A002] GIL-atomic int read; staleness only skews a gauge
+        return self._count
+"""
+        assert affinity.check_project(
+            [("detectmateservice_tpu/s.py", src)]) == []
+
+    DURABILITY_CLEAN = """
+import json
+import os
+
+
+def fsync_dir(directory):
+    fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_json_atomic(path, doc):
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(doc))
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path.parent)
+
+
+def open_segment(path):
+    return open(path, "ab", buffering=0)
+
+
+def read_manifest(path):
+    return json.loads(open(path).read())
+"""
+
+    def test_zero_durability_findings_on_clean_corpus(self):
+        assert durability.check_module(
+            "detectmateservice_tpu/wal/clean.py", self.DURABILITY_CLEAN) == []
+
 
 # ---------------------------------------------------------------------------
 # baseline mechanics
@@ -448,6 +758,46 @@ class TestRealTree:
             REPO / "detectmateservice_tpu" / "web" / "router.py")
         assert set(parsed) == {f"{r.method} {r.path}" for r in ROUTES}
 
+    def test_event_registry_matches_runtime_and_emit_sites(self):
+        """The AST-parsed EVENT_KINDS must equal the runtime registry, and
+        every kind the AST walker extracts from the emit sites must be
+        registered — the DM-E gate's own parity pin (if the declaration
+        idiom changes shape, break loudly, not silently)."""
+        from detectmateservice_tpu.engine.health import EVENT_KINDS
+
+        parsed = contracts.declared_event_kinds(
+            REPO / "detectmateservice_tpu" / "engine" / "health.py")
+        assert set(parsed) == set(EVENT_KINDS)
+        emitted = contracts.emitted_event_kinds(REPO)
+        assert set(emitted) == set(EVENT_KINDS)
+
+    def test_soak_gated_kind_extraction_sees_the_known_gates(self):
+        gated = contracts.soak_gated_kinds(REPO / "scripts" / "soak.py")
+        assert {"replica_drain", "model_canary_holdback"} <= set(gated)
+
+    def test_affinity_sees_the_real_seams(self):
+        """The pragma sweep landed: the spool/router engine seams and the
+        supervisor/watchdog/rollout entry points are machine-readable."""
+        from detectmateservice_tpu.analysis.cli import iter_py_files
+
+        files = []
+        for path in iter_py_files(REPO):
+            rel = path.resolve().relative_to(REPO).as_posix()
+            if rel.startswith("detectmateservice_tpu/"):
+                files.append((rel, path.read_text(encoding="utf-8")))
+        project = affinity._build_project(files, set())
+        assert project.ownership["IngressSpool"]["append"] == "engine"
+        assert project.ownership["IngressSpool"]["tick"] == "engine"
+        assert project.ownership["ReplicaRouter"]["dispatch"] == "engine"
+        assert project.ownership["ReplicaRouter"]["tick"] == "engine"
+        assert project.ownership["ReplicaRouter"]["apply_probe"] == "any"
+        sup = next(c for c in project.classes
+                   if c.name == "ReplicaSupervisor")
+        assert sup.methods["poll_once"].declared == "supervisor"
+        # the supervisor's router seam is TYPED, so a future off-thread
+        # call there resolves (the PR 9 regression stays detectable)
+        assert sup.attr_types["_router"] == "ReplicaRouter"
+
     def test_marker_lint_sees_registered_markers(self):
         regs = markers.registered_markers(REPO / "pyproject.toml")
         assert {"tpu", "slow"} <= regs
@@ -463,6 +813,54 @@ class TestRealTree:
             capture_output=True, text=True, timeout=120)
         assert proc.returncode == 0
         assert "DM-L001" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# SARIF output + diff-aware mode (the CI annotation surface)
+# ---------------------------------------------------------------------------
+class TestSarifAndDiffMode:
+    def test_sarif_schema_shape(self):
+        from detectmateservice_tpu.analysis.findings import Finding
+
+        finding = Finding("DM-A001", "pkg/mod.py", 42, "off-thread call",
+                          hint="move it", key="K")
+        doc = to_sarif([finding], suppressed=[
+            Finding("DM-L001", "pkg/other.py", 7, "benign race", key="S")])
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        (run_doc,) = doc["runs"]
+        driver = run_doc["tool"]["driver"]
+        assert driver["name"] == "detectmate-lint"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert {"DM-A001", "DM-D001", "DM-E001"} <= rule_ids
+        active, suppressed = run_doc["results"]
+        assert active["ruleId"] == "DM-A001"
+        assert active["level"] == "error"
+        loc = active["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "pkg/mod.py"
+        assert loc["region"]["startLine"] == 42
+        assert active["partialFingerprints"]["dmlintFingerprint/v1"] \
+            == finding.fingerprint
+        assert "move it" in active["message"]["text"]
+        # baseline-suppressed findings ride along marked suppressed, so
+        # code scanning shows them as dismissed instead of resurfacing them
+        assert suppressed["suppressions"][0]["kind"] == "external"
+        json.dumps(doc)    # must be plain-JSON serializable
+
+    def test_cli_sarif_output_parses(self, capsys):
+        assert main(["--format", "sarif"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["runs"][0]["tool"]["driver"]["name"] == "detectmate-lint"
+
+    def test_changed_mode_filters_to_diffed_files(self, capsys):
+        """--changed HEAD exits clean on a tree whose full gate is clean
+        (the filter can only shrink the finding set)."""
+        assert main(["--changed", "HEAD"]) == 0
+
+    def test_changed_files_helper_handles_bad_ref(self):
+        from detectmateservice_tpu.analysis.cli import changed_files
+
+        assert changed_files(REPO, "no-such-ref-anywhere") is None
 
 
 # ---------------------------------------------------------------------------
@@ -489,3 +887,29 @@ class TestSanitizerWiring:
         steps = " ".join(str(s.get("run", ""))
                          for s in doc["jobs"]["native-sanitize"]["steps"])
         assert "native_sanitize.sh" in steps
+
+    def test_ci_static_job_uploads_sarif_and_runs_diff_aware_on_prs(self):
+        import yaml
+
+        doc = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+        static = doc["jobs"]["static"]
+        assert static["permissions"]["security-events"] == "write"
+        runs = " ".join(str(s.get("run", "")) for s in static["steps"])
+        uses = " ".join(str(s.get("uses", "")) for s in static["steps"])
+        assert "--changed origin/" in runs       # PR fail-fast mode
+        assert "--format sarif" in runs
+        assert "upload-sarif" in uses
+        # the full unfiltered gate still runs (push-to-main branch)
+        conds = [str(s.get("if", "")) for s in static["steps"]
+                 if "static_check.py" in str(s.get("run", ""))
+                 and "--changed" not in str(s.get("run", ""))
+                 and "sarif" not in str(s.get("run", ""))]
+        assert any("pull_request" in c for c in conds)
+
+    def test_precommit_hook_is_diff_aware(self):
+        import yaml
+
+        doc = yaml.safe_load((REPO / ".pre-commit-config.yaml").read_text())
+        local = next(r for r in doc["repos"] if r["repo"] == "local")
+        hook = next(h for h in local["hooks"] if h["id"] == "detectmate-lint")
+        assert "--changed HEAD" in hook["entry"]

@@ -1,0 +1,125 @@
+"""Runtime thread-affinity twin (utils/threadcheck): the dynamic half of
+the DM-A static contract.
+
+The whole suite runs with ``DM_THREADCHECK=1`` (tests/conftest.py), so the
+asserts embedded at the spool/router engine seams are ARMED for every other
+test in the tier — an off-thread call anywhere in the suite fails loudly.
+This file pins the mechanism itself: binding, name-map classification,
+unclassified-thread passes, and the seam integration (a supervisor-named
+thread calling an engine-owned spool method trips the assert).
+"""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from detectmateservice_tpu.utils import threadcheck
+from detectmateservice_tpu.utils.threadcheck import (
+    ThreadAffinityError,
+    assert_affinity,
+    bind_thread,
+    current_domain,
+    unbind_thread,
+)
+
+
+@pytest.fixture(autouse=True)
+def _armed():
+    """Arm for each test regardless of the env, restore afterwards."""
+    before = threadcheck.armed()
+    threadcheck.arm(True)
+    yield
+    threadcheck.arm(before)
+    unbind_thread()
+
+
+def run_in_thread(fn, name):
+    """Run ``fn`` on a named thread, re-raising anything it raised."""
+    box = {}
+
+    def target():
+        try:
+            box["result"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — test relay
+            box["error"] = exc
+
+    thread = threading.Thread(target=target, name=name)
+    thread.start()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+class TestMechanism:
+    def test_unclassified_thread_passes_every_assert(self):
+        # pytest's MainThread has no binding and no mapped name — the
+        # contract constrains production threads, not harnesses
+        assert current_domain() is None
+        assert_affinity("engine")
+        assert_affinity("supervisor")
+
+    def test_bound_thread_passes_its_own_domain_and_any(self):
+        bind_thread("engine")
+        assert current_domain() == "engine"
+        assert_affinity("engine")
+        assert_affinity("any")
+
+    def test_bound_thread_trips_on_a_foreign_seam(self):
+        bind_thread("supervisor")
+        with pytest.raises(ThreadAffinityError, match="supervisor"):
+            assert_affinity("engine")
+
+    def test_name_map_classifies_production_threads(self):
+        assert run_in_thread(current_domain, "EngineLoop") == "engine"
+        assert run_in_thread(current_domain, "ReplicaSupervisor") \
+            == "supervisor"
+        assert run_in_thread(current_domain, "HealthWatchdog") == "watchdog"
+        assert run_in_thread(current_domain, "ModelRollout") == "rollout"
+
+    def test_binding_overrides_the_name_map(self):
+        def body():
+            bind_thread("engine")
+            try:
+                return current_domain()
+            finally:
+                unbind_thread()
+
+        assert run_in_thread(body, "ReplicaSupervisor") == "engine"
+
+    def test_disarmed_is_a_no_op(self):
+        threadcheck.arm(False)
+        bind_thread("supervisor")
+        assert_affinity("engine")    # would raise if armed
+
+
+class TestSeamIntegration:
+    def test_supervisor_thread_cannot_append_to_the_spool(self, tmp_path):
+        """The runtime half of the PR 9 bug class: an engine-owned WAL
+        write-path call from the supervisor thread trips immediately."""
+        from detectmateservice_tpu.wal import IngressSpool
+
+        spool = IngressSpool(str(tmp_path))
+        try:
+            with pytest.raises(ThreadAffinityError):
+                run_in_thread(lambda: spool.append(b"frame"),
+                              "ReplicaSupervisor")
+            # the engine-named thread is allowed through the same seam
+            assert run_in_thread(lambda: spool.append(b"frame"),
+                                 "EngineLoop") == 1
+        finally:
+            spool.close()
+
+    def test_engine_loop_thread_owns_the_spool_tick(self, tmp_path):
+        from detectmateservice_tpu.wal import IngressSpool
+
+        spool = IngressSpool(str(tmp_path))
+        try:
+            run_in_thread(lambda: spool.tick(force=True), "EngineLoop")
+            with pytest.raises(ThreadAffinityError):
+                run_in_thread(lambda: spool.tick(force=True),
+                              "HealthWatchdog")
+        finally:
+            spool.close()
