@@ -74,6 +74,8 @@ ALERT_COVERED_SERIES = (
     "model_checkpoint_age_seconds",
     "wal_spool_depth_frames",
     "wal_oldest_unacked_age_seconds",
+    "shed_frames_total",
+    "shed_ladder_state",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

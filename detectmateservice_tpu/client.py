@@ -25,6 +25,9 @@ rejection)
 (re-drive a recorded WAL ingress spool through the stage behind
 ``/admin/replay`` — deterministic pipeline replay/backfill, or ``--shadow``
 offline scoring of a dmroll candidate against recorded traffic),
+``tenants [--limit N]`` (the dmshed admission-control snapshot behind
+``/admin/tenants`` — per-tier/per-tenant admitted+shed counters and the
+current degradation-ladder state),
 and ``health`` — which fans out across every stage of
 a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
 ``stages:`` mapping), prints a roll-up table, and exits non-zero when any
@@ -181,6 +184,19 @@ class DetectMateClient:
     def load_status(self) -> Any:
         """Live SLO scorecard of the load run (``GET /admin/load``)."""
         return self._request("GET", "/admin/load")
+
+    def tenants(self, limit: Optional[int] = None) -> Any:
+        """Admission-control snapshot (``GET /admin/tenants``): per-tier and
+        per-tenant admitted/shed counters + the current degradation-ladder
+        state. HTTP 404 (stage without ``shed_enabled``) surfaces as None,
+        mirroring ``replicas``/``model_status``."""
+        suffix = f"?limit={int(limit)}" if limit is not None else ""
+        try:
+            return self._request("GET", "/admin/tenants" + suffix)
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
 
     def replay_status(self) -> Any:
         """WAL replay status + the live ingress spool's stats
@@ -728,6 +744,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                           help="return immediately; poll `replay status`")
     replay_p.add_argument("--timeout", type=float, default=600.0,
                           help="wait budget in seconds (default 600)")
+    tenants_p = sub.add_parser(
+        "tenants", help="admission-control snapshot: per-tier admitted/shed "
+                        "counters + the degradation-ladder state "
+                        "(/admin/tenants)")
+    tenants_p.add_argument("--limit", type=int, default=None,
+                           help="only the top N tenants by shed count")
     trace = sub.add_parser(
         "trace", help="read the pipeline flight recorder (/admin/trace)")
     trace.add_argument("--chrome", action="store_true",
@@ -757,6 +779,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_model(client, args)
         if args.command == "replay":
             return run_replay(client, args)
+        if args.command == "tenants":
+            result = client.tenants(limit=args.limit)
+            if result is None:
+                print("admission control is not enabled on this stage "
+                      "(shed_enabled)", file=sys.stderr)
+                return 1
+            print(json.dumps(result, indent=2))
+            return 0
         if args.command == "events":
             result = client.events(limit=args.limit)
         elif args.command == "xla":

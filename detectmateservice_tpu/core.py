@@ -242,9 +242,71 @@ class Service:
                     "device_inflight", pending_fn, drained_fn)
 
         self.processor = LibraryComponentProcessor(self.library_component, self._labels)
+
+        # multi-tenant overload control (shed/): quota map + degradation
+        # ladder + admission controller, built BEFORE the Engine so ingress
+        # can consult them from the first frame. A tenants.yaml typo fails
+        # construction here — a quota misload must stop the service, not
+        # silently admit everything under the default.
+        self.admission = None
+        self.shed_ladder = None
+        if settings.shed_enabled:
+            from .engine.health import DegradationLadder
+            from .shed import AdmissionController, load_quota_map
+            from .shed.quota import default_quota_map
+
+            if settings.tenants_file:
+                quota_map = load_quota_map(
+                    settings.tenants_file,
+                    default_tier=settings.tenant_default_tier,
+                    default_rate=settings.tenant_default_rate,
+                    default_burst=settings.tenant_default_burst)
+            else:
+                quota_map = default_quota_map(
+                    tier=settings.tenant_default_tier,
+                    rate=settings.tenant_default_rate,
+                    burst=settings.tenant_default_burst)
+            self.shed_ladder = DegradationLadder(
+                (settings.shed_ladder_backlog_t1,
+                 settings.shed_ladder_backlog_t2,
+                 settings.shed_ladder_backlog_t3),
+                dict(self._labels),
+                recovery_intervals=settings.shed_ladder_recovery_intervals,
+                events=self.health.emit_event)
+            self.health.add_check(self.shed_ladder)
+            self.admission = AdmissionController(
+                quota_map, dict(self._labels),
+                buckets=settings.shed_tenant_buckets,
+                retry_after_ms=settings.shed_retry_after_ms,
+                ladder=self.shed_ladder,
+                events=self.health.emit_event,
+                logger=self.logger)
+            self.logger.info(
+                "admission control armed: %d named tenants, default "
+                "tier=%s rate=%.0f/s, ladder thresholds %d/%d/%d",
+                len(quota_map.tenants), quota_map.default.tier,
+                quota_map.default.rate, settings.shed_ladder_backlog_t1,
+                settings.shed_ladder_backlog_t2,
+                settings.shed_ladder_backlog_t3)
+
         self.engine = Engine(settings, self.processor, socket_factory,
-                             self.logger, health=self.health)
+                             self.logger, health=self.health,
+                             admission=self.admission)
         self.health.trace_recorder = self.engine.trace_recorder
+        if self.shed_ladder is not None:
+            # backlog probes the ladder sums each watchdog interval: rows
+            # held/in flight in the processor, unsettled replica windows,
+            # and the durable spool's unacked depth — every place pressure
+            # pools when the process falls behind
+            pending_fn = getattr(self.processor, "pending_count", None)
+            if callable(pending_fn):
+                self.shed_ladder.add_backlog_source(pending_fn)
+            if self.engine.router is not None:
+                self.shed_ladder.add_backlog_source(
+                    self.engine.router.unacked_total)
+            if self.engine.spool is not None:
+                spool = self.engine.spool
+                self.shed_ladder.add_backlog_source(spool.depth_frames)
         # device-observability plane (engine/device_obs.py): bind the
         # process-wide XLA compile ledger to THIS service's identity and
         # health plane, so an unexpected recompile lands in the event ring,

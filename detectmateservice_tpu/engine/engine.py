@@ -28,16 +28,18 @@ per-message, and a lone message still flushes after the batch timeout.
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
 from collections import deque
-from typing import List, Optional, Protocol, runtime_checkable
+from typing import List, Optional, Protocol, Tuple, runtime_checkable
 
 from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
 from . import metrics as m
 from .framing import (
     MAGIC_SHM,
+    MAGIC_TEN,
     MAGIC_V2,
     FramingError,
     Hop,
@@ -45,7 +47,9 @@ from .framing import (
     frame_msg_count,
     pack_batch,
     unpack_batch,
+    unwrap_tenant,
     unwrap_trace,
+    wrap_tenant,
     wrap_trace,
 )
 from .health import Heartbeat
@@ -117,6 +121,7 @@ class Engine:
         socket_factory: Optional[EngineSocketFactory] = None,
         logger: Optional[logging.Logger] = None,
         health=None,
+        admission=None,
     ) -> None:
         if processor is None or not callable(getattr(processor, "process", None)):
             raise EngineException("processor must provide a callable process(bytes)")
@@ -190,6 +195,23 @@ class Engine:
             self._dwell_obs = m.PIPELINE_STAGE_DWELL().labels(**self._labels).observe
             self._transit_obs = m.PIPELINE_TRANSIT().labels(**self._labels).observe
             self._e2e_obs = m.PIPELINE_E2E_LATENCY().labels(**self._labels).observe
+
+        # multi-tenant admission control (shed/): tenant blocks are stripped
+        # at ingress UNCONDITIONALLY (clean downgrade for tenant-unaware
+        # configs, mirroring v2 trace handling) and re-stamped OUTERMOST on
+        # forwarded egress frames; the admission decision only runs when a
+        # controller was wired (core.py, shed_enabled). _tenant_pending is
+        # the egress FIFO — exact when frames map 1:1 through the stage,
+        # approximate under merging/re-chunking, same contract as
+        # _trace_pending. The NACK child is hoisted per DM-H001.
+        self.admission = admission
+        self._tenant_pending: deque = deque()
+        self._m_nacks = m.SHED_NACKS().labels(**self._labels)
+        # tenant-attribution seam for coalescing processors (the scorer's
+        # weighted-fair batcher): told the current ingress frame's tenant so
+        # held rows can be segmented per tenant. Hoisted: one getattr at
+        # construction, not one per frame.
+        self._note_tenant = getattr(processor, "note_tenant", None)
 
         # router slot initialized before any socket exists so the failure
         # cleanup path (_close_all) can always probe it
@@ -566,6 +588,10 @@ class Engine:
         only at the terminal stage — no forwarding outputs, or the
         ``trace_terminal`` override — where the trace's life genuinely
         ends."""
+        # tenant attribution shares the finalize point: pending tenants whose
+        # frames did not leave this burst (filtered / deferred outputs) must
+        # not re-stamp a later burst's frames with a stale tenant
+        self._tenant_pending.clear()
         if not self._trace_pending:
             return
         now = time.time_ns()
@@ -579,6 +605,61 @@ class Engine:
                 e2e = max(0, now - ctx.ingest_ns) / 1e9
                 self._e2e_obs(e2e)
                 self.trace_recorder.record(ctx, e2e)
+
+    def _strip_tenant(self, raw: bytes,
+                      err_c) -> Tuple[Optional[bytes], Optional[str]]:
+        """Strip one tenant block → ``(payload, tenant)``. A garbled id is
+        counted and the payload survives (admitted as the anonymous tenant,
+        so damage cannot buy a better quota); only a declared id length
+        running past the frame end loses the frame."""
+        try:
+            payload, tenant, damaged = unwrap_tenant(raw)
+        except FramingError as exc:
+            err_c.inc()
+            self.logger.error("corrupt tenant frame dropped: %s", exc)
+            return None, None
+        if damaged:
+            err_c.inc()
+            self.logger.warning(
+                "garbled tenant block stripped; payload messages kept")
+        return (payload or None), tenant
+
+    def _admit_frame(self, tenant: Optional[str], raw: bytes) -> bool:
+        """One frame's admission decision; False means shed (the controller
+        already counted + evented it). In reply mode the requester gets a
+        structured retry-after NACK instead of a silent empty reply."""
+        ok, reason, tier = self.admission.admit(
+            tenant, frame_msg_count(raw), time.monotonic())
+        if ok:
+            return True
+        if not self._out_socks and self.router is None:
+            self._send_nack(reason or "quota", tier, tenant)
+        return False
+
+    def _send_nack(self, reason: str, tier: Optional[str],
+                   tenant: Optional[str], origin=None) -> None:
+        """Best-effort reply-mode NACK: a compact ``dm_nack`` JSON body
+        (reason + retry_after_ms) the requester can back off on, counted on
+        shed_nacks_total. A NACK the transport will not take is dropped —
+        it exists to shed load, never to add backpressure."""
+        if self.admission is not None:
+            body = self.admission.nack_payload(reason, tier, tenant)
+        else:
+            body = {"dm_nack": {
+                "reason": reason, "tier": tier, "tenant": tenant,
+                "retry_after_ms": getattr(
+                    self.settings, "shed_retry_after_ms", 100.0)}}
+        payload = json.dumps(body, separators=(",", ":")).encode("utf-8")
+        send_to = getattr(self._pair_sock, "send_to", None)
+        try:
+            if origin is not None and callable(send_to):
+                send_to(origin, payload)
+            else:
+                self._pair_sock.send(payload)
+        except (TransportAgain, TransportError) as exc:
+            self.logger.warning("shed NACK undeliverable: %s", exc)
+            return
+        self._m_nacks.inc()
 
     def _expand_frame(self, raw: bytes, read_b, read_l, err_c) -> List[bytes]:
         """One wire frame → its messages. Batch frames (framing.py) are
@@ -601,14 +682,34 @@ class Engine:
             raw = self._resolve_shm(raw, err_c)
             if not raw:
                 return []
+        # tenant attribution + admission (shed/): the tenant block is the
+        # outermost wrapper, so it is stripped first — before the spool
+        # append decision, because a SHED frame must never be made durable
+        # (shedding is only cheap at the front door). Replay is exempt from
+        # admission: a recovered frame was admitted and metered when it
+        # first arrived.
+        wire = raw              # pre-strip bytes: the spool stays byte-faithful
+        tenant = None
+        if raw[0] == 0xD7 and raw.startswith(MAGIC_TEN):
+            raw, tenant = self._strip_tenant(raw, err_c)
+            if not raw:
+                return []
+        if self._note_tenant is not None:
+            self._note_tenant(tenant)
+        if (self.admission is not None and not self._replaying
+                and not self._admit_frame(tenant, raw)):
+            return []
+        if tenant is not None and (self._out_socks or self.router is not None):
+            self._tenant_pending.append(tenant)
         # durable ingress: record the frame BEFORE any processing — post
         # shm-resolution (a slot reference is not durable), pre trace-strip
         # (the recorded bytes keep their original trace id + ingest stamp,
-        # which is what makes replay byte-faithful). The tick keeps the
-        # fsync cadence honest inside long burst-collect windows, when the
-        # loop-top tick cannot run.
+        # which is what makes replay byte-faithful; the tenant block is
+        # recorded too, so replayed frames keep their attribution). The
+        # tick keeps the fsync cadence honest inside long burst-collect
+        # windows, when the loop-top tick cannot run.
         if self._spool is not None and not self._replaying:
-            self._spool.append(raw)
+            self._spool.append(wire)
             self._spool.tick()
         read_b.inc(len(raw))
         # first-byte probe before the slice compare: protobuf payloads never
@@ -811,10 +912,26 @@ class Engine:
                         nxt = self._resolve_shm(nxt, err_c)
                         if not nxt:
                             return None
+                    # tenant strip + admission: same placement contract as
+                    # _expand_frame (shed frames never reach the spool)
+                    wire = nxt
+                    tenant = None
+                    if nxt[0] == 0xD7 and nxt.startswith(MAGIC_TEN):
+                        nxt, tenant = self._strip_tenant(nxt, err_c)
+                        if not nxt:
+                            return None
+                    if self._note_tenant is not None:
+                        self._note_tenant(tenant)
+                    if (self.admission is not None
+                            and not self._admit_frame(tenant, nxt)):
+                        return None
+                    if tenant is not None and (self._out_socks
+                                               or self.router is not None):
+                        self._tenant_pending.append(tenant)
                     # durable ingress: same append point (and mid-burst
                     # fsync tick) as _expand_frame
                     if spool is not None:
-                        spool.append(nxt)
+                        spool.append(wire)
                         spool.tick()
                     read_b.inc(len(nxt))
                     if self._trace_enabled or nxt.startswith(MAGIC_V2):
@@ -976,6 +1093,17 @@ class Engine:
                     return
                 if use_frames:
                     read_b.inc(len(raw))
+                    if raw.startswith(MAGIC_TEN):
+                        # recovered frames keep their attribution for the
+                        # egress re-stamp; admission is NOT re-run (they
+                        # were admitted and metered when they first arrived)
+                        raw, tenant = self._strip_tenant(raw, err_c)
+                        if not raw:
+                            self._finalize_traces()
+                            continue
+                        if tenant is not None and (
+                                self._out_socks or self.router is not None):
+                            self._tenant_pending.append(tenant)
                     if self._trace_enabled or raw.startswith(MAGIC_V2):
                         raw = self._ingest_trace(raw, err_c)
                     if raw:
@@ -1112,6 +1240,13 @@ class Engine:
                 if lines is None:
                     lines = _count_lines(data)
                 data = self._stamp_trace(data, now_ns)
+            if self._tenant_pending:
+                # tenant block re-stamped OUTERMOST (after the trace wrap)
+                # so the next stage's admission reads it from the first
+                # bytes; only forwarded frames ever enqueue here
+                if lines is None:
+                    lines = _count_lines(data)
+                data = wrap_tenant(data, self._tenant_pending.popleft())
             built.append((data, lines, origin))
             start = end
         # batched fan-out (send_many): one GIL crossing per send_batch_max
@@ -1290,6 +1425,12 @@ class Engine:
                 self.logger.warning("reply undeliverable: %s", exc)
                 dropped_b.inc(len(data))
                 dropped_l.inc(lines)
+                # drop-mode overflow fix: the requester used to see NOTHING
+                # when its reply was dropped here — send the compact
+                # structured NACK instead (a ~100-byte body often fits the
+                # very buffer a full reply overflowed), so the sender can
+                # back off instead of timing out blind
+                self._send_nack("overflow", None, None, origin=origin)
                 return False
             except TransportError as exc:
                 self.logger.error("reply on input socket failed: %s", exc)

@@ -54,6 +54,15 @@ MAGIC_V2 = b"\xd7DM\x02"
 # downstream. Senders only emit these on colocated links (ipc/inproc peers
 # with ``zero_copy_framing`` enabled) and copy-downgrade everywhere else.
 MAGIC_SHM = b"\xd7DM\x03"
+# Tenant-attributed frame (v2 format family, dmshed): the OUTERMOST wrapper —
+# a tenant id rides in front of whatever the sender emits (a v2 traced frame,
+# a v1 batch frame, or a plain single message), so ingress admission control
+# can attribute and shed a frame from its first bytes without touching the
+# trace block or payload. Stripping it for a tenant-unaware peer is a slice
+# (everything after the block), the same clean-downgrade contract v2 has:
+#
+#     0xD7 'D' 'M' 0x04 | varint id_len | tenant id utf-8 | payload
+MAGIC_TEN = b"\xd7DM\x04"
 
 
 class FramingError(ValueError):
@@ -105,6 +114,15 @@ def frame_msg_count(data: bytes) -> int:
     that."""
     if not data:
         return 0
+    if data.startswith(MAGIC_TEN):
+        try:
+            id_len, pos = _get_varint(data, len(MAGIC_TEN))
+        except FramingError:
+            return 0
+        start = pos + id_len
+        if start > len(data):
+            return 0
+        return frame_msg_count(data[start:])
     if data.startswith(MAGIC_V2):
         try:
             trace_len, pos = _get_varint(data, len(MAGIC_V2))
@@ -298,8 +316,18 @@ def unwrap_trace(data: bytes) -> Tuple[bytes, Optional[TraceContext], bool]:
 def peek_trace_id(data: bytes) -> Optional[int]:
     """The trace id of a v2 frame WITHOUT parsing the hop records — the
     router's sticky_trace policy runs this per dispatched frame, so it reads
-    exactly one varint and eight bytes. None for non-v2 frames and for
-    frames whose declared trace block cannot hold an id."""
+    exactly one varint and eight bytes (plus one varint skip when a tenant
+    block rides in front). None for non-v2 frames and for frames whose
+    declared trace block cannot hold an id."""
+    if data.startswith(MAGIC_TEN):
+        try:
+            id_len, pos = _get_varint(data, len(MAGIC_TEN))
+        except FramingError:
+            return None
+        start = pos + id_len
+        if start > len(data):
+            return None
+        data = data[start:]
     if not data.startswith(MAGIC_V2):
         return None
     try:
@@ -309,6 +337,64 @@ def peek_trace_id(data: bytes) -> Optional[int]:
     if trace_len < 8 or pos + 8 > len(data):
         return None
     return int.from_bytes(data[pos:pos + 8], "big")
+
+
+# -- tenant attribution (dmshed frames) --------------------------------------
+
+
+def wrap_tenant(payload: bytes, tenant: str) -> bytes:
+    """Payload (any complete wire unit: v2 traced frame, v1 batch frame, or
+    a plain single message) → tenant-attributed frame. The tenant block is
+    always the OUTERMOST wrapper; senders stamp it last."""
+    out = bytearray(MAGIC_TEN)
+    name = tenant.encode("utf-8")
+    _put_varint(out, len(name))
+    out += name
+    out += payload
+    return bytes(out)
+
+
+def unwrap_tenant(data: bytes) -> Tuple[bytes, Optional[str], bool]:
+    """Tenant frame → ``(payload, tenant, tenant_damaged)``.
+
+    Non-tenant input passes through as ``(data, None, False)``. A tenant
+    block whose id bytes are not valid UTF-8 still yields its payload — the
+    block is skipped by its declared length and ``tenant_damaged`` is True
+    so the caller can count the damage (and admit under the default quota)
+    without dropping the payload messages. Only a declared id length
+    running past the frame end (no payload can exist) raises
+    FramingError."""
+    if not data.startswith(MAGIC_TEN):
+        return data, None, False
+    id_len, pos = _get_varint(data, len(MAGIC_TEN))
+    start = pos + id_len
+    if start > len(data):
+        raise FramingError("tenant id length exceeds frame size")
+    try:
+        tenant = data[pos:start].decode("utf-8")
+    except UnicodeDecodeError:
+        return data[start:], None, True
+    return data[start:], tenant, False
+
+
+def peek_tenant_id(data: bytes) -> Optional[str]:
+    """The tenant id of a tenant-attributed frame WITHOUT touching the
+    payload — admission control runs this per ingress frame, so it reads
+    exactly one varint and the id bytes. None for frames with no tenant
+    block or an undecodable id."""
+    if not data.startswith(MAGIC_TEN):
+        return None
+    try:
+        id_len, pos = _get_varint(data, len(MAGIC_TEN))
+    except FramingError:
+        return None
+    start = pos + id_len
+    if start > len(data):
+        return None
+    try:
+        return data[pos:start].decode("utf-8")
+    except UnicodeDecodeError:
+        return None
 
 
 def unpack_batch(data: bytes) -> Optional[List[bytes]]:

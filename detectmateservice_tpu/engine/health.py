@@ -80,6 +80,9 @@ EVENT_KINDS = {
     "model_canary_holdback": "the shadow gate rejected a candidate",
     "model_pinned": "an operator pinned the served model version",
     "model_unpinned": "an operator lifted the model pin",
+    "load_shed": "ingress admission control refused frames (tenant over "
+                 "quota, or its tier gated by the degradation ladder)",
+    "shed_ladder_transition": "the overload degradation ladder changed state",
 }
 
 
@@ -272,6 +275,112 @@ class InflightStuckCheck:
             return DEGRADED, (f"{pending} in flight, no drain progress "
                               f"for {stuck:.1f}s")
         return PASS, f"{pending} in flight, waiting {stuck:.2f}s"
+
+
+class DegradationLadder:
+    """The global overload state machine (dmshed): how much of the tenant
+    population ingress admission keeps serving as backlog grows.
+
+    Four states — ``normal`` → ``shed_best_effort`` → ``shed_burst`` →
+    ``emergency`` — driven by the process's aggregate backlog (detector
+    pending batches, router unacked window, durable-spool depth: whatever
+    probe callables the service registers). Climbing is immediate and jumps
+    straight to the highest threshold exceeded (an overloaded process must
+    start shedding within one watchdog interval); descending takes
+    ``recovery_intervals`` consecutive evaluations below the next state's
+    threshold and moves ONE step at a time — the same asymmetric hysteresis
+    the watchdog checks use, so a backlog oscillating around a threshold
+    cannot strobe tiers on and off.
+
+    Registered as a HealthMonitor check (rides the watchdog cadence); the
+    engine's admission controller reads ``state_index`` per frame — a
+    GIL-atomic int attribute, no lock on the hot path. Every transition
+    emits a ``shed_ladder_transition`` structured event and updates the
+    ``shed_ladder_state`` Enum."""
+
+    name = "overload_ladder"
+
+    STATES = ("normal", "shed_best_effort", "shed_burst", "emergency")
+    # ladder state -> roll-up contribution: shedding best-effort traffic is
+    # a degradation; emergency (guaranteed-only) means the process is
+    # effectively down for most tenants
+    _STATUS = (PASS, DEGRADED, DEGRADED, UNHEALTHY)
+
+    def __init__(self, thresholds: Tuple[float, float, float],
+                 labels: Dict[str, str],
+                 recovery_intervals: int = 2,
+                 events: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                 ) -> None:
+        t1, t2, t3 = thresholds
+        if not (0 < t1 <= t2 <= t3):
+            raise ValueError(
+                f"ladder thresholds must satisfy 0 < t1 <= t2 <= t3, got "
+                f"({t1}, {t2}, {t3})")
+        self._thresholds = (float(t1), float(t2), float(t3))
+        self._recovery_intervals = max(1, recovery_intervals)
+        self._events = events
+        self._backlog_fns: List[Callable[[], float]] = []
+        self.state_index = 0   # read per frame by AdmissionController
+        self._clean_streak = 0
+        self._metric = m.SHED_LADDER_STATE().labels(**labels)
+        self._metric.state(self.STATES[0])
+
+    def add_backlog_source(self, fn: Callable[[], float]) -> None:
+        """Register one backlog probe (messages/frames pending somewhere in
+        the process); the ladder drives off the SUM of all sources."""
+        self._backlog_fns.append(fn)
+
+    def backlog(self) -> float:
+        total = 0.0
+        for fn in self._backlog_fns:
+            try:
+                total += float(fn() or 0)
+            except Exception:  # noqa: BLE001 — probes must not kill the watchdog
+                continue
+        return total
+
+    def _target_state(self, backlog: float) -> int:
+        target = 0
+        for index, threshold in enumerate(self._thresholds, start=1):
+            if backlog >= threshold:
+                target = index
+        return target
+
+    def evaluate(self, now: float) -> Tuple[str, str]:
+        backlog = self.backlog()
+        target = self._target_state(backlog)
+        current = self.state_index
+        if target > current:
+            # climb fast: straight to the highest exceeded threshold
+            self._transition(current, target, backlog)
+            current = target
+            self._clean_streak = 0
+        elif target < current:
+            # recover slow: one step down per recovery window
+            self._clean_streak += 1
+            if self._clean_streak >= self._recovery_intervals:
+                self._transition(current, current - 1, backlog)
+                current -= 1
+                self._clean_streak = 0
+        else:
+            self._clean_streak = 0
+        detail = (f"backlog {backlog:.0f} "
+                  f"(thresholds {self._thresholds[0]:.0f}/"
+                  f"{self._thresholds[1]:.0f}/{self._thresholds[2]:.0f})")
+        return self._STATUS[current], f"{self.STATES[current]}: {detail}"
+
+    def _transition(self, old: int, new: int, backlog: float) -> None:
+        self.state_index = new
+        self._metric.state(self.STATES[new])
+        event = {
+            "kind": "shed_ladder_transition",
+            "check": self.name,
+            "from": self.STATES[old],
+            "to": self.STATES[new],
+            "backlog": round(backlog, 1),
+        }
+        if self._events is not None:
+            self._events(event)
 
 
 # ---------------------------------------------------------------------------

@@ -433,3 +433,39 @@ DEADLINE_RELEASES = _series(
     "reached), deadline (latency budget spent), flush (idle/teardown)",
     RELEASE_LABELS,
 )
+
+# multi-tenant admission control (shed/, dmshed): the ingress overload
+# contract. Cardinality discipline — tenant-attributed series carry the
+# quota tier and a BOUNDED hashed tenant bucket (shed_tenant_buckets label
+# values), never raw tenant ids; exact per-tenant counts live behind
+# GET /admin/tenants. shed reasons: quota (that tenant's own token bucket
+# is empty) vs ladder (the global degradation ladder gated its whole
+# tier). The ladder Enum is the deterministic-overload state machine:
+# normal → shed_best_effort → shed_burst → emergency, climb fast / recover
+# slow like the watchdog (ops/alerts.yml DegradationLadderActive).
+SHED_LABELS = ("component_type", "component_id", "tier", "tenant_bucket",
+               "reason")
+SHED_FRAMES = _series(
+    Counter, "shed_frames_total",
+    "Ingress frames refused by admission control, by quota tier, hashed "
+    "tenant bucket, and reason: quota (tenant over its own token bucket) "
+    "or ladder (tier gated by the degradation ladder)",
+    SHED_LABELS)
+ADMIT_LABELS = ("component_type", "component_id", "tier", "tenant_bucket")
+ADMITTED_FRAMES = _series(
+    Counter, "admitted_frames_total",
+    "Ingress frames admitted past admission control, by quota tier and "
+    "hashed tenant bucket",
+    ADMIT_LABELS)
+SHED_NACKS = _series(
+    Counter, "shed_nacks_total",
+    "Structured retry-after NACK replies sent for refused frames in "
+    "reply mode (admission shed or drop-mode overflow) — the sender-"
+    "visible twin of shed_frames_total",
+)
+SHED_LADDER_STATE = _series(
+    Enum, "shed_ladder_state",
+    "The global overload degradation ladder: which tiers ingress "
+    "admission currently sheds",
+    states=["normal", "shed_best_effort", "shed_burst", "emergency"],
+)

@@ -238,18 +238,24 @@ class _ChainRaws:
 class _BatchCoalescer:
     """Deadline-aware row accumulator between the engine and the device.
 
-    Pure host-side FIFO bookkeeping, single-owner (only the engine thread
+    Pure host-side bookkeeping, single-owner (only the engine thread
     touches it, like the rest of the dispatch path — no lock). Rows arrive
-    as (tokens, raws) segments stamped with their arrival time; ``take``
-    pops the oldest ``n`` rows across segment boundaries, preserving both
-    order and each remainder segment's original arrival stamp (the
-    deadline is per-ROW age, not per-call). The release POLICY — target
-    occupancy, warm-bucket choice, retirement — lives in the detector,
-    which owns the warm set and the XLA ledger."""
+    as (tokens, raws) segments stamped with their arrival time and the
+    ingress frame's tenant; ``take`` pops ``n`` rows, preserving each
+    remainder segment's original arrival stamp (the deadline is per-ROW
+    age, not per-call). With one tenant (the anonymous ``None`` default)
+    release order is plain FIFO — byte-identical to the pre-tenant
+    behavior. With several, releases are DEFICIT ROUND-ROBIN across the
+    per-tenant queues (equal quanta), so a tenant holding thousands of
+    rows cannot monopolize a device batch: every active tenant lands
+    ~n/T rows per release while order stays FIFO within each tenant.
+    The release POLICY — target occupancy, warm-bucket choice,
+    retirement — lives in the detector, which owns the warm set and the
+    XLA ledger."""
 
     __slots__ = ("deadline_s", "target_occupancy", "releases", "rows_in",
                  "max_wait_s", "wait_sum_s", "wait_n", "retired_total",
-                 "_segs", "_total")
+                 "_q", "_rr", "_deficit", "_total")
 
     def __init__(self, deadline_s: float, target_occupancy: float) -> None:
         from collections import deque
@@ -262,48 +268,94 @@ class _BatchCoalescer:
         self.wait_sum_s = 0.0
         self.wait_n = 0
         self.retired_total = 0
-        self._segs: Any = deque()   # (t_arrival, tokens [k, S], raws)
+        # tenant -> deque of (t_arrival, tokens [k, S], raws); queues are
+        # pruned when emptied so the table tracks ACTIVE tenants only
+        self._q: Any = {}
+        self._rr: Any = deque()      # round-robin rotation over _q keys
+        self._deficit: Any = {}      # tenant -> carried DRR deficit (rows)
         self._total = 0
 
     def __len__(self) -> int:
         return self._total
 
-    def add(self, tokens: np.ndarray, raws, now: float) -> None:
+    def add(self, tokens: np.ndarray, raws, now: float,
+            tenant: Optional[str] = None) -> None:
         if not len(tokens):
             return
-        self._segs.append((now, tokens, raws))
+        q = self._q.get(tenant)
+        if q is None:
+            from collections import deque
+
+            q = self._q[tenant] = deque()
+            self._rr.append(tenant)
+        q.append((now, tokens, raws))
         self._total += len(tokens)
         self.rows_in += len(tokens)
 
     def oldest_age(self, now: float) -> float:
-        return 0.0 if not self._segs else max(0.0, now - self._segs[0][0])
+        heads = [q[0][0] for q in self._q.values() if q]
+        return 0.0 if not heads else max(0.0, now - min(heads))
 
     def due(self, now: float) -> bool:
         """True once the oldest row's wait APPROACHES the deadline: release
         one drain tick (deadline/4, the exported engine poll hint) early,
         so the wait lands at ~the budget instead of one tick past it."""
-        if not self._segs:
+        if not self._total:
             return False
         return self.oldest_age(now) >= self.deadline_s * 0.75
 
+    def held_by_tenant(self) -> Dict[str, int]:
+        """Held-row depth per tenant (admin/bench visibility; the anonymous
+        tenant reports as ``"default"``)."""
+        return {(t if t is not None else "default"):
+                sum(len(seg[1]) for seg in q)
+                for t, q in self._q.items()}
+
     def take(self, n: int):
-        """Pop the ``n`` oldest rows → (tokens [n, S], raws, t_oldest)."""
-        t_oldest = self._segs[0][0]
+        """Pop ``n`` rows → (tokens [n, S], raws, t_oldest).
+
+        The round starts at the tenant holding the globally-oldest row, so
+        a deadline release always carries the row that tripped it; each
+        visited tenant then serves up to quantum (+ carried deficit) rows
+        before the rotation moves on. An emptied queue forfeits its
+        carried deficit (classic DRR) and leaves the rotation."""
+        quantum = max(1, n // max(1, len(self._rr)))
+        oldest_key = min(self._q, key=lambda k: self._q[k][0][0])
+        while self._rr[0] != oldest_key:
+            self._rr.rotate(-1)
         parts, raw_segs, got = [], [], 0
+        t_oldest = None
         while got < n:
-            t, tok, raws = self._segs.popleft()
-            want = n - got
-            if want < len(tok):
-                parts.append(tok[:want])
-                raw_segs.append(raws[:want])
-                # the remainder keeps ITS arrival stamp — splitting a call's
-                # rows across releases must not reset their deadline clock
-                self._segs.appendleft((t, tok[want:], raws[want:]))
-                got = n
+            key = self._rr[0]
+            q = self._q[key]
+            deficit = self._deficit.get(key, 0) + quantum
+            take_rows = min(deficit, n - got)
+            served = 0
+            while q and served < take_rows:
+                t, tok, raws = q.popleft()
+                if t_oldest is None or t < t_oldest:
+                    t_oldest = t
+                want = take_rows - served
+                if want < len(tok):
+                    parts.append(tok[:want])
+                    raw_segs.append(raws[:want])
+                    # the remainder keeps ITS arrival stamp — splitting a
+                    # call's rows across releases must not reset their
+                    # deadline clock
+                    q.appendleft((t, tok[want:], raws[want:]))
+                    served += want
+                else:
+                    parts.append(tok)
+                    raw_segs.append(raws)
+                    served += len(tok)
+            got += served
+            if q:
+                self._deficit[key] = deficit - served
+                self._rr.rotate(-1)
             else:
-                parts.append(tok)
-                raw_segs.append(raws)
-                got += len(tok)
+                self._rr.popleft()
+                self._deficit.pop(key, None)
+                del self._q[key]
         self._total -= n
         tokens = parts[0] if len(parts) == 1 else np.concatenate(parts)
         raws = raw_segs[0] if len(raw_segs) == 1 else _ChainRaws(raw_segs)
@@ -378,6 +430,10 @@ class JaxScorerDetector(CoreDetector):
         # warm-up or _warm_device_bucket), so coalesced dispatch can never
         # page as an unexpected recompile.
         self._coalescer: Optional[_BatchCoalescer] = None
+        # tenant of the CURRENT ingress frame (engine note_tenant seam):
+        # coalescer.add segments held rows by it so releases stay
+        # weighted-fair across tenants (dmshed). Engine-thread-owned.
+        self._ingress_tenant: Optional[str] = None
         self._device_warm: set = set()        # pre-warmed device buckets
         self._retired_buckets: set = set()    # retired from the active set
         self._retired_hits: Dict[int, int] = {}   # best-fit pressure window
@@ -965,6 +1021,14 @@ class JaxScorerDetector(CoreDetector):
             self._featurize_pb_into(msg, tokens[i])
             ok[i] = True
 
+    def note_tenant(self, tenant: Optional[str]) -> None:
+        """Engine seam (dmshed): the tenant the CURRENT ingress frame was
+        attributed to — rows added to the coalescer until the next call are
+        segmented under it, which is what makes releases weighted-fair.
+        ``None`` clears the attribution (anonymous frame). Called on the
+        engine thread, per frame, before the frame's messages arrive."""
+        self._ingress_tenant = tenant
+
     def process_batch(self, batch: List[bytes]) -> List[Optional[bytes]]:
         """Batched hot path: one featurize kernel + one jit call per
         micro-batch, preserving the per-message in-order None-filtering
@@ -1023,7 +1087,8 @@ class JaxScorerDetector(CoreDetector):
             if coalescer is not None:
                 # continuous batching: hold the rows toward a warm bucket;
                 # _coalesce_pump below decides what (if anything) dispatches
-                coalescer.add(det_tokens, det_raws, time.monotonic())
+                coalescer.add(det_tokens, det_raws, time.monotonic(),
+                              tenant=self._ingress_tenant)
             else:
                 self._dispatch(det_tokens, det_raws)
             self._count_device_lines(n)
@@ -1119,7 +1184,8 @@ class JaxScorerDetector(CoreDetector):
             if coalescer is not None:
                 # SpanRaws segments stay lazy inside the coalescer — no
                 # per-message bytes objects until alert construction
-                coalescer.add(tokens, raws, time.monotonic())
+                coalescer.add(tokens, raws, time.monotonic(),
+                              tenant=self._ingress_tenant)
             else:
                 self._dispatch(tokens, raws)
             self._count_device_lines(n_ok)
@@ -1528,6 +1594,7 @@ class JaxScorerDetector(CoreDetector):
             "mean_wait_s": (round(co.wait_sum_s / co.wait_n, 6)
                             if co is not None and co.wait_n else 0.0),
             "buckets_retired_total": 0 if co is None else co.retired_total,
+            "held_by_tenant": {} if co is None else co.held_by_tenant(),
             "dispatches": occ_n,
             "occupancy_sum": round(occ_sum, 4),
             "occupancy_mean": round(occ_sum / occ_n, 4) if occ_n else None,

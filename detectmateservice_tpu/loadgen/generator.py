@@ -31,10 +31,13 @@ from typing import Any, Callable, Dict, Optional
 from ..engine import metrics as m
 from ..engine.framing import (
     MAGIC_SHM,
+    MAGIC_TEN,
     TraceContext,
     pack_batch,
     unpack_batch,
+    unwrap_tenant,
     unwrap_trace,
+    wrap_tenant,
     wrap_trace,
 )
 from ..engine.socket import TransportError, TransportTimeout, make_socket_factory
@@ -87,6 +90,7 @@ class LoadProfile:
     seed: int = 7
     settle_s: float = 5.0           # post-send drain window before loss counts
     warm_lines: int = 0             # untraced preamble (scorer training)
+    tenant: Optional[str] = None    # dmshed: stamp every frame's tenant block
 
     @classmethod
     def from_payload(cls, payload: Dict[str, Any]) -> "LoadProfile":
@@ -115,6 +119,7 @@ class LoadProfile:
             "rate": self.rate, "burst": self.burst, "seconds": self.seconds,
             "mix": self.mix.to_dict(), "seed": self.seed,
             "settle_s": self.settle_s, "warm_lines": self.warm_lines,
+            "tenant": self.tenant,
         }
 
 
@@ -255,8 +260,13 @@ class LoadGenerator:
                 ctx = TraceContext.new(sched_ns)
                 wire = pack_batch(payloads)
                 lag = max(0.0, now - deadline)
+                framed = wrap_trace(wire, ctx)
+                if profile.tenant:
+                    # tenant block is the OUTERMOST wrapper: admission at
+                    # the next stage's ingress peels it before the trace
+                    framed = wrap_tenant(framed, profile.tenant)
                 try:
-                    self._send_sock.send(wrap_trace(wire, ctx))
+                    self._send_sock.send(framed)
                 except TransportError as exc:
                     self.logger.warning("loadgen send failed: %s", exc)
                     # the frame never left: it is client-visible loss and
@@ -289,8 +299,11 @@ class LoadGenerator:
         for start in range(0, len(rows), burst):
             if self._stop.is_set():
                 return
+            wire = pack_batch(rows[start:start + burst])
+            if self.profile.tenant:
+                wire = wrap_tenant(wire, self.profile.tenant)
             try:
-                self._send_sock.send(pack_batch(rows[start:start + burst]))
+                self._send_sock.send(wire)
             except TransportError as exc:
                 self.logger.warning("loadgen warmup send failed: %s", exc)
 
@@ -318,6 +331,13 @@ class LoadGenerator:
                 self.logger.warning("collector received a shm reference "
                                     "frame it cannot resolve; dropped")
                 continue
+            if raw.startswith(MAGIC_TEN):
+                # tenant block is outermost: peel it or the trace id (and
+                # with it the loss accounting) is invisible underneath
+                try:
+                    raw, _tenant, _damaged = unwrap_tenant(raw)
+                except Exception:
+                    continue
             ctx = None
             try:
                 payload, ctx, _damaged = unwrap_trace(raw)

@@ -10,13 +10,18 @@ policies hold no locks and allocate nothing beyond what the choice needs.
 * ``least_backlog`` — the default: route to the replica with the fewest
   unacked frames in its credit window, ties broken by the last-polled
   ingress backlog (``engine_ingress_backlog`` piggybacked on the
-  supervisor's watermark poll), then by rotation. Lexicographic on
-  purpose: inflight is the router's OWN live knowledge in frames, backlog
-  a stale poll in messages — summing them lets hundreds of backlog
-  messages drown out the signal that actually predicts queueing, the
-  unacked window. Under even replicas this degenerates to round robin;
-  under a slow replica it shifts traffic away *before* the credit window
-  hard-stops dispatch.
+  supervisor's watermark poll), then by the frame's tenant's recent
+  dispatch spread, then by rotation. Lexicographic on purpose: inflight
+  is the router's OWN live knowledge in frames, backlog a stale poll in
+  messages — summing them lets hundreds of backlog messages drown out the
+  signal that actually predicts queueing, the unacked window. The tenant
+  tie-break (dmshed) spreads ONE tenant's frames across equally-loaded
+  replicas, so a hot tenant queues behind the fleet, not behind itself —
+  accounting is per bounded tenant bucket (crc32, like the metric
+  labels), decayed so it tracks RECENT traffic, never history. Under
+  even replicas and no tenant attribution this degenerates to round
+  robin; under a slow replica it shifts traffic away *before* the credit
+  window hard-stops dispatch.
 * ``sticky_trace``  — rendezvous (highest-random-weight) hash of the PR-1
   trace id over the replica set: one source's frames stay on one replica
   (per-source ordering holds there) while it is dispatchable, and only
@@ -25,7 +30,8 @@ policies hold no locks and allocate nothing beyond what the choice needs.
 """
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 class RoundRobinPolicy:
@@ -34,7 +40,8 @@ class RoundRobinPolicy:
     def __init__(self) -> None:
         self._next = 0
 
-    def pick(self, replicas: Sequence, trace_id: Optional[int]) -> Optional[Any]:
+    def pick(self, replicas: Sequence, trace_id: Optional[int],
+             tenant: Optional[str] = None) -> Optional[Any]:
         if not replicas:
             return None
         choice = replicas[self._next % len(replicas)]
@@ -45,22 +52,44 @@ class RoundRobinPolicy:
 class LeastBacklogPolicy:
     name = "least_backlog"
 
+    # tenant accounting is bounded by construction: counts live per
+    # (tenant bucket, replica index), never per raw tenant id, and are
+    # halved every _DECAY_EVERY attributed picks so the table reflects
+    # recent traffic (an idle tenant's history cannot skew a later choice)
+    _TENANT_BUCKETS = 32
+    _DECAY_EVERY = 256
+
     def __init__(self) -> None:
         self._next = 0
+        self._picks = 0
+        self._recent: Dict[Tuple[int, int], int] = {}
 
-    def pick(self, replicas: Sequence, trace_id: Optional[int]) -> Optional[Any]:
+    def pick(self, replicas: Sequence, trace_id: Optional[int],
+             tenant: Optional[str] = None) -> Optional[Any]:
         if not replicas:
             return None
         # rotating start index breaks ties fairly without a second pass
         start = self._next % len(replicas)
         self._next = (self._next + 1) % (1 << 30)
+        bucket = (None if tenant is None else
+                  zlib.crc32(tenant.encode("utf-8")) % self._TENANT_BUCKETS)
         best = None
         best_load = None
         for i in range(len(replicas)):
             replica = replicas[(start + i) % len(replicas)]
-            load = (replica.inflight, replica.backlog)
+            recent = (0 if bucket is None else
+                      self._recent.get((bucket, replica.index), 0))
+            load = (replica.inflight, replica.backlog, recent)
             if best_load is None or load < best_load:
                 best, best_load = replica, load
+        if bucket is not None and best is not None:
+            key = (bucket, best.index)
+            self._recent[key] = self._recent.get(key, 0) + 1
+            self._picks += 1
+            if self._picks >= self._DECAY_EVERY:
+                self._picks = 0
+                self._recent = {k: v >> 1
+                                for k, v in self._recent.items() if v > 1}
         return best
 
 
@@ -79,7 +108,8 @@ class StickyTracePolicy:
         # untraced frames (no v2 header) cannot stick — rotate them
         self._fallback = RoundRobinPolicy()
 
-    def pick(self, replicas: Sequence, trace_id: Optional[int]) -> Optional[Any]:
+    def pick(self, replicas: Sequence, trace_id: Optional[int],
+             tenant: Optional[str] = None) -> Optional[Any]:
         if not replicas:
             return None
         if trace_id is None:

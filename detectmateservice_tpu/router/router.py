@@ -34,7 +34,7 @@ from collections import deque
 from typing import Any, Callable, List, Optional
 
 from ..engine import metrics as m
-from ..engine.framing import peek_trace_id
+from ..engine.framing import peek_tenant_id, peek_trace_id
 from ..engine.socket import TransportAgain, TransportError
 from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
 from ..utils.threadcheck import assert_affinity
@@ -131,6 +131,10 @@ class ReplicaRouter:
         lock acquire per pick, sends outside the lock."""
         assert_affinity("engine")
         trace_id = peek_trace_id(wire) if self._sticky else None
+        # one startswith probe for tenant-unattributed frames — the policy's
+        # tenant tie-break (least_backlog) spreads a hot tenant's frames
+        # across equally-loaded replicas (dmshed)
+        tenant = peek_tenant_id(wire)
         retries = 0
         tried: set = set()
         while True:
@@ -140,7 +144,7 @@ class ReplicaRouter:
                               and r.sock is not None
                               and len(r.window) < self._credit
                               and r.index not in tried]
-                choice = self._policy.pick(candidates, trace_id)
+                choice = self._policy.pick(candidates, trace_id, tenant)
                 sock = choice.sock if choice is not None else None
             if choice is None:
                 # every dispatchable replica was tried (or none exists):
@@ -247,7 +251,8 @@ class ReplicaRouter:
                               and len(r.window) < self._credit]
                 choice = self._policy.pick(
                     candidates,
-                    peek_trace_id(wire) if self._sticky else None)
+                    peek_trace_id(wire) if self._sticky else None,
+                    peek_tenant_id(wire))
                 sock = choice.sock if choice is not None else None
             if choice is None:
                 return

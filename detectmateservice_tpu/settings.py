@@ -363,6 +363,43 @@ class ServiceSettings(BaseModel):
     wal_retain_bytes: int = Field(default=1024 * 1024 * 1024, ge=4096)
     wal_retain_age_s: float = Field(default=86400.0, gt=0.0)
 
+    # -- multi-tenant admission control: dmshed (shed/) -------------------
+    # When true, the engine ingress runs per-tenant token-bucket admission
+    # BEFORE spooling/processing each frame: frames carry an optional
+    # tenant block (engine/framing.py MAGIC_TEN), quotas come from
+    # tenants_file (or the tenant_default_* fields for unmapped/anonymous
+    # tenants), and refused frames are counted + shed instead of growing
+    # an unbounded backlog (docs/overload.md). Off (the default) leaves
+    # the hot path byte-identical to the pre-shed build — the tenant
+    # block, when present, is still stripped cleanly.
+    shed_enabled: bool = False
+    # tenants.yaml quota map: tier + rate (sustained lines/s) + burst
+    # headroom per tenant, with a 'default' entry for unmapped tenants.
+    # None = every tenant rides the tenant_default_* quota below.
+    tenants_file: Optional[str] = None
+    tenant_default_tier: str = Field(
+        default="best_effort", pattern="^(guaranteed|burst|best_effort)$")
+    tenant_default_rate: float = Field(default=10000.0, gt=0.0)
+    # None = 2x tenant_default_rate (one second of doubled arrivals)
+    tenant_default_burst: Optional[float] = Field(default=None, gt=0.0)
+    # cardinality bound for the tenant_bucket metric label: tenant ids
+    # hash into this many stable buckets (never per-tenant label values)
+    shed_tenant_buckets: int = Field(default=16, ge=1, le=256)
+    # retry hint stamped into the structured NACK a refused frame gets in
+    # reply mode (never an empty reply — the dm_nack payload carries
+    # reason, tier, and this backoff)
+    shed_retry_after_ms: float = Field(default=100.0, ge=0.0, le=60000.0)
+    # global degradation ladder (engine/health.py DegradationLadder):
+    # aggregate process backlog (detector pending + router unacked + spool
+    # depth) at which the ladder climbs to shed_best_effort / shed_burst /
+    # emergency. Climb is immediate to the highest exceeded threshold;
+    # recovery steps down one state per shed_ladder_recovery_intervals
+    # consecutive clean watchdog evaluations (watchdog-style hysteresis).
+    shed_ladder_backlog_t1: float = Field(default=256.0, gt=0.0)
+    shed_ladder_backlog_t2: float = Field(default=1024.0, gt=0.0)
+    shed_ladder_backlog_t3: float = Field(default=4096.0, gt=0.0)
+    shed_ladder_recovery_intervals: int = Field(default=2, ge=1)
+
     # -- self-diagnosis (engine/health.py) --------------------------------
     # "json" renders every log record as one JSON object per line (component
     # identity + message + attached structured event), for fleet log
@@ -441,6 +478,23 @@ class ServiceSettings(BaseModel):
         if self.durable_ingress and not self.wal_dir:
             raise ValueError(
                 "durable_ingress requires wal_dir (the WAL spool directory)")
+        return self
+
+    # -- shed cross-validation --------------------------------------------
+    @model_validator(mode="after")
+    def _check_shed(self) -> "ServiceSettings":
+        if not (self.shed_ladder_backlog_t1 <= self.shed_ladder_backlog_t2
+                <= self.shed_ladder_backlog_t3):
+            raise ValueError(
+                "shed ladder thresholds must be ordered t1 <= t2 <= t3 "
+                f"({self.shed_ladder_backlog_t1} / "
+                f"{self.shed_ladder_backlog_t2} / "
+                f"{self.shed_ladder_backlog_t3})")
+        if (self.tenant_default_burst is not None
+                and self.tenant_default_burst < self.tenant_default_rate):
+            raise ValueError(
+                "tenant_default_burst must be >= tenant_default_rate "
+                f"({self.tenant_default_burst} < {self.tenant_default_rate})")
         return self
 
     # -- TLS cross-validation (reference: settings.py:116-132) ------------

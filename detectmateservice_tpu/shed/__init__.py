@@ -1,0 +1,39 @@
+"""dmshed: multi-tenant admission control and deterministic overload shedding.
+
+The reference service degrades under overload by unbounded backlog: every
+stage is an anonymous single-tenant stream, so one hot source starves
+everyone. This package gives the engine ingress a DAGOR-style admission
+layer — per-tenant token buckets grouped into priority tiers, loaded from a
+``tenants.yaml`` quota map — so overload degrades *deterministically*:
+shed early, at ingress, by priority, and keep victims inside SLO.
+
+* :mod:`quota`     — the quota map (tenants.yaml loader), token buckets
+  with an injected clock, and the bounded tenant→bucket label hash.
+* :mod:`admission` — the per-frame admit/shed decision the engine hot loop
+  calls, its hoisted metric children, per-tenant counters (in-process,
+  bounded — never prometheus labels), and the rate-limited ``load_shed``
+  structured event.
+
+The global degradation ladder (normal → shed-best-effort → shed-burst →
+emergency) lives in :mod:`engine.health` with the other watchdog checks;
+admission reads its integer state per frame (a GIL-atomic attribute read).
+"""
+from .admission import AdmissionController
+from .quota import (
+    TIERS,
+    QuotaMap,
+    TenantQuota,
+    TokenBucket,
+    load_quota_map,
+    tenant_bucket,
+)
+
+__all__ = [
+    "AdmissionController",
+    "QuotaMap",
+    "TIERS",
+    "TenantQuota",
+    "TokenBucket",
+    "load_quota_map",
+    "tenant_bucket",
+]

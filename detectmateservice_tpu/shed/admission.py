@@ -1,0 +1,213 @@
+"""The per-frame admission decision the engine ingress runs.
+
+Placement contract (engine.py ``_expand_frame``): admission runs after shm
+resolution (the decision needs the real frame) and BEFORE the durable-spool
+append and all processing — a shed frame costs one peek + one bucket take
+and is never made durable, never parsed, never batched. DAGOR's lesson
+applied: shedding is only cheap if it happens at the front door.
+
+Two shed reasons:
+
+* ``quota``  — the tenant's own token bucket is empty (it alone is over
+  its sustained rate + burst headroom);
+* ``ladder`` — the global degradation ladder (engine/health.py) gated the
+  tenant's whole TIER because the process is overloaded, regardless of the
+  tenant's individual credit.
+
+Cardinality discipline: the prometheus series carry ``tier`` and the
+bounded ``tenant_bucket`` hash (quota.tenant_bucket), never raw tenant
+ids. Exact per-tenant admitted/shed counts live in a bounded in-process
+table served by ``GET /admin/tenants`` — that is also what the
+noisy_neighbor soak gates its "shed on the aggressor only" verdict on.
+
+Threading: ``admit`` is engine-thread-only (single owner, no lock, like
+the rest of the hot loop); ``snapshot`` reads plain ints/dicts from admin
+threads — GIL-atomic reads of a monotonically growing table, so a
+snapshot is internally approximate but never corrupt.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..engine import metrics as m
+from .quota import TIERS, QuotaMap, TokenBucket, tenant_bucket
+
+# ladder states, index order == severity; admission maps the index to the
+# highest tier index still admitted (see _LADDER_MAX_TIER)
+LADDER_STATES = ("normal", "shed_best_effort", "shed_burst", "emergency")
+# state index -> highest admitted tier index (guaranteed=0, burst=1,
+# best_effort=2); emergency additionally revokes burst headroom below
+_LADDER_MAX_TIER = {0: 2, 1: 1, 2: 0, 3: 0}
+
+_EVENT_INTERVAL_S = 1.0   # per-tier load_shed event rate limit
+_MAX_TRACKED_TENANTS = 1024   # bounded per-tenant counter table
+_OVERFLOW_KEY = "_other"
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        quota_map: QuotaMap,
+        labels: Dict[str, str],
+        *,
+        buckets: int = 16,
+        retry_after_ms: float = 100.0,
+        ladder: Optional[Any] = None,
+        events: Optional[Callable[[Dict[str, Any]], Any]] = None,
+        logger: Optional[logging.Logger] = None,
+    ) -> None:
+        self.quota_map = quota_map
+        self._labels = dict(labels)
+        self._buckets = max(1, buckets)
+        self.retry_after_ms = retry_after_ms
+        self._ladder = ladder
+        self._events = events
+        self._logger = logger or logging.getLogger("shed")
+        # per-tenant token buckets, created on first frame from each tenant
+        self._tenant_buckets: Dict[str, TokenBucket] = {}
+        # exact per-tenant counters (in-process, bounded): tenant ->
+        # [admitted_frames, shed_frames]; tenants past the cap aggregate
+        # under _OVERFLOW_KEY so the table cannot grow with the population
+        self._tenant_counts: Dict[str, list] = {}
+        # per-tier roll-ups for /admin/tenants and the smoke/soak gates
+        self.tier_admitted = {tier: 0 for tier in TIERS}
+        self.tier_shed = {tier: 0 for tier in TIERS}
+        # hoisted metric children (DM-H001): label resolution happens here
+        # and on first sight of a (tier, bucket) pair, never per frame
+        self._m_shed: Dict[Tuple[str, str, str], Any] = {}
+        self._m_admitted: Dict[Tuple[str, str], Any] = {}
+        self._last_event_t = {tier: -_EVENT_INTERVAL_S for tier in TIERS}
+
+    # -- the hot-path decision -------------------------------------------
+    # dmlint: thread(engine)
+    def admit(self, tenant: Optional[str], cost: int,
+              now: float) -> Tuple[bool, Optional[str], str]:
+        """One frame's admission decision → ``(admitted, reason, tier)``.
+
+        ``tenant`` None means the frame carried no (or a damaged) tenant
+        block — it is admitted under the default quota as the anonymous
+        tenant. ``cost`` is the frame's message count (the engine's cheap
+        header estimate); a zero/garbled count still meters one token so
+        an attacker cannot ride free on damaged headers."""
+        name = tenant if tenant is not None else self.quota_map.default.name
+        quota = self.quota_map.lookup(name)
+        ladder_index = self._ladder_index()
+        if quota.tier_index > _LADDER_MAX_TIER[ladder_index]:
+            self._count(name, quota.tier, False, "ladder", ladder_index)
+            return False, "ladder", quota.tier
+        bucket = self._tenant_buckets.get(name)
+        if bucket is None:
+            bucket = quota.make_bucket(now)
+            self._tenant_buckets[name] = bucket
+        # emergency revokes burst headroom: even a guaranteed tenant is
+        # clamped to ~1 s of sustained refill, so the recovering process
+        # cannot be re-buried by banked credit the moment it climbs down
+        cap = quota.rate if ladder_index >= 3 else None
+        if not bucket.take(max(1, cost), now, cap=cap):
+            self._count(name, quota.tier, False, "quota", ladder_index)
+            return False, "quota", quota.tier
+        self._count(name, quota.tier, True, None, ladder_index)
+        return True, None, quota.tier
+
+    def _ladder_index(self) -> int:
+        ladder = self._ladder
+        if ladder is None:
+            return 0
+        # GIL-atomic int read; the ladder check mutates it on the watchdog
+        # thread, admission reads it per frame on the engine thread
+        index = ladder.state_index
+        return index if 0 <= index < len(LADDER_STATES) else 0
+
+    def _count(self, tenant: str, tier: str, admitted: bool,
+               reason: Optional[str], ladder_index: int) -> None:
+        bucket_label = tenant_bucket(tenant, self._buckets)
+        # dmlint: ignore[DM-A002] single-writer (engine) GIL-atomic bumps; the admin snapshot only reads, worst case one stale counter
+        counts = self._tenant_counts.get(tenant)
+        if counts is None:
+            if len(self._tenant_counts) >= _MAX_TRACKED_TENANTS:
+                tenant = _OVERFLOW_KEY
+                counts = self._tenant_counts.setdefault(tenant, [0, 0])
+            else:
+                counts = self._tenant_counts[tenant] = [0, 0]
+        if admitted:
+            counts[0] += 1
+            self.tier_admitted[tier] += 1
+            child = self._m_admitted.get((tier, bucket_label))
+            if child is None:
+                child = m.ADMITTED_FRAMES().labels(
+                    tier=tier, tenant_bucket=bucket_label, **self._labels)
+                self._m_admitted[(tier, bucket_label)] = child
+            child.inc()
+            return
+        counts[1] += 1
+        self.tier_shed[tier] += 1
+        key = (tier, bucket_label, reason or "quota")
+        child = self._m_shed.get(key)
+        if child is None:
+            child = m.SHED_FRAMES().labels(
+                tier=tier, tenant_bucket=bucket_label,
+                reason=reason or "quota", **self._labels)
+            self._m_shed[key] = child
+        child.inc()
+        self._maybe_emit(tenant, tier, reason or "quota", ladder_index)
+
+    def _maybe_emit(self, tenant: str, tier: str, reason: str,
+                    ladder_index: int) -> None:
+        """Rate-limited structured event: a shed storm must be visible in
+        the event ring without turning the ring into a per-frame log."""
+        now = time.monotonic()
+        if now - self._last_event_t[tier] < _EVENT_INTERVAL_S:
+            return
+        self._last_event_t[tier] = now
+        event = {
+            "kind": "load_shed",
+            "tenant_bucket": tenant_bucket(tenant, self._buckets),
+            "tier": tier,
+            "reason": reason,
+            "ladder_state": LADDER_STATES[ladder_index],
+            "tier_shed_total": self.tier_shed[tier],
+        }
+        if self._events is not None:
+            self._events(event)
+        else:
+            self._logger.warning("load_shed: %s", event)
+
+    # -- NACK payload (reply-mode overflow/shed) -------------------------
+    def nack_payload(self, reason: str, tier: Optional[str],
+                     tenant: Optional[str]) -> Dict[str, Any]:
+        """The structured retry-after NACK body the engine sends back in
+        reply mode instead of an empty reply (docs/overload.md)."""
+        return {
+            "dm_nack": {
+                "reason": reason,
+                "tier": tier,
+                "tenant": tenant,
+                "retry_after_ms": self.retry_after_ms,
+            }
+        }
+
+    # -- admin plane ------------------------------------------------------
+    # dmlint: thread(admin)
+    def snapshot(self, limit: int = 64) -> Dict[str, Any]:
+        ladder_index = self._ladder_index()
+        tenants = {}
+        for name, counts in sorted(self._tenant_counts.items()):
+            if len(tenants) >= limit:
+                break
+            quota = self.quota_map.lookup(name)
+            tenants[name] = {
+                "tier": quota.tier,
+                "admitted_frames": counts[0],
+                "shed_frames": counts[1],
+            }
+        return {
+            "ladder_state": LADDER_STATES[ladder_index],
+            "tiers": {tier: {"admitted_frames": self.tier_admitted[tier],
+                             "shed_frames": self.tier_shed[tier]}
+                      for tier in TIERS},
+            "tenants": tenants,
+            "tracked_tenants": len(self._tenant_counts),
+            "quota": self.quota_map.snapshot(),
+        }

@@ -1,0 +1,193 @@
+"""Tenant quotas: tiers, token buckets, and the tenants.yaml quota map.
+
+A tenant's quota is a classic token bucket — ``rate`` tokens/s of sustained
+refill up to ``burst`` tokens of headroom — plus a priority ``tier`` the
+degradation ladder gates on. One token admits one message (the engine
+meters frames by their header message count), so quotas are written in the
+same lines/s unit every throughput series uses.
+
+All bucket arithmetic takes an explicit ``now`` (the engine passes its loop
+clock; tests inject a fake one) — no hidden ``time`` calls, so refill math
+is exactly reproducible under test.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, Optional
+
+import yaml
+
+# priority tiers, highest first: the ladder sheds from the BACK of this
+# tuple (best_effort first, guaranteed never)
+TIERS = ("guaranteed", "burst", "best_effort")
+TIER_INDEX = {name: index for index, name in enumerate(TIERS)}
+
+DEFAULT_TENANT = "default"
+
+
+class QuotaError(ValueError):
+    """tenants.yaml is malformed (unknown tier, non-positive rate, ...)."""
+
+
+def tenant_bucket(tenant: str, buckets: int) -> str:
+    """Stable hash of a tenant id into one of ``buckets`` label values.
+
+    Metric cardinality discipline: per-tenant label values would make
+    series cardinality follow the tenant population (thousands), so every
+    tenant-attributed series carries this bounded bucket instead. crc32,
+    not ``hash()`` — Python string hashing is salted per process and the
+    bucket must agree across restarts and replicas."""
+    return str(zlib.crc32(tenant.encode("utf-8")) % max(1, buckets))
+
+
+class TokenBucket:
+    """Sustained ``rate`` tokens/s with ``burst`` tokens of headroom.
+
+    Lazy refill on ``take``: no timer thread, one float multiply per call.
+    ``cap`` clamps the spendable level below ``burst`` — the ladder's
+    emergency state uses it to revoke burst headroom (a guaranteed tenant
+    keeps its sustained rate but cannot draw down banked credit)."""
+
+    __slots__ = ("rate", "burst", "level", "last")
+
+    def __init__(self, rate: float, burst: float, now: float = 0.0) -> None:
+        self.rate = float(rate)
+        self.burst = max(float(burst), float(rate))
+        self.level = self.burst  # start full: a fresh tenant gets its burst
+        self.last = now
+
+    def refill(self, now: float) -> None:
+        elapsed = now - self.last
+        if elapsed > 0:
+            self.level = min(self.burst, self.level + elapsed * self.rate)
+        self.last = now
+
+    def take(self, tokens: float, now: float,
+             cap: Optional[float] = None) -> bool:
+        """Spend ``tokens`` if available; False leaves the level untouched
+        (a shed frame must not also drain the tenant's credit)."""
+        self.refill(now)
+        available = self.level if cap is None else min(self.level, cap)
+        if tokens > available:
+            return False
+        self.level -= tokens
+        return True
+
+
+class TenantQuota:
+    """One tenant's configured quota: tier + bucket geometry."""
+
+    __slots__ = ("name", "tier", "rate", "burst")
+
+    def __init__(self, name: str, tier: str, rate: float,
+                 burst: Optional[float] = None) -> None:
+        if tier not in TIER_INDEX:
+            raise QuotaError(
+                f"tenant {name!r}: unknown tier {tier!r}; expected one of "
+                f"{TIERS}")
+        if rate <= 0:
+            raise QuotaError(f"tenant {name!r}: rate must be > 0, got {rate}")
+        self.name = name
+        self.tier = tier
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else 2.0 * self.rate
+        if self.burst < self.rate:
+            raise QuotaError(
+                f"tenant {name!r}: burst ({self.burst}) must be >= rate "
+                f"({self.rate})")
+
+    @property
+    def tier_index(self) -> int:
+        return TIER_INDEX[self.tier]
+
+    def make_bucket(self, now: float) -> TokenBucket:
+        return TokenBucket(self.rate, self.burst, now)
+
+
+class QuotaMap:
+    """The tenant → quota table, with a default quota for tenants the map
+    does not name (and for frames that carry no tenant block at all — the
+    single-tenant upgrade path: an unattributed pipeline is one anonymous
+    tenant under the default quota)."""
+
+    def __init__(self, default: TenantQuota,
+                 tenants: Optional[Dict[str, TenantQuota]] = None) -> None:
+        self.default = default
+        self.tenants: Dict[str, TenantQuota] = dict(tenants or {})
+
+    def lookup(self, tenant: str) -> TenantQuota:
+        return self.tenants.get(tenant, self.default)
+
+    def snapshot(self) -> Dict[str, Any]:
+        def _one(quota: TenantQuota) -> Dict[str, Any]:
+            return {"tier": quota.tier, "rate": quota.rate,
+                    "burst": quota.burst}
+        return {"default": _one(self.default),
+                "tenants": {name: _one(q)
+                            for name, q in sorted(self.tenants.items())}}
+
+
+def load_quota_map(path: str, *, default_tier: str = "best_effort",
+                   default_rate: float = 10000.0,
+                   default_burst: Optional[float] = None) -> QuotaMap:
+    """Parse a ``tenants.yaml`` quota map::
+
+        default:              # optional; falls back to the settings defaults
+          tier: best_effort
+          rate: 1000          # sustained lines/s
+          burst: 2000         # headroom tokens (default 2x rate)
+        tenants:
+          acme:
+            tier: guaranteed
+            rate: 5000
+          crawler:
+            tier: best_effort
+            rate: 200
+
+    Unknown keys, unknown tiers, and non-positive rates all fail the load —
+    a quota typo must stop the service at startup, not silently admit
+    everything under the default."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = yaml.safe_load(fh) or {}
+    if not isinstance(doc, dict):
+        raise QuotaError(f"quota map {path} must contain a mapping")
+    unknown = set(doc) - {"default", "tenants"}
+    if unknown:
+        raise QuotaError(
+            f"quota map {path}: unknown top-level keys {sorted(unknown)}")
+    default = _parse_quota(DEFAULT_TENANT, doc.get("default") or {},
+                           default_tier, default_rate, default_burst)
+    tenants: Dict[str, TenantQuota] = {}
+    entries = doc.get("tenants") or {}
+    if not isinstance(entries, dict):
+        raise QuotaError(f"quota map {path}: 'tenants' must be a mapping")
+    for name, body in entries.items():
+        # burst is NOT inherited from the default entry: an entry that
+        # names a rate but no burst gets 2x ITS OWN rate (the documented
+        # default), not the default tenant's absolute headroom
+        tenants[str(name)] = _parse_quota(
+            str(name), body or {}, default.tier, default.rate, None)
+    return QuotaMap(default, tenants)
+
+
+def default_quota_map(*, tier: str = "best_effort", rate: float = 10000.0,
+                      burst: Optional[float] = None) -> QuotaMap:
+    """The no-tenants.yaml map: every tenant rides the settings default."""
+    return QuotaMap(TenantQuota(DEFAULT_TENANT, tier, rate, burst))
+
+
+def _parse_quota(name: str, body: Dict[str, Any], tier: str, rate: float,
+                 burst: Optional[float]) -> TenantQuota:
+    if not isinstance(body, dict):
+        raise QuotaError(f"tenant {name!r}: entry must be a mapping")
+    unknown = set(body) - {"tier", "rate", "burst"}
+    if unknown:
+        raise QuotaError(
+            f"tenant {name!r}: unknown keys {sorted(unknown)}")
+    out_burst = body.get("burst", burst)
+    return TenantQuota(
+        name,
+        str(body.get("tier", tier)),
+        float(body.get("rate", rate)),
+        float(out_burst) if out_burst is not None else None,
+    )

@@ -160,6 +160,15 @@ def _replay_status(service, query, payload) -> Response:
     return Response(200, status)
 
 
+def _tenants(service, query, payload) -> Response:
+    admission = getattr(service, "admission", None)
+    if admission is None:
+        return Response(404, {"detail": "admission control is not enabled "
+                                        "on this stage (shed_enabled)"})
+    limit = _int_param(query, "limit", default=64)
+    return Response(200, admission.snapshot(limit=limit))
+
+
 def _profile_status(service, query, payload) -> Response:
     from ..utils.profiling import PROFILER
 
@@ -362,6 +371,9 @@ ROUTES: Tuple[Route, ...] = (
           "model lifecycle status (?history=1 for the checkpoint log)"),
     Route("GET", "/admin/replay", _replay_status,
           "WAL replay status + the live ingress spool's stats"),
+    Route("GET", "/admin/tenants", _tenants,
+          "admission control: per-tier/per-tenant admitted+shed counters "
+          "and the current degradation-ladder state"),
     Route("POST", "/admin/start", _start, "start the engine"),
     Route("POST", "/admin/stop", _stop, "stop the engine"),
     Route("POST", "/admin/shutdown", _shutdown, "shut the service down"),

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""CI smoke for dmshed: admission isolates tenants, reply-mode NACKs, and
+the degradation ladder climbs and recovers — all on CPU inside ~10 s.
+
+Three fail-fast phases around a REAL ``Engine`` (no jax, tiny echo
+processors — mirrors the wal-smoke shape: every gate asserts immediately,
+no pollable hangs):
+
+1. **two-tenant isolation**: a forwarding engine with an
+   ``AdmissionController`` loaded from a real ``tenants.yaml`` takes an
+   in-quota victim and an over-quota aggressor interleaved on the same
+   ingress; gates: every victim frame delivered downstream with its tenant
+   block re-stamped, the aggressor throttled to its burst credit, shed
+   counted on the aggressor only, a ``load_shed`` event emitted;
+2. **reply-mode NACK**: a reply-mode engine (no outputs) sheds an
+   over-quota sender and must answer with the structured ``dm_nack``
+   retry-after payload instead of silence — the sender-visible contract;
+3. **ladder round trip**: a ``DegradationLadder`` driven by an injected
+   backlog probe climbs ``normal`` → ``emergency`` immediately when the
+   backlog spikes, gates whole tiers through the live admission
+   controller (reason ``ladder``), then walks back DOWN one state per
+   recovery window to ``normal`` — the full round trip wall-clocked
+   under 10 s.
+
+Writes the verdict JSON to ``--out`` for the workflow-artifact upload.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class Echo:
+    def process(self, data: bytes):
+        return data
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="shed-smoke.json")
+    args = ap.parse_args()
+
+    import tempfile
+
+    from detectmateservice_tpu.engine import Engine
+    from detectmateservice_tpu.engine.framing import (
+        unwrap_tenant,
+        wrap_tenant,
+    )
+    from detectmateservice_tpu.engine.health import DegradationLadder
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+    from detectmateservice_tpu.settings import ServiceSettings
+    from detectmateservice_tpu.shed import AdmissionController, load_quota_map
+
+    t0 = time.monotonic()
+    tmp = Path(tempfile.mkdtemp(prefix="shed-smoke-"))
+    record = {"schema": "shed-smoke-v1", "gates": []}
+
+    def gate(name: str, ok: bool, detail: str) -> None:
+        record["gates"].append({"name": name, "ok": bool(ok),
+                                "detail": str(detail)})
+        print(f"[shed-smoke] {'PASS' if ok else 'FAIL'} {name}: {detail}")
+        if not ok:
+            Path(args.out).write_text(json.dumps(record, indent=2) + "\n",
+                                      encoding="utf-8")
+            raise SystemExit(f"shed-smoke failed at {name}")
+
+    # -- phase 1: two tenants through a real forwarding engine -------------
+    tenants_yaml = tmp / "tenants.yaml"
+    tenants_yaml.write_text(
+        # plain frames cost 1 token each (frame_msg_count of a non-magic
+        # payload), so rate/burst are frames here
+        "default:\n  tier: guaranteed\n  rate: 100000\n"
+        "tenants:\n"
+        "  victim:\n    tier: guaranteed\n    rate: 1000\n"
+        "  aggr:\n    tier: burst\n    rate: 5\n    burst: 10\n",
+        encoding="utf-8")
+    quota_map = load_quota_map(tenants_yaml, default_tier="best_effort",
+                               default_rate=100000.0, default_burst=None)
+    labels = {"component_type": "core", "component_id": "shed-smoke"}
+    events = []
+    admission = AdmissionController(quota_map, labels, buckets=16,
+                                    retry_after_ms=50.0,
+                                    events=events.append)
+    factory = InprocQueueSocketFactory(maxsize=4096)
+    settings = ServiceSettings(
+        component_type="core", component_id="shed-smoke",
+        engine_addr="inproc://shed-smoke-in",
+        out_addr=["inproc://shed-smoke-out"],
+        engine_recv_timeout=20, log_to_file=False, log_to_console=False)
+    engine = Engine(settings, Echo(), socket_factory=factory,
+                    admission=admission)
+    sink = factory.create("inproc://shed-smoke-out")
+    sink.recv_timeout = 50
+    sender = factory.create_output("inproc://shed-smoke-in")
+    engine.start()
+
+    expect_victim = set()
+    for i in range(50):
+        victim_frame = b"v-%03d" % i
+        expect_victim.add(victim_frame)
+        sender.send(wrap_tenant(victim_frame, "victim"))
+        sender.send(wrap_tenant(b"a-%03d" % i, "aggr"))
+
+    def drain():
+        out = []
+        try:
+            while True:
+                out.append(sink.recv())
+        except Exception:
+            return out
+
+    deadline = time.monotonic() + 5.0
+    delivered = []
+    while time.monotonic() < deadline:
+        delivered += drain()
+        victims = [f for f in delivered
+                   if unwrap_tenant(f)[1] == "victim"]
+        if len(victims) >= len(expect_victim):
+            break
+    snap = admission.snapshot()
+    record["admission"] = snap
+    got_victim = {unwrap_tenant(f)[0] for f in delivered
+                  if unwrap_tenant(f)[1] == "victim"}
+    gate("victim_all_delivered", got_victim == expect_victim,
+         f"{len(got_victim)}/{len(expect_victim)} victim frames out the "
+         "other side, tenant block re-stamped")
+    aggr = snap["tenants"].get("aggr", {})
+    victim = snap["tenants"].get("victim", {})
+    gate("aggressor_shed", aggr.get("shed_frames", 0) > 0
+         and aggr.get("shed_frames", 0) > aggr.get("admitted_frames", 0),
+         f"aggr admitted={aggr.get('admitted_frames')} "
+         f"shed={aggr.get('shed_frames')} against rate=5 burst=10")
+    gate("victim_never_shed", victim.get("shed_frames", 1) == 0
+         and victim.get("admitted_frames", 0) == len(expect_victim),
+         f"victim admitted={victim.get('admitted_frames')} "
+         f"shed={victim.get('shed_frames')}")
+    gate("load_shed_event_emitted",
+         any(e.get("kind") == "load_shed" for e in events),
+         f"{sum(1 for e in events if e.get('kind') == 'load_shed')} "
+         "load_shed event(s) in the ring (rate-limited per tier)")
+    engine.stop()
+
+    # -- phase 2: reply-mode shed answers with a structured NACK -----------
+    quota_map2 = load_quota_map(tenants_yaml, default_tier="best_effort",
+                                default_rate=100000.0, default_burst=None)
+    admission2 = AdmissionController(quota_map2, labels, buckets=16,
+                                     retry_after_ms=50.0,
+                                     events=events.append)
+    settings2 = ServiceSettings(
+        component_type="core", component_id="shed-smoke-reply",
+        engine_addr="inproc://shed-smoke-reply",
+        engine_recv_timeout=20, log_to_file=False, log_to_console=False)
+    engine2 = Engine(settings2, Echo(), socket_factory=factory,
+                     admission=admission2)
+    client = factory.create_output("inproc://shed-smoke-reply")
+    client.recv_timeout = 2000
+    engine2.start()
+    # burn the aggressor's burst credit, then one more frame must NACK
+    replies = []
+    for i in range(16):
+        client.send(wrap_tenant(b"r-%03d" % i, "aggr"))
+    deadline = time.monotonic() + 5.0
+    nack = None
+    while nack is None and time.monotonic() < deadline:
+        try:
+            reply = client.recv()
+        except Exception:
+            continue
+        replies.append(reply)
+        try:
+            doc = json.loads(reply)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "dm_nack" in doc:
+            nack = doc["dm_nack"]
+    gate("reply_mode_nack", nack is not None,
+         f"shed answered with {nack} after "
+         f"{len(replies)} replies (echoes for the admitted prefix)")
+    gate("nack_payload_complete",
+         nack.get("reason") == "quota" and nack.get("tier") == "burst"
+         and nack.get("retry_after_ms") == 50.0,
+         f"reason={nack.get('reason')} tier={nack.get('tier')} "
+         f"retry_after_ms={nack.get('retry_after_ms')}")
+    engine2.stop()
+
+    # -- phase 3: ladder climbs fast, recovers slow, round trip < 10 s -----
+    backlog = {"value": 0.0}
+    transitions = []
+    ladder = DegradationLadder((4, 8, 16), labels, recovery_intervals=2,
+                              events=transitions.append)
+    ladder.add_backlog_source(lambda: backlog["value"])
+    admission2._ladder = ladder
+    t_ladder = time.monotonic()
+    backlog["value"] = 100.0
+    ladder.evaluate(time.monotonic())
+    gate("ladder_climbs_immediately",
+         ladder.state_index == 3,
+         f"backlog 100 >= t3=16 -> {ladder.STATES[ladder.state_index]} "
+         "in one evaluation")
+    # with the ladder at emergency even the burst-tier aggressor is gated
+    # by TIER, before its bucket is consulted
+    ok, reason, tier = admission2.admit("aggr", 1, time.monotonic())
+    gate("ladder_gates_tier", not ok and reason == "ladder",
+         f"admit(aggr) -> admitted={ok} reason={reason} tier={tier} "
+         "at emergency")
+    backlog["value"] = 0.0
+    while ladder.state_index > 0:
+        if time.monotonic() - t_ladder > 10.0:
+            break
+        ladder.evaluate(time.monotonic())
+        time.sleep(0.05)
+    round_trip = time.monotonic() - t_ladder
+    gate("ladder_recovered_normal",
+         ladder.state_index == 0 and round_trip < 10.0,
+         f"walked back to normal in {round_trip:.2f}s "
+         f"({len(transitions)} transitions)")
+    down_steps = [(e["from"], e["to"]) for e in transitions
+                  if e.get("kind") == "shed_ladder_transition"]
+    gate("ladder_steps_one_at_a_time",
+         down_steps == [("normal", "emergency"),
+                        ("emergency", "shed_burst"),
+                        ("shed_burst", "shed_best_effort"),
+                        ("shed_best_effort", "normal")],
+         f"transition chain: {down_steps}")
+    record["ladder_transitions"] = transitions
+
+    record["elapsed_s"] = round(time.monotonic() - t0, 2)
+    Path(args.out).write_text(json.dumps(record, indent=2) + "\n",
+                              encoding="utf-8")
+    print(f"[shed-smoke] PASS all gates in {record['elapsed_s']:.1f}s "
+          f"-> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

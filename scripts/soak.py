@@ -72,6 +72,16 @@ SCENARIOS = {
                 "deliberately-broken candidate shadows — gated on "
                 "ModelCanaryDiverging firing and the "
                 "model_canary_holdback event"),
+    "noisy_neighbor": (("ShedRateHigh",),
+                       "an aggressor tenant offers 10x its admission quota "
+                       "alongside an in-quota victim tenant; the parser's "
+                       "ingress admission (shed_enabled + tenants.yaml) "
+                       "sheds the aggressor's excess at the front door; "
+                       "gates: victim p99 inside the --slo-ms SLO, zero "
+                       "victim unique-frame loss, shed counted on the "
+                       "aggressor only (exact per-tenant counters off "
+                       "/admin/tenants), the load_shed event emitted, and "
+                       "ShedRateHigh actually firing"),
     "ingress_crash": (("SpoolAgeHigh",),
                       "the parser (durable_ingress on) wedges mid-burst "
                       "with frames banked unacked in its WAL spool, then "
@@ -90,7 +100,8 @@ AUDIT_TEMPLATE = ("arch=<*> syscall=<*> success=<*> exit=<*> pid=<*> "
                   "uid=<*> comm=<*> exe=<*>")
 
 
-def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None):
+def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None,
+                   tenants_file=None):
     """The three service settings + component configs of the soak pipeline.
     Frame sizes are kept uniform (engine_frame_batch == loadgen burst) so
     wire frames map ~1:1 through every stage and the FIFO trace attachment
@@ -110,11 +121,16 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None):
         wal = dict(durable_ingress=True, wal_dir=str(wal_dir),
                    wal_fsync_interval_ms=20.0,
                    wal_segment_bytes=4 * 1024 * 1024)
+    shed = {}
+    if tenants_file is not None:
+        # dmshed on the pipeline's front stage only: admission belongs at
+        # the front door, and the inner stages see already-admitted traffic
+        shed = dict(shed_enabled=True, tenants_file=str(tenants_file))
     parser = ServiceSettings(
         component_type="parsers.template_matcher.MatcherParser",
         component_id="soak-parser", trace_stage="parser",
         engine_addr="inproc://soak-parser",
-        out_addr=["inproc://soak-detector"], **wal, **common)
+        out_addr=["inproc://soak-detector"], **wal, **shed, **common)
     rollout = {}
     if rollout_dir is not None:
         # the dmroll cycle, CI-sized: a generous mean-delta gate (a 1-epoch
@@ -174,13 +190,14 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None):
 
 
 def boot_pipeline(tmp: Path, factory, burst: int, rollout_dir=None,
-                  wal_dir=None):
+                  wal_dir=None, tenants_file=None):
     from detectmateservice_tpu.core import Service
 
     services = []
     for settings, config in build_settings(tmp, burst,
                                            rollout_dir=rollout_dir,
-                                           wal_dir=wal_dir):
+                                           wal_dir=wal_dir,
+                                           tenants_file=tenants_file):
         service = Service(settings, component_config=config,
                           socket_factory=factory)
         service.setup_io()
@@ -362,6 +379,9 @@ def main() -> int:
                          "per scenario")
     ap.add_argument("--settle", type=float, default=8.0,
                     help="baseline drain window before loss is counted")
+    ap.add_argument("--slo-ms", type=float, default=2000.0,
+                    help="noisy_neighbor: the victim tenant's p99 SLO "
+                         "gate in ms (default 2000)")
     ap.add_argument("--mix", default="anomaly=0.005,json=0.01,"
                                      "invalid_utf8=0.005")
     ap.add_argument("--out-dir", default=str(REPO))
@@ -371,10 +391,12 @@ def main() -> int:
     # (scaled) detection horizon — threshold crossing + for: hold
     fault_defaults = {"none": 0.0, "stall": 45.0, "slow_sink": 45.0,
                       "recompile": 8.0, "replica_kill": 40.0,
-                      "rollout": 45.0, "ingress_crash": 45.0}
+                      "rollout": 45.0, "ingress_crash": 45.0,
+                      "noisy_neighbor": 45.0}
     scale_defaults = {"none": 6.0, "stall": 6.0, "slow_sink": 12.0,
                       "recompile": 6.0, "replica_kill": 12.0,
-                      "rollout": 12.0, "ingress_crash": 12.0}
+                      "rollout": 12.0, "ingress_crash": 12.0,
+                      "noisy_neighbor": 12.0}
     fault_s = (args.fault_seconds if args.fault_seconds is not None
                else fault_defaults[args.scenario])
     time_scale = (args.time_scale if args.time_scale is not None
@@ -410,14 +432,27 @@ def main() -> int:
         print(f"[soak] {'PASS' if ok else 'FAIL'} {name}: {detail}")
         return ok
 
-    def new_generator(factory, seconds: float, settle: float):
+    # noisy_neighbor splits the box's characterized comfortable rate in
+    # half: the victim tenant gets one half (in quota, by a wide margin),
+    # the aggressor's QUOTA is the other half — but it OFFERS 10x that, so
+    # admission must shed ~90% of it to hold admitted load at ~args.rate
+    noisy = args.scenario == "noisy_neighbor"
+    victim_rate = args.rate / 2 if noisy else args.rate
+    aggr_quota = args.rate / 2
+
+    def new_generator(factory, seconds: float, settle: float,
+                      rate=None, tenant=None, listen=True,
+                      component_id="soak-loadgen"):
         profile = LoadProfile(
             target_addr="inproc://soak-parser",
-            listen_addr="inproc://soak-collector",
-            rate=args.rate, burst=args.burst, seconds=seconds,
-            mix=mix, settle_s=settle)
+            listen_addr="inproc://soak-collector" if listen else None,
+            rate=rate if rate is not None else victim_rate,
+            burst=args.burst, seconds=seconds,
+            mix=mix, settle_s=settle,
+            tenant=tenant if tenant is not None
+            else ("victim" if noisy else None))
         return LoadGenerator(profile, labels=dict(
-            component_type="loadgen", component_id="soak-loadgen"),
+            component_type="loadgen", component_id=component_id),
             socket_factory=factory)
 
     # deep ingress/inter-stage queues: a stall scenario banks the whole
@@ -455,6 +490,27 @@ def main() -> int:
         elif args.scenario == "ingress_crash":
             services = boot_pipeline(Path(tmp), factory, args.burst,
                                      wal_dir=Path(tmp) / "wal")
+        elif args.scenario == "noisy_neighbor":
+            # the default quota stays effectively unlimited: the untenanted
+            # warm traffic (and any damaged tenant block) must never shed —
+            # only the two NAMED tenants are under test
+            tenants_file = Path(tmp) / "tenants.yaml"
+            tenants_file.write_text(
+                "default:\n"
+                "  tier: guaranteed\n"
+                "  rate: 10000000\n"
+                "tenants:\n"
+                "  victim:\n"
+                "    tier: guaranteed\n"
+                f"    rate: {victim_rate * 3:.0f}\n"
+                f"    burst: {victim_rate * 6:.0f}\n"
+                "  aggr:\n"
+                "    tier: burst\n"
+                f"    rate: {aggr_quota:.0f}\n"
+                f"    burst: {aggr_quota * 2:.0f}\n",
+                encoding="utf-8")
+            services = boot_pipeline(Path(tmp), factory, args.burst,
+                                     tenants_file=tenants_file)
         else:
             services = boot_pipeline(Path(tmp), factory, args.burst)
         scraper = Scraper(store, evaluator, services)
@@ -593,6 +649,18 @@ def main() -> int:
                     router_service.engine.router.replicas[victim_pos] \
                         .admin_url = (f"http://127.0.0.1:"
                                       f"{victim.web_server.port}")
+                elif args.scenario == "noisy_neighbor":
+                    # the "fault" is traffic: a second generator, tenant
+                    # "aggr", offered 10x its quota while the victim keeps
+                    # streaming — admission at the parser's ingress is what
+                    # stands between the aggressor and the victim's SLO
+                    aggressor = new_generator(
+                        factory, fault_s, settle=2.0,
+                        rate=aggr_quota * 10, tenant="aggr", listen=False,
+                        component_id="soak-loadgen-aggr")
+                    aggressor.start()
+                    aggressor.wait(timeout=fault_s + 60.0)
+                    record["aggressor"] = aggressor.stop()["scorecard"]
                 elif args.scenario == "ingress_crash":
                     # wedge first so ingress frames bank UNACKED in the
                     # parser's spool (appended at recv, ack blocked behind
@@ -690,6 +758,47 @@ def main() -> int:
                           unexpected == 0,
                           f"scorer_xla_recompiles_unexpected_total="
                           f"{unexpected}")
+                if args.scenario == "noisy_neighbor":
+                    # the isolation contract, gated by execution: every
+                    # victim frame was admitted and delivered inside its
+                    # SLO, every shed frame belonged to the aggressor, and
+                    # the shed storm was visible (load_shed event + the
+                    # ShedRateHigh rule via the generic alert loop above)
+                    parser_service = services[0]
+                    snap = parser_service.admission.snapshot()
+                    record["admission"] = snap
+                    victim_counts = snap["tenants"].get(
+                        "victim", {"admitted_frames": 0, "shed_frames": 0})
+                    aggr_counts = snap["tenants"].get(
+                        "aggr", {"admitted_frames": 0, "shed_frames": 0})
+                    check("victim_loss_zero",
+                          chaos["scorecard"]["loss"] == 0,
+                          f"loss={chaos['scorecard']['loss']} of "
+                          f"{chaos['scorecard']['sent_frames']} victim "
+                          "frames (unique trace ids)")
+                    p99 = chaos["scorecard"]["latency"]["p99_ms"]
+                    check("victim_p99_inside_slo",
+                          p99 is not None and p99 <= args.slo_ms,
+                          f"victim p99={p99}ms against slo={args.slo_ms}ms "
+                          "with the aggressor at 10x quota")
+                    check("shed_on_aggressor_only",
+                          aggr_counts["shed_frames"] > 0
+                          and victim_counts["shed_frames"] == 0,
+                          f"aggr shed={aggr_counts['shed_frames']} "
+                          f"admitted={aggr_counts['admitted_frames']}; "
+                          f"victim shed={victim_counts['shed_frames']} "
+                          f"admitted={victim_counts['admitted_frames']}")
+                    check("aggressor_throttled_to_quota",
+                          aggr_counts["shed_frames"]
+                          > aggr_counts["admitted_frames"],
+                          "the majority of the aggressor's frames were "
+                          f"refused ({aggr_counts['shed_frames']} shed vs "
+                          f"{aggr_counts['admitted_frames']} admitted)")
+                    kinds = [e.get("kind") for e in
+                             parser_service.events.snapshot()["events"]]
+                    check("load_shed_event_emitted",
+                          "load_shed" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
                 if args.scenario == "ingress_crash":
                     # the durability contract, gated by execution: frames
                     # were banked unacked at the crash, recovery actually

@@ -1,0 +1,500 @@
+"""dmshed: tenant framing interop, token-bucket math under an injected
+clock, admission decisions against the degradation ladder, deficit
+round-robin coalescer release, ladder hysteresis, the reply-mode NACK
+contract through a real engine, and the loadgen per-tenant profile knob.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from detectmateservice_tpu.engine import Engine
+from detectmateservice_tpu.engine.framing import (
+    MAGIC_TEN,
+    FramingError,
+    TraceContext,
+    frame_msg_count,
+    pack_batch,
+    peek_tenant_id,
+    peek_trace_id,
+    unpack_batch,
+    unwrap_tenant,
+    unwrap_trace,
+    wrap_tenant,
+    wrap_trace,
+)
+from detectmateservice_tpu.engine.health import DegradationLadder
+from detectmateservice_tpu.library.detectors.jax_scorer import _BatchCoalescer
+from detectmateservice_tpu.loadgen.generator import LoadProfile
+from detectmateservice_tpu.settings import ServiceSettings
+from detectmateservice_tpu.shed import (
+    AdmissionController,
+    load_quota_map,
+)
+from detectmateservice_tpu.shed.quota import (
+    QuotaError,
+    TokenBucket,
+    default_quota_map,
+    tenant_bucket,
+)
+
+LABELS = {"component_type": "core", "component_id": "test-shed"}
+
+
+# -- tenant frame block: wire interop ----------------------------------------
+
+
+class TestTenantFraming:
+    def test_wrap_unwrap_round_trip(self):
+        payload = b"hello payload"
+        framed = wrap_tenant(payload, "acme")
+        assert framed.startswith(MAGIC_TEN)
+        out, tenant, damaged = unwrap_tenant(framed)
+        assert (out, tenant, damaged) == (payload, "acme", False)
+
+    def test_peek_matches_unwrap_without_touching_payload(self):
+        framed = wrap_tenant(b"x" * 1024, "tenant-\u00e9\u00fc")
+        assert peek_tenant_id(framed) == "tenant-\u00e9\u00fc"
+
+    def test_untenanted_passthrough(self):
+        data = b"no magic here"
+        assert unwrap_tenant(data) == (data, None, False)
+        assert peek_tenant_id(data) is None
+
+    def test_outermost_over_v1_batch(self):
+        batch = pack_batch([b"a", b"b", b"c"])
+        framed = wrap_tenant(batch, "acme")
+        # the frame cost the engine meters is the payload's message count,
+        # read THROUGH the tenant block
+        assert frame_msg_count(framed) == 3
+        inner, tenant, _ = unwrap_tenant(framed)
+        assert tenant == "acme"
+        assert unpack_batch(inner) == [b"a", b"b", b"c"]
+
+    def test_outermost_over_v2_trace(self):
+        ctx = TraceContext.new(123456)
+        framed = wrap_tenant(wrap_trace(b"payload", ctx), "acme")
+        # trace-id loss accounting must see through the tenant block
+        assert peek_trace_id(framed) == ctx.trace_id
+        inner, tenant, _ = unwrap_tenant(framed)
+        assert tenant == "acme"
+        stripped, got_ctx, _ = unwrap_trace(inner)
+        assert stripped == b"payload"
+        assert got_ctx.trace_id == ctx.trace_id
+
+    def test_damaged_utf8_keeps_payload(self):
+        framed = bytearray(wrap_tenant(b"payload", "ab"))
+        # corrupt the 2-byte tenant id into invalid UTF-8
+        framed[len(MAGIC_TEN) + 1:len(MAGIC_TEN) + 3] = b"\xff\xfe"
+        out, tenant, damaged = unwrap_tenant(bytes(framed))
+        assert out == b"payload"
+        assert tenant is None
+        assert damaged is True
+        assert peek_tenant_id(bytes(framed)) is None
+
+    def test_id_overrun_raises(self):
+        truncated = wrap_tenant(b"", "a-very-long-tenant-name")[:6]
+        with pytest.raises(FramingError):
+            unwrap_tenant(truncated)
+        assert peek_tenant_id(truncated) is None
+
+
+# -- token buckets under an injected clock ------------------------------------
+
+
+class TestTokenBucket:
+    def test_starts_full_and_refills_at_rate(self):
+        bucket = TokenBucket(rate=10.0, burst=20.0, now=0.0)
+        assert bucket.take(20, 0.0)          # full burst available at birth
+        assert not bucket.take(1, 0.0)       # drained
+        assert bucket.take(5, 0.5)           # 0.5 s * 10/s = 5 tokens back
+        assert not bucket.take(1, 0.5)
+
+    def test_refill_clamps_at_burst(self):
+        bucket = TokenBucket(rate=10.0, burst=20.0, now=0.0)
+        assert bucket.take(20, 0.0)
+        assert bucket.take(20, 1000.0)       # long idle banks only `burst`
+        assert not bucket.take(1, 1000.0)
+
+    def test_refusal_leaves_level_untouched(self):
+        bucket = TokenBucket(rate=1.0, burst=5.0, now=0.0)
+        assert not bucket.take(6, 0.0)       # over burst: refused...
+        assert bucket.take(5, 0.0)           # ...without draining the level
+
+    def test_cap_revokes_burst_headroom(self):
+        bucket = TokenBucket(rate=10.0, burst=100.0, now=0.0)
+        # emergency clamp: banked credit above `rate` is unspendable
+        assert not bucket.take(11, 0.0, cap=10.0)
+        assert bucket.take(10, 0.0, cap=10.0)
+
+    def test_burst_floor_is_rate(self):
+        assert TokenBucket(rate=10.0, burst=1.0).burst == 10.0
+
+
+class TestQuotaMap:
+    def test_load_and_lookup(self, tmp_path):
+        path = tmp_path / "tenants.yaml"
+        path.write_text(
+            "default:\n  tier: best_effort\n  rate: 100\n"
+            "tenants:\n  acme:\n    tier: guaranteed\n    rate: 500\n",
+            encoding="utf-8")
+        quota_map = load_quota_map(path)
+        assert quota_map.lookup("acme").tier == "guaranteed"
+        assert quota_map.lookup("acme").burst == 1000.0   # default 2x rate
+        assert quota_map.lookup("unknown").tier == "best_effort"
+        assert quota_map.lookup("unknown").rate == 100.0
+
+    @pytest.mark.parametrize("body", [
+        "default:\n  tier: platinum\n  rate: 1\n",          # unknown tier
+        "default:\n  tier: burst\n  rate: 0\n",             # rate <= 0
+        "default:\n  tier: burst\n  rate: 10\n  burst: 5\n",  # burst < rate
+        "tenants:\n  a:\n    speed: 9\n",                   # unknown key
+        "quotas: {}\n",                                     # unknown section
+    ])
+    def test_malformed_map_fails_load(self, tmp_path, body):
+        path = tmp_path / "tenants.yaml"
+        path.write_text(body, encoding="utf-8")
+        with pytest.raises(QuotaError):
+            load_quota_map(path)
+
+    def test_tenant_bucket_is_stable_and_bounded(self):
+        assert tenant_bucket("acme", 16) == tenant_bucket("acme", 16)
+        assert all(0 <= int(tenant_bucket(f"t{i}", 16)) < 16
+                   for i in range(100))
+
+
+# -- admission decisions -------------------------------------------------------
+
+
+def make_admission(tmp_path, events=None, ladder=None):
+    path = tmp_path / "tenants.yaml"
+    path.write_text(
+        "default:\n  tier: best_effort\n  rate: 100\n"
+        "tenants:\n"
+        "  gold:\n    tier: guaranteed\n    rate: 10\n    burst: 20\n"
+        "  elastic:\n    tier: burst\n    rate: 10\n    burst: 20\n"
+        "  scratch:\n    tier: best_effort\n    rate: 10\n    burst: 20\n",
+        encoding="utf-8")
+    return AdmissionController(load_quota_map(path), LABELS, buckets=16,
+                               retry_after_ms=25.0, ladder=ladder,
+                               events=events)
+
+
+class TestAdmissionController:
+    def test_quota_shed_after_burst_credit(self, tmp_path):
+        admission = make_admission(tmp_path)
+        for _ in range(20):
+            assert admission.admit("gold", 1, 0.0) == (True, None,
+                                                       "guaranteed")
+        admitted, reason, tier = admission.admit("gold", 1, 0.0)
+        assert (admitted, reason, tier) == (False, "quota", "guaranteed")
+        # other tenants are untouched by gold's exhaustion
+        assert admission.admit("elastic", 1, 0.0)[0] is True
+
+    def test_anonymous_frame_rides_default_quota(self, tmp_path):
+        admission = make_admission(tmp_path)
+        admitted, reason, tier = admission.admit(None, 1, 0.0)
+        assert (admitted, reason, tier) == (True, None, "best_effort")
+
+    def test_cost_meters_message_count(self, tmp_path):
+        admission = make_admission(tmp_path)
+        assert admission.admit("gold", 20, 0.0)[0] is True    # whole burst
+        assert admission.admit("gold", 1, 0.0)[0] is False
+        # a garbled zero-cost header still pays one token
+        assert admission.admit("elastic", 0, 0.0)[0] is True
+        snap = admission.snapshot()
+        assert snap["tenants"]["gold"]["shed_frames"] == 1
+
+    def test_ladder_gates_whole_tiers(self, tmp_path):
+        events = []
+        ladder = DegradationLadder((4, 8, 16), LABELS,
+                                   recovery_intervals=2,
+                                   events=events.append)
+        backlog = {"value": 0.0}
+        ladder.add_backlog_source(lambda: backlog["value"])
+        admission = make_admission(tmp_path, ladder=ladder)
+        backlog["value"] = 5.0                       # >= t1: shed_best_effort
+        ladder.evaluate(0.0)
+        assert admission.admit("scratch", 1, 0.0) == (False, "ladder",
+                                                      "best_effort")
+        assert admission.admit("elastic", 1, 0.0)[0] is True
+        backlog["value"] = 9.0                       # >= t2: shed_burst
+        ladder.evaluate(1.0)
+        assert admission.admit("elastic", 1, 1.0) == (False, "ladder",
+                                                      "burst")
+        assert admission.admit("gold", 1, 1.0)[0] is True
+
+    def test_emergency_revokes_burst_credit(self, tmp_path):
+        ladder = DegradationLadder((4, 8, 16), LABELS)
+        backlog = {"value": 100.0}
+        ladder.add_backlog_source(lambda: backlog["value"])
+        ladder.evaluate(0.0)
+        assert ladder.state_index == 3
+        admission = make_admission(tmp_path, ladder=ladder)
+        # gold's bucket holds burst=20 but emergency caps the draw at
+        # rate=10: an 11-token frame is refused on quota, a 10-token passes
+        assert admission.admit("gold", 11, 0.0) == (False, "quota",
+                                                    "guaranteed")
+        assert admission.admit("gold", 10, 0.0)[0] is True
+
+    def test_load_shed_event_rate_limited_per_tier(self, tmp_path):
+        events = []
+        admission = make_admission(tmp_path, events=events.append)
+        for _ in range(20):
+            admission.admit("scratch", 1, 0.0)
+        for _ in range(50):
+            admission.admit("scratch", 1, 0.0)       # 50 sheds, same instant
+        sheds = [e for e in events if e["kind"] == "load_shed"]
+        assert len(sheds) == 1                       # 1/s per tier
+        event = sheds[0]
+        assert event["tier"] == "best_effort"
+        assert event["reason"] == "quota"
+        # cardinality discipline: the event carries the hashed bucket, not
+        # the raw tenant id
+        assert event["tenant_bucket"] == tenant_bucket("scratch", 16)
+
+    def test_snapshot_shape(self, tmp_path):
+        admission = make_admission(tmp_path)
+        admission.admit("gold", 1, 0.0)
+        for _ in range(25):
+            admission.admit("scratch", 1, 0.0)
+        snap = admission.snapshot()
+        assert snap["ladder_state"] == "normal"
+        assert snap["tiers"]["guaranteed"]["admitted_frames"] == 1
+        assert snap["tiers"]["best_effort"]["shed_frames"] == 5
+        assert snap["tenants"]["gold"] == {
+            "tier": "guaranteed", "admitted_frames": 1, "shed_frames": 0}
+        assert snap["quota"]["tenants"]["gold"]["rate"] == 10.0
+
+    def test_nack_payload(self, tmp_path):
+        admission = make_admission(tmp_path)
+        doc = admission.nack_payload("quota", "burst", "elastic")
+        assert doc["dm_nack"] == {"reason": "quota", "tier": "burst",
+                                  "tenant": "elastic",
+                                  "retry_after_ms": 25.0}
+
+    def test_tracked_tenant_table_is_bounded(self):
+        admission = AdmissionController(default_quota_map(rate=1e9), LABELS)
+        for i in range(1100):
+            admission.admit(f"t{i}", 1, 0.0)
+        snap = admission.snapshot(limit=2000)
+        assert snap["tracked_tenants"] <= 1025       # 1024 + "_other"
+        assert "_other" in snap["tenants"]
+
+
+# -- deficit round-robin coalescer release ------------------------------------
+
+
+def rows(start, count):
+    return np.arange(start, start + count, dtype=np.int32).reshape(count, 1)
+
+
+class TestCoalescerDRR:
+    def test_single_tenant_is_fifo(self):
+        co = _BatchCoalescer(deadline_s=1.0, target_occupancy=0.5)
+        co.add(rows(0, 3), [b"0", b"1", b"2"], now=0.0)
+        co.add(rows(3, 3), [b"3", b"4", b"5"], now=1.0)
+        tokens, raws, t_oldest = co.take(4)
+        assert tokens[:, 0].tolist() == [0, 1, 2, 3]
+        assert list(raws) == [b"0", b"1", b"2", b"3"]
+        assert t_oldest == 0.0
+        # the remainder keeps ITS arrival stamp across the split
+        tokens, raws, t_oldest = co.take(2)
+        assert tokens[:, 0].tolist() == [4, 5]
+        assert t_oldest == 1.0
+        assert len(co) == 0
+
+    def test_two_tenants_share_a_release(self):
+        co = _BatchCoalescer(deadline_s=1.0, target_occupancy=0.5)
+        co.add(rows(0, 100), [b"a%d" % i for i in range(100)], now=0.0,
+               tenant="hog")
+        co.add(rows(1000, 4), [b"b%d" % i for i in range(4)], now=1.0,
+               tenant="mouse")
+        tokens, raws, t_oldest = co.take(8)
+        served = tokens[:, 0].tolist()
+        # quantum 8//2 = 4: the hog cannot monopolize the batch
+        assert sorted(served) == [0, 1, 2, 3, 1000, 1001, 1002, 1003]
+        assert t_oldest == 0.0
+        assert co.held_by_tenant() == {"hog": 96}    # mouse drained + pruned
+
+    def test_release_starts_at_globally_oldest_row(self):
+        co = _BatchCoalescer(deadline_s=1.0, target_occupancy=0.5)
+        co.add(rows(0, 2), [b"x", b"y"], now=5.0, tenant="late")
+        co.add(rows(10, 2), [b"p", b"q"], now=1.0, tenant="early")
+        tokens, _, t_oldest = co.take(1)
+        # a deadline release must carry the row that tripped the deadline
+        assert tokens[0, 0] == 10
+        assert t_oldest == 1.0
+
+    def test_fifo_within_each_tenant(self):
+        co = _BatchCoalescer(deadline_s=1.0, target_occupancy=0.5)
+        for batch in range(3):
+            co.add(rows(batch * 10, 2), [b"a", b"b"], now=float(batch),
+                   tenant="a")
+            co.add(rows(100 + batch * 10, 2), [b"c", b"d"], now=float(batch),
+                   tenant="b")
+        tokens, _, _ = co.take(12)
+        served = tokens[:, 0].tolist()
+        a_rows = [v for v in served if v < 100]
+        b_rows = [v for v in served if v >= 100]
+        assert a_rows == sorted(a_rows)
+        assert b_rows == sorted(b_rows)
+        assert len(a_rows) == len(b_rows) == 6
+
+
+# -- degradation ladder hysteresis --------------------------------------------
+
+
+class TestDegradationLadder:
+    def make(self, events=None, recovery_intervals=2):
+        ladder = DegradationLadder((4, 8, 16), LABELS,
+                                   recovery_intervals=recovery_intervals,
+                                   events=events)
+        backlog = {"value": 0.0}
+        ladder.add_backlog_source(lambda: backlog["value"])
+        return ladder, backlog
+
+    def test_climb_jumps_to_highest_exceeded_threshold(self):
+        ladder, backlog = self.make()
+        backlog["value"] = 9.0
+        ladder.evaluate(0.0)
+        assert ladder.STATES[ladder.state_index] == "shed_burst"
+        backlog["value"] = 50.0
+        ladder.evaluate(1.0)
+        assert ladder.STATES[ladder.state_index] == "emergency"
+
+    def test_recovery_steps_once_per_clean_window(self):
+        transitions = []
+        ladder, backlog = self.make(events=transitions.append,
+                                    recovery_intervals=2)
+        backlog["value"] = 100.0
+        ladder.evaluate(0.0)
+        backlog["value"] = 0.0
+        states = []
+        for step in range(1, 9):
+            ladder.evaluate(float(step))
+            states.append(ladder.STATES[ladder.state_index])
+        # one step DOWN per 2 clean evaluations, never skipping a state
+        assert states == ["emergency", "shed_burst", "shed_burst",
+                          "shed_best_effort", "shed_best_effort",
+                          "normal", "normal", "normal"]
+        chain = [(e["from"], e["to"]) for e in transitions]
+        assert chain == [("normal", "emergency"),
+                         ("emergency", "shed_burst"),
+                         ("shed_burst", "shed_best_effort"),
+                         ("shed_best_effort", "normal")]
+
+    def test_dirty_evaluation_resets_the_clean_streak(self):
+        ladder, backlog = self.make(recovery_intervals=2)
+        backlog["value"] = 5.0
+        ladder.evaluate(0.0)
+        assert ladder.STATES[ladder.state_index] == "shed_best_effort"
+        backlog["value"] = 0.0
+        ladder.evaluate(1.0)            # clean #1
+        backlog["value"] = 5.0
+        ladder.evaluate(2.0)            # dirty: streak resets
+        backlog["value"] = 0.0
+        ladder.evaluate(3.0)            # clean #1 again
+        assert ladder.STATES[ladder.state_index] == "shed_best_effort"
+        ladder.evaluate(4.0)            # clean #2: now it steps
+        assert ladder.STATES[ladder.state_index] == "normal"
+
+    def test_broken_backlog_source_is_swallowed(self):
+        ladder = DegradationLadder((4, 8, 16), LABELS)
+        ladder.add_backlog_source(lambda: 1 / 0)
+        ladder.add_backlog_source(lambda: 100.0)
+        ladder.evaluate(0.0)
+        assert ladder.STATES[ladder.state_index] == "emergency"
+
+
+# -- the engine-level reply-mode NACK contract (satellite regression) ----------
+
+
+class Echo:
+    def process(self, data: bytes):
+        return data
+
+
+class TestEngineReplyNack:
+    def test_shed_reply_sender_gets_structured_nack(self, inproc_factory,
+                                                    tmp_path):
+        """A reply-mode sender over quota must receive the dm_nack
+        retry-after payload — silence was the pre-dmshed regression."""
+        path = tmp_path / "tenants.yaml"
+        path.write_text(
+            "default:\n  tier: guaranteed\n  rate: 100000\n"
+            "tenants:\n  aggr:\n    tier: burst\n    rate: 2\n    burst: 4\n",
+            encoding="utf-8")
+        admission = AdmissionController(load_quota_map(path), LABELS,
+                                        retry_after_ms=75.0)
+        settings = ServiceSettings(
+            component_type="core", engine_addr="inproc://shed-nack",
+            engine_recv_timeout=20, log_to_file=False)
+        engine = Engine(settings, Echo(), inproc_factory,
+                        admission=admission)
+        client = inproc_factory.create_output("inproc://shed-nack")
+        client.recv_timeout = 2000
+        engine.start()
+        try:
+            for i in range(8):
+                client.send(wrap_tenant(b"m-%d" % i, "aggr"))
+            nack = None
+            deadline = time.monotonic() + 5.0
+            while nack is None and time.monotonic() < deadline:
+                try:
+                    reply = client.recv()
+                except Exception:
+                    continue
+                try:
+                    doc = json.loads(reply)
+                except ValueError:
+                    continue    # echo of an admitted frame
+                if isinstance(doc, dict) and "dm_nack" in doc:
+                    nack = doc["dm_nack"]
+            assert nack == {"reason": "quota", "tier": "burst",
+                            "tenant": "aggr", "retry_after_ms": 75.0}
+            assert admission.snapshot()["tenants"]["aggr"]["shed_frames"] > 0
+        finally:
+            engine.stop()
+
+    def test_forwarding_restamps_tenant_on_egress(self, inproc_factory,
+                                                  tmp_path):
+        admission = AdmissionController(default_quota_map(rate=1e6), LABELS)
+        settings = ServiceSettings(
+            component_type="core", engine_addr="inproc://shed-fwd",
+            out_addr=["inproc://shed-fwd-out"],
+            engine_recv_timeout=20, log_to_file=False)
+        engine = Engine(settings, Echo(), inproc_factory,
+                        admission=admission)
+        sink = inproc_factory.create("inproc://shed-fwd-out")
+        sink.recv_timeout = 2000
+        sender = inproc_factory.create_output("inproc://shed-fwd")
+        engine.start()
+        try:
+            sender.send(wrap_tenant(b"payload", "acme"))
+            out = sink.recv()
+            assert unwrap_tenant(out) == (b"payload", "acme", False)
+        finally:
+            engine.stop()
+
+
+# -- loadgen per-tenant profiles ----------------------------------------------
+
+
+class TestLoadProfileTenant:
+    def test_from_payload_accepts_tenant(self):
+        profile = LoadProfile.from_payload({
+            "target_addr": "inproc://x", "tenant": "acme",
+            "mix": {"audit": 1.0}})
+        assert profile.tenant == "acme"
+        assert profile.to_dict()["tenant"] == "acme"
+
+    def test_tenant_defaults_to_none(self):
+        profile = LoadProfile.from_payload({"target_addr": "inproc://x"})
+        assert profile.tenant is None
+
+    def test_unknown_keys_still_rejected(self):
+        with pytest.raises(ValueError):
+            LoadProfile.from_payload({"target_addr": "inproc://x",
+                                      "tenannt": "typo"})
