@@ -76,6 +76,8 @@ ALERT_COVERED_SERIES = (
     "wal_oldest_unacked_age_seconds",
     "shed_frames_total",
     "shed_ladder_state",
+    "wal_spool_degraded",
+    "dlq_depth_frames",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

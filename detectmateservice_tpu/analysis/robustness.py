@@ -1,0 +1,151 @@
+"""DM-R: robustness discipline — no silently swallowed exceptions.
+
+The dmfault chaos harness exists to prove failures surface; a ``try`` /
+``except Exception: pass`` defeats it from inside. The confirmed failure
+modes this rule guards against are exactly the ones the fault-injection PR
+fixed: the engine's micro-batch path caught a processor exception and
+acked the chunk anyway (poison silently destroyed), and an ``_fsync``
+error escaped one layer up and killed the whole EngineLoop because the
+intermediate layers had nowhere to record it. An exception handler that
+neither re-raises, nor logs, nor counts, nor even LOOKS at the exception
+is invisible in production — the failure happened, the evidence is gone.
+
+  DM-R001  broad exception handler (``except Exception`` /
+           ``except BaseException``, alone or in a tuple) whose body does
+           none of: re-raise, reference the bound exception object, call a
+           logger/print, or bump a counter (``.inc()``/``.observe()`` or an
+           augmented ``+=``). Bare ``except:`` stays DM-B002's.
+
+A handler that touches its exception (``raise X from exc``, passes ``exc``
+to a helper, formats it into a message) is considered handled — examining
+the error is the opposite of swallowing it. Genuinely-justified swallows
+(best-effort probes on cold paths where any failure means "feature
+absent") carry a ``# dmlint: ignore[DM-R001] <reason>`` pragma or a
+baseline entry, so every one of them is a *written-down decision*.
+
+Scope: the shipped package only (``detectmateservice_tpu/``). Tests and
+operator scripts swallow exceptions as part of normal teardown/polling
+choreography — flagging those would bury the signal the rule exists for.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Finding, PragmaIndex
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_COUNT_METHODS = {"inc", "observe"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> Optional[str]:
+    """The broad exception-class name this handler catches, or None.
+    Bare ``except:`` is excluded — DM-B002 already owns it."""
+    node = handler.type
+    if node is None:
+        return None
+    types = node.elts if isinstance(node, ast.Tuple) else [node]
+    for t in types:
+        if isinstance(t, ast.Name) and t.id in _BROAD:
+            return t.id
+        if isinstance(t, ast.Attribute) and t.attr in _BROAD:
+            return t.attr
+    return None
+
+
+class _BodyScan(ast.NodeVisitor):
+    """Does the handler body surface the failure in ANY way?"""
+
+    def __init__(self, exc_name: Optional[str]) -> None:
+        self.exc_name = exc_name
+        self.handled = False
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        self.handled = True
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if self.exc_name and node.id == self.exc_name:
+            self.handled = True
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        # `self.errors += 1` — hand-rolled failure counting
+        if isinstance(node.op, ast.Add):
+            self.handled = True
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            self.handled = True
+        elif isinstance(func, ast.Attribute) and func.attr in (
+                _LOG_METHODS | _COUNT_METHODS):
+            self.handled = True
+        self.generic_visit(node)
+
+    # a nested try that handles differently still belongs to this scan —
+    # generic_visit walks into it; nested function bodies run elsewhere,
+    # their handling does not surface THIS exception
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    scan = _BodyScan(handler.name)
+    for stmt in handler.body:
+        scan.visit(stmt)
+        if scan.handled:
+            return False
+    return True
+
+
+def check_module(rel: str, source: str,
+                 tree: Optional[ast.Module] = None,
+                 pragmas: Optional[PragmaIndex] = None) -> List[Finding]:
+    from .findings import scan_pragmas
+
+    if not rel.startswith("detectmateservice_tpu/"):
+        return []
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # DM-B005 owns unparseable files
+    if pragmas is None:
+        pragmas = scan_pragmas(source)
+
+    # map every handler to its enclosing function for stable keys; the
+    # fingerprint ordinal counts swallowing handlers WITHIN that scope, so
+    # unrelated edits elsewhere in the file never reshuffle identities
+    scopes: Dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.ExceptHandler):
+                    scopes.setdefault(id(sub), node.name)
+
+    findings: List[Finding] = []
+    ordinals: Dict[Tuple[str, str], int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught = _is_broad(node)
+        if caught is None or not _swallows(node):
+            continue
+        if pragmas.is_ignored("DM-R001", node.lineno):
+            continue
+        scope = scopes.get(id(node), "<module>")
+        n = ordinals.get((scope, caught), 0)
+        ordinals[(scope, caught)] = n + 1
+        findings.append(Finding(
+            "DM-R001", rel, node.lineno,
+            f"except {caught} swallows the error silently "
+            f"(no re-raise, log, count, or use of the exception)",
+            hint="log it, count it, re-raise it — or pragma the line with "
+                 "the reason the silence is safe",
+            key=f"{scope}:{caught}:{n}"))
+    return findings

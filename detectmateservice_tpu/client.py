@@ -28,6 +28,12 @@ offline scoring of a dmroll candidate against recorded traffic),
 ``tenants [--limit N]`` (the dmshed admission-control snapshot behind
 ``/admin/tenants`` — per-tier/per-tenant admitted+shed counters and the
 current degradation-ladder state),
+``dlq [status] [--limit N] | requeue [--id N] | purge [--id N]`` (the
+dmfault dead-letter queue behind ``/admin/dlq`` — inspect quarantined
+poison frames, hand them back to the engine, or drop them),
+``faults [status] | arm PLAN.json | disarm`` (the dmfault injection plane
+behind ``/admin/faults`` — arm a seeded fault plan, read the armed plan's
+op counters + fired log, disarm and collect the fired schedule),
 and ``health`` — which fans out across every stage of
 a pipeline (stage URLs, service settings YAMLs, or a pipeline YAML with a
 ``stages:`` mapping), prints a roll-up table, and exits non-zero when any
@@ -197,6 +203,35 @@ class DetectMateClient:
             if exc.code == 404:
                 return None
             raise
+
+    def dlq_status(self, limit: Optional[int] = None) -> Any:
+        """Dead-letter-queue snapshot (``GET /admin/dlq``): depth, totals,
+        and the newest quarantined entries (frame bytes omitted)."""
+        suffix = f"?limit={int(limit)}" if limit is not None else ""
+        return self._request("GET", "/admin/dlq" + suffix)
+
+    def dlq_action(self, action: str, entry_id: Optional[int] = None) -> Any:
+        """DLQ verb (``POST /admin/dlq``): ``requeue`` hands frames back to
+        the engine (at-most-once), ``purge`` drops them; one ``id`` or all."""
+        payload: dict = {"action": action}
+        if entry_id is not None:
+            payload["id"] = int(entry_id)
+        return self._request("POST", "/admin/dlq", payload)
+
+    def faults_status(self, tail: Optional[int] = None) -> Any:
+        """Fault-injection status (``GET /admin/faults``): the armed plan,
+        per-site op counters, and the fired-fault log tail."""
+        suffix = f"?tail={int(tail)}" if tail is not None else ""
+        return self._request("GET", "/admin/faults" + suffix)
+
+    def faults_arm(self, plan: dict) -> Any:
+        """Arm a seeded fault plan (``POST /admin/faults``)."""
+        return self._request("POST", "/admin/faults",
+                             {"action": "arm", "plan": plan})
+
+    def faults_disarm(self) -> Any:
+        """Disarm the active plan and return its final fired schedule."""
+        return self._request("POST", "/admin/faults", {"action": "disarm"})
 
     def replay_status(self) -> Any:
         """WAL replay status + the live ingress spool's stats
@@ -547,6 +582,54 @@ def run_replay(client: DetectMateClient, args) -> int:
     return 0 if result.get("state") == "done" else 1
 
 
+def run_dlq(client: DetectMateClient, args) -> int:
+    """``client.py dlq``: inspect / requeue / purge the dead-letter queue
+    behind ``/admin/dlq``. ``status`` (default) prints the snapshot and
+    exits non-zero when poison is waiting, so a pipeline health sweep can
+    gate on it; ``requeue``/``purge`` act on ``--id`` or everything."""
+    try:
+        if args.action == "status":
+            result = client.dlq_status(limit=args.limit)
+            print(json.dumps(result, indent=2))
+            return 1 if result.get("depth_frames", 0) else 0
+        result = client.dlq_action(args.action, entry_id=args.id)
+    except urllib.error.HTTPError as exc:
+        print(f"dlq {args.action} rejected ({exc.code}): "
+              f"{exc.read().decode('utf-8', errors='replace')}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+def run_faults(client: DetectMateClient, args) -> int:
+    """``client.py faults``: the fault-injection plane behind
+    ``/admin/faults``. ``status`` (default) prints the armed plan + fired
+    log; ``arm PLAN.json`` posts a seeded plan; ``disarm`` ends the chaos
+    run and prints the final fired schedule (the determinism artifact)."""
+    try:
+        if args.action == "status":
+            print(json.dumps(client.faults_status(tail=args.tail), indent=2))
+            return 0
+        if args.action == "disarm":
+            print(json.dumps(client.faults_disarm(), indent=2))
+            return 0
+        if not args.plan_file:
+            print("error: faults arm requires a PLAN.json path",
+                  file=sys.stderr)
+            return 2
+        with open(args.plan_file, "r", encoding="utf-8") as fh:
+            plan = json.load(fh)
+        result = client.faults_arm(plan)
+    except urllib.error.HTTPError as exc:
+        print(f"faults {args.action} rejected ({exc.code}): "
+              f"{exc.read().decode('utf-8', errors='replace')}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(result, indent=2))
+    return 0
+
+
 def _parse_mix(spec: str) -> dict:
     """``anomaly=0.005,json=0.01,invalid_utf8=0.005`` → mix dict."""
     mix = {}
@@ -750,6 +833,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(/admin/tenants)")
     tenants_p.add_argument("--limit", type=int, default=None,
                            help="only the top N tenants by shed count")
+    dlq_p = sub.add_parser(
+        "dlq", help="dead-letter queue: inspect, requeue, or purge "
+                    "quarantined poison frames (/admin/dlq)")
+    dlq_p.add_argument("action", nargs="?", default="status",
+                       choices=["status", "requeue", "purge"],
+                       help="status (default, non-zero exit when poison is "
+                            "waiting), requeue, or purge")
+    dlq_p.add_argument("--id", type=int, default=None,
+                       help="one DLQ entry id (default: all entries)")
+    dlq_p.add_argument("--limit", type=int, default=None,
+                       help="show at most N newest entries")
+    faults_p = sub.add_parser(
+        "faults", help="deterministic fault injection: arm a seeded plan, "
+                       "read its fired log, disarm (/admin/faults)")
+    faults_p.add_argument("action", nargs="?", default="status",
+                          choices=["status", "arm", "disarm"],
+                          help="status (default), arm, or disarm")
+    faults_p.add_argument("plan_file", nargs="?", default=None,
+                          help="arm: JSON fault-plan file "
+                               "(seed + specs, docs/fault_injection.md)")
+    faults_p.add_argument("--tail", type=int, default=None,
+                          help="status: show the last N fired faults")
     trace = sub.add_parser(
         "trace", help="read the pipeline flight recorder (/admin/trace)")
     trace.add_argument("--chrome", action="store_true",
@@ -779,6 +884,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_model(client, args)
         if args.command == "replay":
             return run_replay(client, args)
+        if args.command == "dlq":
+            return run_dlq(client, args)
+        if args.command == "faults":
+            return run_faults(client, args)
         if args.command == "tenants":
             result = client.tenants(limit=args.limit)
             if result is None:

@@ -24,6 +24,7 @@ silently always did the latter.
 """
 from __future__ import annotations
 
+import json
 import logging
 import sys
 import threading
@@ -289,6 +290,13 @@ class Service:
                 settings.shed_ladder_backlog_t2,
                 settings.shed_ladder_backlog_t3)
 
+        # deterministic fault injection (faults/): arm a seeded plan from
+        # disk BEFORE the engine is built, so recovery replay and spool
+        # setup already run under it. A malformed plan fails construction —
+        # a chaos run that silently tested nothing is worse than no run.
+        if settings.fault_plan_file:
+            self._arm_fault_plan(settings.fault_plan_file)
+
         self.engine = Engine(settings, self.processor, socket_factory,
                              self.logger, health=self.health,
                              admission=self.admission)
@@ -358,6 +366,31 @@ class Service:
             except (ImportError, AttributeError, RuntimeError) as exc:
                 self.logger.warning("cannot load config class %s: %s", path, exc)
         return CoreConfig
+
+    def _arm_fault_plan(self, path: str) -> None:
+        """Arm the seeded fault plan in ``path`` (JSON, FaultPlan.from_dict
+        shape). Chaos harnesses point ``fault_plan_file`` here; production
+        configs leave it unset and every site stays one untaken branch."""
+        from . import faults
+        from .faults import FaultPlan, FaultPlanError
+
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            plan = FaultPlan.from_dict(doc)
+        except (OSError, ValueError, FaultPlanError) as exc:
+            raise ServiceError(
+                f"cannot arm fault plan from {path}: {exc}") from exc
+        faults.arm(plan, labels=dict(self._labels),
+                   events=self.health.emit_event, logger=self.logger)
+        self.health.emit_event({
+            "kind": "faults_armed", "seed": plan.seed,
+            "specs": len(plan.specs), "source": path,
+        })
+        self.logger.warning(
+            "FAULT INJECTION ARMED from %s: seed=%d, %d spec(s) — this "
+            "process will deliberately fail", path, plan.seed,
+            len(plan.specs))
 
     # -- lifecycle ------------------------------------------------------
     def setup_io(self) -> None:
@@ -464,6 +497,12 @@ class Service:
                 self.library_component.teardown()
             except Exception as exc:
                 self.logger.error("component teardown failed: %s", exc)
+        if self.settings.fault_plan_file:
+            # disarm the process-global injector this service armed, so an
+            # embedding process (tests, notebooks) is not left chaotic
+            from . import faults
+
+            faults.disarm()
         self.health.stop()
         remove_excepthook_sink(self._excepthook_sink)
         self.web_server.stop()

@@ -35,6 +35,7 @@ import time
 from collections import deque
 from typing import List, Optional, Protocol, Tuple, runtime_checkable
 
+from .. import faults
 from ..settings import TLS_SCHEME_PREFIXES, ServiceSettings
 from . import metrics as m
 from .framing import (
@@ -260,8 +261,21 @@ class Engine:
         # off — the hot path then pays one attribute read per frame.
         self._spool = None
         self._replaying = False
+        # dead-letter quarantine (wal/deadletter.py): the destination for
+        # frames that exhausted their dlq_max_attempts processing attempts.
+        # Always constructed — memory-only without a directory — so poison
+        # isolation converges in every configuration. _requeue_pending is
+        # the admin→engine hand-off for POST /admin/dlq requeue: web
+        # threads append under the lock, the engine loop drains it at the
+        # top of each iteration and re-drives the frames replay-style.
+        self._dlq = None
+        self._dlq_max_attempts = max(
+            1, int(getattr(settings, "dlq_max_attempts", 3)))
+        self._requeue_pending: deque = deque()
+        self._requeue_lock = threading.Lock()
         try:
             self._setup_spool()
+            self._setup_dlq()
         except Exception:
             self._close_all()
             raise
@@ -366,6 +380,8 @@ class Engine:
         from ..wal import IngressSpool
 
         s = self.settings
+        events = (self._health.emit_event
+                  if self._health is not None else None)
         self._spool = IngressSpool(
             s.wal_dir,
             segment_bytes=s.wal_segment_bytes,
@@ -373,6 +389,10 @@ class Engine:
             retain_bytes=s.wal_retain_bytes,
             retain_age_s=s.wal_retain_age_s,
             fsync_observer=m.WAL_FSYNC_SECONDS().labels(**self._labels).inc,
+            on_disk_error=getattr(s, "wal_on_disk_error", "degrade"),
+            events=events,
+            disk_error_observer=m.WAL_FSYNC_ERRORS()
+            .labels(**self._labels).inc,
             logger=self.logger)
         spool = self._spool
         m.WAL_SPOOL_DEPTH().labels(**self._labels) \
@@ -381,11 +401,54 @@ class Engine:
             .set_function(spool.spool_bytes)
         m.WAL_OLDEST_UNACKED_AGE().labels(**self._labels) \
             .set_function(spool.oldest_unacked_age_seconds)
+        m.WAL_SPOOL_DEGRADED().labels(**self._labels) \
+            .set_function(spool.degraded_value)
         self._m_wal_recovered = m.WAL_REPLAYED_FRAMES().labels(
             mode="recovery", **self._labels)
         self.logger.info(
             "durable ingress armed: spool at %s (%d unacked to replay)",
             s.wal_dir, int(spool.depth_frames()))
+
+    def _setup_dlq(self) -> None:
+        """Open (or reopen after a restart) the dead-letter quarantine and
+        bind its depth gauge; memory-only when no directory applies."""
+        s = self.settings
+        dlq_dir = getattr(s, "dlq_dir", None)
+        if dlq_dir is None and getattr(s, "durable_ingress", False) \
+                and getattr(s, "wal_dir", None):
+            import os as _os
+
+            dlq_dir = _os.path.join(s.wal_dir, "dlq")
+        from ..wal.deadletter import DeadLetterSpool
+
+        self._dlq = DeadLetterSpool(
+            dlq_dir,
+            max_frames=getattr(s, "dlq_max_frames", 1024),
+            labels=self._labels,
+            events=(self._health.emit_event
+                    if self._health is not None else None),
+            logger=self.logger)
+        m.DLQ_DEPTH().labels(**self._labels) \
+            .set_function(self._dlq.depth_frames)
+        if self._dlq.depth_frames():
+            self.logger.warning(
+                "DLQ holds %d quarantined frames at start (inspect with "
+                "GET /admin/dlq)", int(self._dlq.depth_frames()))
+
+    @property
+    def dlq(self):
+        """The dead-letter quarantine spool (the /admin/dlq verbs read and
+        mutate it; never None after construction)."""
+        return self._dlq
+
+    # dmlint: thread(any) — web/admin threads enqueue; the engine loop drains
+    def requeue_frames(self, frames: List[bytes]) -> int:
+        """Hand previously-quarantined frames back to the engine loop for
+        re-processing (POST /admin/dlq requeue). At-most-once: a frame
+        that fails again is re-quarantined with a fresh attempt budget."""
+        with self._requeue_lock:
+            self._requeue_pending.extend(frames)
+        return len(frames)
 
     def _router_abort(self) -> bool:
         """Stop-aware backpressure escape for the router's block mode: the
@@ -401,6 +464,11 @@ class Engine:
     def _setup_output_sockets(self) -> None:
         for addr in self.settings.out_addr:
             try:
+                # sock_dial fault site: an injected dial error takes the
+                # same log-and-continue path as a real failed dial
+                inj = faults._ACTIVE
+                if inj is not None:
+                    inj.sock("sock_dial")
                 # TLS-bearing schemes get the client material; others get
                 # None so a fake factory never sees surprise TLS args. The
                 # scheme list is shared with settings validation on purpose:
@@ -415,7 +483,7 @@ class Engine:
                     buffer_size=self.settings.engine_buffer_size,
                 )
                 self._out_socks.append(sock)
-            except TransportError as exc:
+            except (TransportError, OSError) as exc:
                 self.logger.error("cannot dial output %s: %s (continuing)", addr, exc)
 
     # -- lifecycle ------------------------------------------------------
@@ -439,6 +507,7 @@ class Engine:
                 self._setup_zero_copy()
                 self._setup_router()
                 self._setup_spool()
+                self._setup_dlq()
             except Exception:
                 self._close_all()
                 raise
@@ -505,6 +574,11 @@ class Engine:
             except Exception as exc:
                 self.logger.error("WAL spool close failed: %s", exc)
             self._spool = None
+        dlq = getattr(self, "_dlq", None)
+        if dlq is not None:
+            # entries are already durable per-record; close just releases
+            # the append handle (start() reopens and reloads)
+            dlq.close()
 
     def crash_abort(self) -> None:
         """CHAOS/TEST SEAM — die like kill -9, minus the process exit: the
@@ -674,7 +748,10 @@ class Engine:
         (the reference's newline rule)."""
         if not getattr(self.settings, "engine_frame_autodetect", True):
             if self._spool is not None and not self._replaying:
-                self._spool.append(raw)
+                if (self._spool.append(raw) is None
+                        and self._spool.on_disk_error == "shed"):
+                    err_c.inc()
+                    return []       # not durable → shed per policy
             read_b.inc(len(raw))
             read_l.inc(_count_lines(raw))
             return [raw]
@@ -709,7 +786,10 @@ class Engine:
         # tick keeps the fsync cadence honest inside long burst-collect
         # windows, when the loop-top tick cannot run.
         if self._spool is not None and not self._replaying:
-            self._spool.append(wire)
+            if (self._spool.append(wire) is None
+                    and self._spool.on_disk_error == "shed"):
+                err_c.inc()
+                return []           # not durable → shed per policy
             self._spool.tick()
         read_b.inc(len(raw))
         # first-byte probe before the slice compare: protobuf payloads never
@@ -865,6 +945,9 @@ class Engine:
                 spool.tick()
             if router is not None:
                 router.tick()
+            # dmlint: ignore[DM-L001] lock-free emptiness peek: the GIL makes the deque truth-test atomic, and _drain_requeue re-checks under _requeue_lock
+            if self._requeue_pending:
+                self._drain_requeue(read_b, read_l, err_c)
             if callable(pending_fn):
                 want = short_timeout if pending_fn() > 0 else base_timeout
                 if want != current_timeout:
@@ -893,6 +976,18 @@ class Engine:
                 continue
             if not raw:
                 continue
+            # sock_recv fault site: latency sleeps inside sock(); "drop"
+            # discards the received frame (simulated ingress packet loss);
+            # an injected error treats this frame like a transport error
+            inj = faults._ACTIVE
+            if inj is not None:
+                try:
+                    if inj.sock("sock_recv") == "drop":
+                        continue
+                except OSError as exc:
+                    err_c.inc()
+                    self.logger.error("injected sock_recv fault: %s", exc)
+                    continue
             self._hb_ingest.beat()
 
             if use_frames:
@@ -931,7 +1026,10 @@ class Engine:
                     # durable ingress: same append point (and mid-burst
                     # fsync tick) as _expand_frame
                     if spool is not None:
-                        spool.append(wire)
+                        if (spool.append(wire) is None
+                                and spool.on_disk_error == "shed"):
+                            err_c.inc()
+                            return None   # not durable → shed per policy
                         spool.tick()
                     read_b.inc(len(nxt))
                     if self._trace_enabled or nxt.startswith(MAGIC_V2):
@@ -954,13 +1052,8 @@ class Engine:
                 if not frames:
                     continue
                 ingress_g.set(est[0])
-                try:
-                    outs, _n_msgs, n_lines = frames_fn(frames)
-                except Exception as exc:
-                    err_c.inc(len(frames))
-                    self.logger.error("process_frames() raised: %s", exc)
-                    self._finalize_traces()
-                    continue
+                outs, n_lines = self._dispatch_frames(frames_fn, frames,
+                                                      err_c)
                 read_l.inc(n_lines)
                 self._send_results(outs)
                 self._finalize_traces()
@@ -974,12 +1067,7 @@ class Engine:
 
             if not use_batches:
                 for msg_raw in msgs:
-                    try:
-                        out = self.processor.process(msg_raw)
-                    except Exception as exc:
-                        err_c.inc()
-                        self.logger.error("process() raised: %s", exc)
-                        continue
+                    out = self._dispatch_single(msg_raw, err_c)
                     if out is not None:
                         self._send_results([out], [origin])
                 if self._trace_pending:
@@ -1017,12 +1105,7 @@ class Engine:
             # beyond the configured cap (its memory/latency contract)
             for start in range(0, len(batch), batch_size):
                 chunk = batch[start:start + batch_size]
-                try:
-                    outs = batch_fn(chunk)
-                except Exception as exc:
-                    err_c.inc(len(chunk))
-                    self.logger.error("process_batch() raised: %s", exc)
-                    continue
+                outs = self._dispatch_chunk(batch_fn, chunk, err_c)
                 # in-order, per-message None filter; origin alignment holds
                 # only when outputs are immediate (len match) — a pipelined
                 # processor defers results across calls
@@ -1060,6 +1143,169 @@ class Engine:
             if router is None or router.unacked_total() == 0:
                 spool.ack(spool.last_appended_seq)
             spool.tick(force=True)
+
+    # -- poison isolation + dead-letter quarantine -----------------------
+    # A chunk-level processing exception used to drop (and then silently
+    # ack) every frame in the chunk — the confirmed replay-wedge /
+    # silent-loss bug. Now the failing chunk is re-dispatched one message
+    # at a time: healthy messages complete, and a message that fails on
+    # every one of its dlq_max_attempts attempts moves to the DLQ with its
+    # reason and last error. Deterministic poison converges in ONE pass;
+    # a transient error just costs the bounded retries.
+
+    def _quarantine_msg(self, msg: bytes, reason: str, exc: BaseException,
+                        attempts: int) -> None:
+        if self._dlq is None or not msg:
+            return
+        self._dlq.quarantine(
+            msg, reason=reason, error=f"{type(exc).__name__}: {exc}",
+            attempts=attempts,
+            seq=(self._spool.last_appended_seq
+                 if self._spool is not None else None))
+
+    # dmlint: thread(engine)
+    def _dispatch_chunk(self, batch_fn, chunk: List[bytes], err_c,
+                        reason: str = "processing_error") -> List:
+        """``process_batch`` with the proc fault site armed and poison
+        isolation on failure; always returns the ready outputs."""
+        inj = faults._ACTIVE
+        try:
+            if inj is not None:
+                inj.proc(chunk)
+            return batch_fn(chunk)
+        except Exception as exc:
+            err_c.inc(len(chunk))
+            self.logger.error(
+                "process_batch() raised: %s — isolating %d messages",
+                exc, len(chunk))
+            return self._isolate_poison(batch_fn, chunk, exc, reason)
+
+    def _isolate_poison(self, batch_fn, chunk: List[bytes],
+                        chunk_exc: BaseException, reason: str) -> List:
+        """Cold path: re-dispatch a failed chunk one message at a time;
+        messages still failing after the attempt budget are quarantined.
+        The chunk-level failure counts as each message's first attempt."""
+        inj = faults._ACTIVE
+        retries = max(1, self._dlq_max_attempts - 1)
+        outs: List = []
+        for msg in chunk:
+            last: BaseException = chunk_exc
+            res = None
+            done = False
+            for _ in range(retries):
+                try:
+                    if inj is not None:
+                        inj.proc([msg])
+                    res = batch_fn([msg])
+                    done = True
+                    break
+                except Exception as exc:
+                    last = exc
+            if done:
+                if res:
+                    outs.extend(res)
+            else:
+                self._quarantine_msg(msg, reason, last, 1 + retries)
+        return outs
+
+    # dmlint: thread(engine)
+    def _dispatch_single(self, msg: bytes, err_c,
+                         reason: str = "processing_error"):
+        """``process`` with the proc fault site armed and a bounded attempt
+        budget; a message failing every attempt is quarantined, not
+        silently dropped."""
+        inj = faults._ACTIVE
+        last: Optional[BaseException] = None
+        for _ in range(self._dlq_max_attempts):
+            try:
+                if inj is not None:
+                    inj.proc([msg])
+                return self.processor.process(msg)
+            except Exception as exc:
+                last = exc
+        err_c.inc()
+        self.logger.error("process() raised on all %d attempts: %s",
+                          self._dlq_max_attempts, last)
+        self._quarantine_msg(msg, reason, last, self._dlq_max_attempts)
+        return None
+
+    # dmlint: thread(engine)
+    def _dispatch_frames(self, frames_fn, frames: List[bytes], err_c,
+                         reason: str = "processing_error"):
+        """Fused-frame dispatch with the same isolation contract; returns
+        ``(outs, n_lines)``."""
+        inj = faults._ACTIVE
+        try:
+            if inj is not None:
+                inj.proc(frames)
+            outs, _n_msgs, n_lines = frames_fn(frames)
+            return outs, n_lines
+        except Exception as exc:
+            err_c.inc(len(frames))
+            self.logger.error(
+                "process_frames() raised: %s — isolating %d frames",
+                exc, len(frames))
+        retries = max(1, self._dlq_max_attempts - 1)
+        outs, n_lines = [], 0
+        for frame in frames:
+            last = None
+            got = None
+            done = False
+            for _ in range(retries):
+                try:
+                    if inj is not None:
+                        inj.proc([frame])
+                    got = frames_fn([frame])
+                    done = True
+                    break
+                except Exception as exc:
+                    last = exc
+            if done:
+                f_outs, _n, f_lines = got
+                if f_outs:
+                    outs.extend(f_outs)
+                n_lines += f_lines
+            else:
+                self._quarantine_msg(frame, reason, last, 1 + retries)
+        return outs, n_lines
+
+    # dmlint: thread(engine)
+    def _drain_requeue(self, read_b, read_l, err_c) -> None:
+        """Re-drive DLQ-requeued frames through the pipeline, replay-style
+        (no re-append, no admission — they were admitted and metered when
+        they first arrived). Runs at the loop top, on the engine thread."""
+        with self._requeue_lock:
+            items = list(self._requeue_pending)
+            self._requeue_pending.clear()
+        if not items:
+            return
+        self.logger.info("re-driving %d DLQ-requeued frames", len(items))
+        batch_fn = getattr(self.processor, "process_batch", None)
+        batch_size = max(1, self.settings.engine_batch_size)
+        use_batches = batch_size > 1 and callable(batch_fn)
+        self._replaying = True
+        try:
+            for raw in items:
+                if not raw:
+                    continue
+                msgs = self._expand_frame(raw, read_b, read_l, err_c)
+                if not msgs:
+                    self._finalize_traces()
+                    continue
+                if use_batches:
+                    for start in range(0, len(msgs), batch_size):
+                        self._send_results(self._dispatch_chunk(
+                            batch_fn, msgs[start:start + batch_size],
+                            err_c, reason="requeue_failed"))
+                else:
+                    for msg in msgs:
+                        out = self._dispatch_single(
+                            msg, err_c, reason="requeue_failed")
+                        if out is not None:
+                            self._send_results([out])
+                self._finalize_traces()
+        finally:
+            self._replaying = False
 
     def _replay_recovered(self, read_b, read_l, err_c) -> None:
         """Durable-ingress restart recovery: re-drive the spool's unacked
@@ -1107,31 +1353,29 @@ class Engine:
                     if self._trace_enabled or raw.startswith(MAGIC_V2):
                         raw = self._ingest_trace(raw, err_c)
                     if raw:
-                        try:
-                            outs, _n, n_lines = frames_fn([raw])
-                            read_l.inc(n_lines)
-                            self._send_results(outs)
-                        except Exception as exc:
-                            err_c.inc()
-                            self.logger.error(
-                                "recovery process_frames() raised: %s", exc)
+                        # poison isolation keeps a poisoned recovery frame
+                        # from wedging the replay: it quarantines, the rest
+                        # of the suffix completes, the ack below advances
+                        outs, n_lines = self._dispatch_frames(
+                            frames_fn, [raw], err_c,
+                            reason="recovery_replay")
+                        read_l.inc(n_lines)
+                        self._send_results(outs)
                     self._finalize_traces()
                     continue
                 msgs = self._expand_frame(raw, read_b, read_l, err_c)
                 for start in range(0, len(msgs), batch_size):
                     chunk = msgs[start:start + batch_size]
-                    try:
-                        if use_batches:
-                            self._send_results(batch_fn(chunk))
-                        else:
-                            for msg in chunk:
-                                out = self.processor.process(msg)
-                                if out is not None:
-                                    self._send_results([out])
-                    except Exception as exc:
-                        err_c.inc(len(chunk))
-                        self.logger.error("recovery processing raised: %s",
-                                          exc)
+                    if use_batches:
+                        self._send_results(self._dispatch_chunk(
+                            batch_fn, chunk, err_c,
+                            reason="recovery_replay"))
+                    else:
+                        for msg in chunk:
+                            out = self._dispatch_single(
+                                msg, err_c, reason="recovery_replay")
+                            if out is not None:
+                                self._send_results([out])
                 self._finalize_traces()
             # drain held/pipelined rows so the replayed frames are really
             # delivered before they ack (bounded: an unhealthy processor
@@ -1205,6 +1449,17 @@ class Engine:
             # in-flight burst are lost here exactly as a real kill -9 loses
             # them, which is what the WAL recovery replay must cover
             return
+        # sock_send fault site: latency stalls the send (inside sock());
+        # drop and injected errors discard this call's results — simulated
+        # egress loss, visible to the loadgen loss gate by design
+        inj = faults._ACTIVE
+        if inj is not None and outs:
+            try:
+                if inj.sock("sock_send") == "drop":
+                    return
+            except OSError as exc:
+                self.logger.error("injected sock_send fault: %s", exc)
+                return
         frame_batch = getattr(self.settings, "engine_frame_batch", 1)
         if origins is not None and len(origins) == len(outs):
             pending = [(o, origins[i]) for i, o in enumerate(outs)

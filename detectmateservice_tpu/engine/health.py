@@ -83,6 +83,14 @@ EVENT_KINDS = {
     "load_shed": "ingress admission control refused frames (tenant over "
                  "quota, or its tier gated by the degradation ladder)",
     "shed_ladder_transition": "the overload degradation ladder changed state",
+    "wal_degraded": "the ingress spool hit (or recovered from) a disk "
+                    "error; state says degraded or restored",
+    "frame_quarantined": "a poison frame exhausted its attempts and moved "
+                         "to the dead-letter queue",
+    "fault_injected": "an armed fault plan executed a fault at an "
+                      "instrumented site (chaos runs only)",
+    "faults_armed": "a seeded fault-injection plan was armed (settings "
+                    "file or POST /admin/faults)",
 }
 
 

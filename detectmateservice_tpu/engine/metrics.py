@@ -469,3 +469,44 @@ SHED_LADDER_STATE = _series(
     "admission currently sheds",
     states=["normal", "shed_best_effort", "shed_burst", "emergency"],
 )
+
+# fault tolerance (faults/ + wal/deadletter.py + spool degradation, dmfault).
+# faults_injected_total only moves while a FaultPlan is armed (chaos runs);
+# in production it stays flat at absence. The WAL disk-error pair is the
+# degradation policy's contract: errors count every append/fsync OSError
+# the spool absorbed instead of letting it kill the EngineLoop thread, and
+# the degraded gauge is 1 exactly while the spool is serving NON-DURABLY
+# after a disk error (wal_on_disk_error: degrade) — the WalDegraded page,
+# cleared when a write succeeds and durability re-arms. The DLQ series are
+# the poison-frame quarantine: depth is read at scrape time off the live
+# spool (same discipline as the WAL gauges), quarantined counts frames
+# moved aside by reason (processing_error / replay / requeue_failed), and
+# a depth that grows run-over-run is the DeadLetterGrowing ticket.
+FAULT_LABELS = ("component_type", "component_id", "site", "kind")
+FAULTS_INJECTED = _series(
+    Counter, "faults_injected_total",
+    "Faults executed by the armed FaultPlan, by instrumented site and "
+    "fault kind (flat at absence unless a chaos plan is armed)",
+    FAULT_LABELS)
+WAL_FSYNC_ERRORS = _series(
+    Counter, "wal_fsync_errors_total",
+    "OSErrors (EIO/ENOSPC/...) absorbed by the ingress spool's append/"
+    "fsync path instead of escaping into the EngineLoop thread")
+WAL_SPOOL_DEGRADED = _series(
+    Gauge, "wal_spool_degraded",
+    "1 while the ingress spool is serving non-durably after a disk error "
+    "(wal_on_disk_error: degrade); re-arms to 0 when writes succeed again")
+DLQ_DEPTH = _series(
+    Gauge, "dlq_depth_frames",
+    "Frames quarantined in the dead-letter spool and not yet requeued or "
+    "purged; read at scrape time off the live DLQ")
+DLQ_REASON_LABELS = ("component_type", "component_id", "reason")
+DLQ_QUARANTINED = _series(
+    Counter, "dlq_quarantined_total",
+    "Frames moved to the dead-letter quarantine after exhausting their "
+    "processing attempts, by reason",
+    DLQ_REASON_LABELS)
+DLQ_REQUEUED = _series(
+    Counter, "dlq_requeued_total",
+    "Quarantined frames re-driven through the pipeline via "
+    "POST /admin/dlq requeue")

@@ -1,0 +1,53 @@
+"""dmfault: seeded, deterministic fault injection at the I/O boundaries.
+
+The package splits into the pure plan (:mod:`plan` — seeded schedule,
+validation, replayable decisions) and the armed runtime (:mod:`injector` —
+counters, metrics, events, the actual raises/sleeps). Production pays one
+branch per site: each instrumented boundary does::
+
+    inj = faults._ACTIVE
+    if inj is not None:
+        ...  # fault check
+
+and ``_ACTIVE`` is None unless an operator armed a plan via settings
+(``fault_plan_file``) or ``POST /admin/faults``. Arming swaps a single
+module-global reference (GIL-atomic), so sites racing an arm/disarm see
+either the old injector or the new one, never a torn state.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .injector import FaultInjected, FaultInjector
+from .plan import SITES, FaultPlan, FaultPlanError, FaultSpec
+
+__all__ = [
+    "SITES", "FaultPlan", "FaultPlanError", "FaultSpec",
+    "FaultInjected", "FaultInjector", "arm", "disarm", "active",
+]
+
+# the one production branch: None → every site is a no-op
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def arm(plan: FaultPlan, **kwargs: Any) -> FaultInjector:
+    """Arm ``plan`` process-wide; returns the live injector. Re-arming
+    replaces the previous injector (its fired log is dropped — snapshot
+    first if you need it)."""
+    global _ACTIVE
+    injector = FaultInjector(plan, **kwargs)
+    _ACTIVE = injector
+    return injector
+
+
+def disarm() -> Optional[FaultInjector]:
+    """Disarm fault injection; returns the injector that was active (so
+    callers can keep its fired log as the run artifact)."""
+    global _ACTIVE
+    injector = _ACTIVE
+    _ACTIVE = None
+    return injector
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE

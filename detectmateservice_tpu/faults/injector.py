@@ -1,0 +1,202 @@
+"""FaultInjector: the armed runtime that executes a :class:`FaultPlan`.
+
+Production builds pay one branch per instrumented site — ``faults._ACTIVE``
+is ``None`` unless an operator armed a plan (settings ``fault_plan_file`` or
+``POST /admin/faults``), and every site guards its call with that check.
+Armed, each site call advances that site's op counter, asks the plan
+whether a fault is due, and executes it:
+
+* filesystem sites raise the real ``OSError`` (``EIO``/``ENOSPC``) the
+  disk would have raised — the degradation policy under test sees exactly
+  the production failure shape;
+* socket sites stall (``latency``), swallow (``drop``), or raise
+  (``error`` → ``ECONNRESET``);
+* the processor site raises :class:`FaultInjected` (``raise``/poison
+  ``match``) or stalls (``slow``/``hang``) — an injected processor
+  exception travels the same except-path a real model bug would.
+
+Every executed fault is recorded in a bounded ``fired`` log —
+``(site, kind, op)`` triples, the artifact the chaos soak compares against
+the plan's precomputed schedule to prove determinism — counted on
+``faults_injected_total{site,kind}``, and surfaced as a rate-limited
+``fault_injected`` structured event.
+
+Thread-safety: sites fire from the engine thread, the rollout thread
+(checkpoint commits), and admin verbs; op counters and the fired log are
+mutated under one small lock (only ever paid while armed — chaos runs, not
+production). Sleeps happen outside the lock.
+"""
+from __future__ import annotations
+
+import errno
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .plan import SITES, FaultPlan, FaultSpec
+
+_ERRNOS = {"eio": errno.EIO, "enospc": errno.ENOSPC}
+_EVENT_INTERVAL_S = 1.0      # per-site fault_injected event rate limit
+_MAX_FIRED = 10000           # bounded fired log (schedule artifact)
+
+
+class FaultInjected(RuntimeError):
+    """An injected processor-dispatch fault (never raised unarmed)."""
+
+
+class FaultInjector:
+    def __init__(
+        self,
+        plan: FaultPlan,
+        *,
+        labels: Optional[Dict[str, str]] = None,
+        events: Optional[Callable[[Dict[str, Any]], Any]] = None,
+        logger: Optional[logging.Logger] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self.plan = plan
+        # the metric carries the standard component labels; a bare injector
+        # (unit tests, scripts) gets a recognizable default pair
+        self._labels = {"component_type": "faults", "component_id": "chaos"}
+        self._labels.update(labels or {})
+        self._events = events
+        self._logger = logger or logging.getLogger("faults")
+        self._sleep = sleep
+        self._by_site: Dict[str, List[FaultSpec]] = {}
+        for spec in plan.specs:
+            self._by_site.setdefault(spec.site, []).append(spec)
+        self._lock = threading.Lock()
+        self._ops: Dict[str, int] = {site: 0 for site in SITES}
+        self.fired: List[Dict[str, Any]] = []
+        self._fired_dropped = 0
+        self._injected_total = 0
+        self._last_event_t: Dict[str, float] = {}
+        # hoisted metric children per (site, kind); lazy import so merely
+        # importing the faults package (plan validation, docs tooling)
+        # stays dependency-free
+        self._m_injected: Dict[tuple, Any] = {}
+        try:
+            from ..engine import metrics as m
+
+            self._metrics = m
+        except ImportError:             # pragma: no cover - hermetic envs
+            self._metrics = None
+
+    # -- decision core ----------------------------------------------------
+    def _advance(self, site: str) -> int:
+        with self._lock:
+            op = self._ops.get(site, 0)
+            self._ops[site] = op + 1
+        return op
+
+    def _record(self, spec: FaultSpec, op: int) -> None:
+        with self._lock:
+            self._injected_total += 1
+            if len(self.fired) < _MAX_FIRED:
+                self.fired.append(
+                    {"site": spec.site, "kind": spec.kind, "op": op})
+            else:
+                self._fired_dropped += 1
+        if self._metrics is not None:
+            child = self._m_injected.get((spec.site, spec.kind))
+            if child is None:
+                child = self._metrics.FAULTS_INJECTED().labels(
+                    site=spec.site, kind=spec.kind, **self._labels)
+                self._m_injected[(spec.site, spec.kind)] = child
+            child.inc()
+        now = time.monotonic()
+        last = self._last_event_t.get(spec.site, -_EVENT_INTERVAL_S)
+        if now - last >= _EVENT_INTERVAL_S:
+            self._last_event_t[spec.site] = now
+            event = {"kind": "fault_injected", "site": spec.site,
+                     "fault_kind": spec.kind, "op": op,
+                     "seed": self.plan.seed}
+            if self._events is not None:
+                self._events(event)
+            else:
+                self._logger.warning("fault_injected: %s", event)
+
+    def _due(self, site: str) -> Optional[tuple]:
+        op = self._advance(site)
+        for spec in self._by_site.get(site, ()):
+            if not spec.match and self.plan.due(spec, op):
+                return spec, op
+        return None
+
+    # -- site entry points -------------------------------------------------
+    def fs(self, site: str) -> bool:
+        """Filesystem site: raises the injected ``OSError`` for eio/enospc;
+        returns True when a ``torn`` commit is due (the caller aborts
+        between temp write and rename), else False."""
+        hit = self._due(site)
+        if hit is None:
+            return False
+        spec, op = hit
+        self._record(spec, op)
+        if spec.kind == "torn":
+            return True
+        code = _ERRNOS[spec.kind]
+        raise OSError(code, f"injected {spec.kind} at {site} op {op}")
+
+    def sock(self, site: str) -> Optional[str]:
+        """Socket site: sleeps through a latency fault (returns None),
+        returns ``"drop"`` for a drop fault, raises ``OSError`` for
+        error/partition faults."""
+        hit = self._due(site)
+        if hit is None:
+            return None
+        spec, op = hit
+        self._record(spec, op)
+        if spec.kind == "latency":
+            if spec.delay_ms > 0:
+                self._sleep(spec.delay_ms / 1000.0)
+            return None
+        if spec.kind == "drop":
+            return "drop"
+        raise OSError(errno.ECONNRESET,
+                      f"injected socket error at {site} op {op}")
+
+    def proc(self, frames: Sequence[bytes]) -> None:
+        """Processor-dispatch site: raises :class:`FaultInjected` for a
+        rate-based ``raise`` fault or any poison ``match`` hit, sleeps for
+        slow/hang. Called with the chunk about to be dispatched — and again
+        with single-frame chunks during poison isolation, where a match
+        fires again by construction (that determinism is what drives the
+        frame into the dead-letter queue instead of an endless retry)."""
+        op = self._advance("proc")
+        for spec in self._by_site.get("proc", ()):
+            if spec.match:
+                needle = spec.match.encode("utf-8")
+                if any(needle in frame for frame in frames):
+                    self._record(spec, op)
+                    raise FaultInjected(
+                        f"injected poison: payload matched {spec.match!r}")
+            elif self.plan.due(spec, op):
+                self._record(spec, op)
+                if spec.kind == "raise":
+                    raise FaultInjected(f"injected processor raise at op {op}")
+                if spec.delay_ms > 0:       # slow / hang
+                    self._sleep(spec.delay_ms / 1000.0)
+                return
+
+    # -- admin plane -------------------------------------------------------
+    def snapshot(self, fired_tail: int = 100) -> Dict[str, Any]:
+        with self._lock:
+            ops = dict(self._ops)
+            tail = list(self.fired[-fired_tail:])
+            total = self._injected_total
+            dropped = self._fired_dropped
+        return {
+            "armed": True,
+            "plan": self.plan.doc(),
+            "ops": {site: n for site, n in sorted(ops.items()) if n},
+            "injected_total": total,
+            "fired_logged": total - dropped,
+            "fired_tail": tail,
+        }
+
+    def fired_schedule(self) -> List[Dict[str, Any]]:
+        """The full (bounded) fired log — the committed chaos artifact."""
+        with self._lock:
+            return list(self.fired)

@@ -1,0 +1,178 @@
+"""FaultPlan: a seeded, deterministic schedule of injected faults.
+
+The plan is the *contract* of every chaos run: given the same seed and the
+same spec list, the decision for (site, kind, op_index) is a pure function —
+no RNG state, no wall clock — so a recorded chaos scenario replays its exact
+fault schedule from nothing but the committed seed. That property is what
+every future chaos bisection depends on: shrink the window, rerun, and the
+faults land on the same operations.
+
+A :class:`FaultSpec` names one fault stream:
+
+* ``site`` — the instrumented boundary (see :data:`SITES`): socket ops
+  (``sock_send``/``sock_recv``/``sock_dial``), filesystem ops
+  (``wal_append``/``wal_fsync``/``fs_commit``), processor dispatch
+  (``proc``);
+* ``kind`` — what happens there (latency/drop/error for sockets,
+  eio/enospc/torn for disk, raise/hang/slow for the processor);
+* ``rate`` — per-operation probability, drawn deterministically from the
+  seed (``rate=1.0`` fires on every op in the window);
+* ``start_op``/``stop_op`` — the op-index window the stream is live in
+  (op indices are per-site counters, so timing is expressed in operations,
+  not wall seconds — the only clock that replays exactly);
+* ``delay_ms`` — for latency/slow/hang kinds, how long the site stalls;
+* ``match`` — processor site only: a substring that marks POISON payloads.
+  A match-spec ignores ``rate``/windows and fires deterministically for
+  every chunk containing the marker — the reproducible poison frame the
+  dead-letter quarantine exists for.
+
+The decision draw hashes ``seed:site:kind:op`` (crc32 → uniform in [0,1)),
+so it is independent of evaluation order, platform, and process — two runs
+that perform the same operations inject the same faults.
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# site → allowed kinds; arming validates against this so a typo'd spec
+# fails loudly instead of silently never firing
+SITES: Dict[str, Tuple[str, ...]] = {
+    "sock_send": ("latency", "drop", "error"),
+    "sock_recv": ("latency", "drop", "error"),
+    "sock_dial": ("error",),
+    "wal_append": ("eio", "enospc"),
+    "wal_fsync": ("eio", "enospc"),
+    "fs_commit": ("eio", "torn"),
+    "proc": ("raise", "hang", "slow"),
+}
+
+
+class FaultPlanError(ValueError):
+    """A fault plan names an unknown site/kind or carries a bad field."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    kind: str
+    rate: float = 1.0
+    start_op: int = 0
+    stop_op: Optional[int] = None
+    delay_ms: float = 0.0
+    match: str = ""
+
+    def validate(self) -> None:
+        kinds = SITES.get(self.site)
+        if kinds is None:
+            raise FaultPlanError(
+                f"unknown fault site {self.site!r} (sites: {sorted(SITES)})")
+        if self.kind not in kinds:
+            raise FaultPlanError(
+                f"site {self.site!r} has no kind {self.kind!r} "
+                f"(kinds: {kinds})")
+        if not 0.0 <= self.rate <= 1.0:
+            raise FaultPlanError(f"rate {self.rate} outside [0, 1]")
+        if self.start_op < 0:
+            raise FaultPlanError(f"start_op {self.start_op} negative")
+        if self.stop_op is not None and self.stop_op <= self.start_op:
+            raise FaultPlanError(
+                f"stop_op {self.stop_op} <= start_op {self.start_op}")
+        if self.match and self.site != "proc":
+            raise FaultPlanError(
+                f"match is processor-site only (spec site {self.site!r})")
+
+    def doc(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"site": self.site, "kind": self.kind,
+                               "rate": self.rate, "start_op": self.start_op}
+        if self.stop_op is not None:
+            out["stop_op"] = self.stop_op
+        if self.delay_ms:
+            out["delay_ms"] = self.delay_ms
+        if self.match:
+            out["match"] = self.match
+        return out
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    seed: int
+    specs: Tuple[FaultSpec, ...] = field(default_factory=tuple)
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "FaultPlan":
+        """Build (and validate) a plan from the JSON shape the settings
+        file and ``POST /admin/faults`` carry:
+        ``{"seed": int, "specs": [{"site": ..., "kind": ..., ...}, ...]}``."""
+        if not isinstance(doc, dict):
+            raise FaultPlanError("fault plan must be a JSON object")
+        try:
+            seed = int(doc.get("seed", 0))
+        except (TypeError, ValueError):
+            raise FaultPlanError(f"bad seed {doc.get('seed')!r}")
+        raw = doc.get("specs", [])
+        if not isinstance(raw, list):
+            raise FaultPlanError("specs must be a list")
+        specs = []
+        allowed = {"site", "kind", "rate", "start_op", "stop_op",
+                   "delay_ms", "match"}
+        for i, entry in enumerate(raw):
+            if not isinstance(entry, dict):
+                raise FaultPlanError(f"spec #{i} is not an object")
+            unknown = set(entry) - allowed
+            if unknown:
+                raise FaultPlanError(
+                    f"spec #{i} has unknown fields {sorted(unknown)}")
+            try:
+                spec = FaultSpec(
+                    site=str(entry.get("site", "")),
+                    kind=str(entry.get("kind", "")),
+                    rate=float(entry.get("rate", 1.0)),
+                    start_op=int(entry.get("start_op", 0)),
+                    stop_op=(None if entry.get("stop_op") is None
+                             else int(entry["stop_op"])),
+                    delay_ms=float(entry.get("delay_ms", 0.0)),
+                    match=str(entry.get("match", "")))
+            except (TypeError, ValueError) as exc:
+                raise FaultPlanError(f"spec #{i} malformed: {exc}")
+            spec.validate()
+            specs.append(spec)
+        return cls(seed=seed, specs=tuple(specs))
+
+    def doc(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "specs": [s.doc() for s in self.specs]}
+
+    # -- the deterministic decision --------------------------------------
+    def draw(self, site: str, kind: str, op: int) -> float:
+        """Uniform [0, 1) draw for one (site, kind, op) — a pure function
+        of the seed, independent of call order and process."""
+        key = f"{self.seed}:{site}:{kind}:{op}".encode("ascii")
+        return (zlib.crc32(key) & 0xFFFFFFFF) / 4294967296.0
+
+    def due(self, spec: FaultSpec, op: int) -> bool:
+        """Whether ``spec`` fires on its site's ``op``-th operation.
+        Match-specs are payload-driven (the injector tests the payload);
+        this covers the windowed/rated streams."""
+        if spec.match:
+            return False
+        if op < spec.start_op:
+            return False
+        if spec.stop_op is not None and op >= spec.stop_op:
+            return False
+        if spec.rate >= 1.0:
+            return True
+        return self.draw(spec.site, spec.kind, op) < spec.rate
+
+    def schedule(self, site: str, ops: int) -> List[Tuple[int, str]]:
+        """The planned (op_index, kind) fault list for a site's first
+        ``ops`` operations — computable with zero runtime state, which is
+        exactly the replayability proof the chaos soak gates on: two
+        fresh plans with the same seed produce identical schedules."""
+        out: List[Tuple[int, str]] = []
+        for op in range(ops):
+            for spec in self.specs:
+                if spec.site == site and self.due(spec, op):
+                    out.append((op, spec.kind))
+                    break
+        return out

@@ -362,6 +362,33 @@ class ServiceSettings(BaseModel):
     # (ops/alerts.yml) page before disk becomes the operator's problem.
     wal_retain_bytes: int = Field(default=1024 * 1024 * 1024, ge=4096)
     wal_retain_age_s: float = Field(default=86400.0, gt=0.0)
+    # disk-fault policy (wal/spool.py): what the spool does when an
+    # append/fsync/manifest OSError (EIO/ENOSPC) is absorbed — the error
+    # itself can never kill the EngineLoop thread. degrade (default):
+    # keep serving NON-durably with wal_spool_degraded raised, re-arming
+    # on the next successful write; shed: drop frames that could not be
+    # made durable (durability over availability); halt: escalate as
+    # WalError and stop the stage.
+    wal_on_disk_error: str = Field(default="degrade",
+                                   pattern="^(degrade|shed|halt)$")
+
+    # -- fault injection + dead-letter quarantine: dmfault (faults/) ------
+    # JSON FaultPlan file ({"seed": int, "specs": [{site, kind, rate,
+    # start_op, stop_op, delay_ms, match}, ...]}) armed at service start;
+    # None (the default) arms nothing and every fault site costs one
+    # is-None branch. POST /admin/faults arms/disarms at runtime.
+    fault_plan_file: Optional[str] = None
+    # dead-letter quarantine (wal/deadletter.py): a frame whose processing
+    # raised on every one of dlq_max_attempts attempts moves to the DLQ
+    # (reason + error + tenant/seq context) instead of crash-looping
+    # recovery replay or being silently dropped-and-acked.
+    dlq_max_attempts: int = Field(default=3, ge=1, le=100)
+    # bound on retained quarantined frames; at capacity the oldest entry
+    # is evicted (newest evidence wins)
+    dlq_max_frames: int = Field(default=1024, ge=1, le=1048576)
+    # DLQ directory; defaults to <wal_dir>/dlq when durable_ingress is on,
+    # memory-only quarantine otherwise
+    dlq_dir: Optional[str] = None
 
     # -- multi-tenant admission control: dmshed (shed/) -------------------
     # When true, the engine ingress runs per-tenant token-bucket admission

@@ -8,10 +8,13 @@ pulling the orbax/jax import chain. ``utils.checkpoint`` re-exports
 """
 from __future__ import annotations
 
+import errno
 import json
 import os
 from pathlib import Path
 from typing import Any, Dict
+
+from .. import faults
 
 
 def fsync_dir(directory: Path) -> None:
@@ -34,9 +37,20 @@ def write_json_atomic(path: Path, doc: Dict[str, Any]) -> None:
     path = Path(path)
     tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
     data = json.dumps(doc, indent=0, sort_keys=True)
+    # fs_commit fault site: eio raises before any byte is written; torn
+    # writes the temp sibling then aborts before os.replace — exactly the
+    # crash window the commit pattern must survive (the old document stays)
+    torn = False
+    inj = faults._ACTIVE
+    if inj is not None:
+        torn = inj.fs("fs_commit")
     with open(tmp, "w", encoding="utf-8") as fh:
         fh.write(data)
         fh.flush()
         os.fsync(fh.fileno())
+    if torn:
+        raise OSError(errno.EIO,
+                      f"injected torn commit: {tmp.name} written, "
+                      f"rename to {path.name} aborted")
     os.replace(tmp, path)
     fsync_dir(path.parent)

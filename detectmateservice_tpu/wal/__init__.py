@@ -29,4 +29,5 @@ from .segment import (  # noqa: F401
     scan_segment,
     segment_name,
 )
+from .deadletter import DeadLetterSpool  # noqa: F401
 from .spool import IngressSpool  # noqa: F401

@@ -1,0 +1,283 @@
+"""DeadLetterSpool: the poison-frame quarantine behind the DLQ verbs.
+
+A frame whose processing keeps raising is POISON: retrying it forever
+wedges the stage (the confirmed failure mode this fixes — under
+``durable_ingress`` a poison frame in the WAL's unacked suffix turned
+every restart into the same crash-replay loop), and dropping it silently
+destroys the evidence. The engine instead gives each frame a bounded
+number of processing attempts (``dlq_max_attempts``) and then moves it
+HERE, with its reason, last error, attempt count, and whatever
+tenant/sequence context the ingress still had — processing converges, the
+frame survives for a human.
+
+Storage is one JSONL file (``dlq.jsonl``) in the DLQ directory: one JSON
+object per line, the frame bytes base64-encoded inline. Appends go
+through an unbuffered handle and fsync per record — quarantine is a cold
+path (it has already cost ``dlq_max_attempts`` failed dispatches), so the
+per-record durability tax is noise, and it means a quarantined frame
+survives the very crash its poison may be about to cause. A torn final
+line (power loss mid-append) is skipped on load, same contract as the WAL
+segment reader. Requeue/purge compact the file through the proven
+temp + fsync + ``os.replace`` + dir-fsync commit.
+
+The spool is bounded (``dlq_max_frames``): at capacity the OLDEST entry
+is evicted (newest evidence wins), counted on the snapshot. With no
+directory configured (``durable_ingress`` off and no ``dlq_dir``) it runs
+memory-only — quarantine still converges, the evidence just does not
+survive a restart.
+
+Threading: ``quarantine`` runs on the engine thread, the admin verbs
+(``snapshot``/``requeue``/``purge``) on web threads — every method takes
+the one internal lock; all paths are cold by construction.
+"""
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..engine import metrics as m
+from ..utils.atomicio import fsync_dir
+
+_DLQ_FILE = "dlq.jsonl"
+_EVENT_INTERVAL_S = 1.0     # per-reason frame_quarantined event rate limit
+
+
+class DeadLetterSpool:
+    def __init__(self, directory: Optional[str], *,
+                 max_frames: int = 1024,
+                 labels: Optional[Dict[str, str]] = None,
+                 events: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                 logger: Optional[logging.Logger] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.directory = Path(directory) if directory else None
+        self.max_frames = max(1, int(max_frames))
+        self._labels = {"component_type": "dlq", "component_id": "dlq"}
+        self._labels.update(labels or {})
+        self._events = events
+        self.logger = logger or logging.getLogger("dlq")
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: List[Dict[str, Any]] = []    # oldest first
+        self._next_id = 1
+        self.quarantined_total = 0
+        self.requeued_total = 0
+        self.purged_total = 0
+        self.evicted_total = 0
+        self._fh = None
+        self._last_event_t: Dict[str, float] = {}
+        # hoisted metric children (DM-H001): per-reason on first sight
+        self._m_quarantined: Dict[str, Any] = {}
+        self._m_requeued = m.DLQ_REQUEUED().labels(**self._labels)
+        if self.directory is not None:
+            self.directory.mkdir(parents=True, exist_ok=True)
+            self._load()
+            self._open_append()
+
+    # -- persistence -----------------------------------------------------
+    @property
+    def path(self) -> Optional[Path]:
+        return (self.directory / _DLQ_FILE) if self.directory else None
+
+    def _load(self) -> None:
+        """Rebuild the quarantine from disk; a torn/garbled line (power
+        loss mid-append) ends the readable prefix, like the WAL's
+        torn-tail rule."""
+        path = self.path
+        if path is None or not path.exists():
+            return
+        kept: List[Dict[str, Any]] = []
+        with open(path, "rb") as fh:
+            for lineno, line in enumerate(fh, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                    doc["frame"] = base64.b64decode(doc.pop("frame_b64"))
+                except (ValueError, KeyError, TypeError,
+                        binascii.Error) as exc:
+                    self.logger.warning(
+                        "DLQ %s: unreadable line %d ends the readable "
+                        "prefix (%s)", path.name, lineno, exc)
+                    break
+                kept.append(doc)
+        self._entries = kept
+        if kept:
+            self._next_id = max(e.get("id", 0) for e in kept) + 1
+
+    def _open_append(self) -> None:
+        # unbuffered like the WAL segments: an append that returned reaches
+        # the kernel; the per-record fsync below makes it power-loss-proof
+        # dmlint: ignore[DM-L001] every caller holds _lock (compaction paths) or predates publication (__init__)
+        self._fh = open(self.path, "ab", buffering=0)
+
+    def _append_record(self, entry: Dict[str, Any]) -> None:
+        if self._fh is None:
+            return
+        doc = dict(entry)
+        doc["frame_b64"] = base64.b64encode(doc.pop("frame")).decode("ascii")
+        line = json.dumps(doc, sort_keys=True).encode("utf-8") + b"\n"
+        try:
+            self._fh.write(line)
+            os.fsync(self._fh.fileno())
+        except OSError as exc:
+            # the disk may be the very fault being injected/suffered; the
+            # in-memory quarantine still converges processing
+            self.logger.error("DLQ append failed (%s); entry %d held "
+                              "in memory only", exc, entry["id"])
+
+    def _compact(self) -> None:
+        """Rewrite the file to match ``self._entries`` (after requeue/
+        purge/evict) through the temp+fsync+replace+dir-fsync commit."""
+        path = self.path
+        if path is None:
+            return
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
+        try:
+            with open(tmp, "wb") as fh:
+                for entry in self._entries:
+                    doc = dict(entry)
+                    doc["frame_b64"] = base64.b64encode(
+                        doc.pop("frame")).decode("ascii")
+                    fh.write(json.dumps(doc, sort_keys=True).encode("utf-8")
+                             + b"\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            fsync_dir(path.parent)
+        except OSError as exc:
+            self.logger.error("DLQ compaction failed: %s", exc)
+        self._open_append()
+
+    # -- engine-side ------------------------------------------------------
+    def quarantine(self, frame: bytes, *, reason: str, error: str = "",
+                   attempts: int = 0, tenant: Optional[str] = None,
+                   seq: Optional[int] = None,
+                   trace_id: Optional[str] = None) -> int:
+        """Move one poison frame aside; returns its DLQ entry id."""
+        with self._lock:
+            entry = {
+                "id": self._next_id,
+                "reason": reason,
+                "error": error[:512],
+                "attempts": attempts,
+                "tenant": tenant,
+                "seq": seq,
+                "trace_id": trace_id,
+                "frame_bytes": len(frame),
+                "quarantined_unix": round(self._clock(), 3),
+                "frame": bytes(frame),
+            }
+            self._next_id += 1
+            self._entries.append(entry)
+            self.quarantined_total += 1
+            evicted = None
+            if len(self._entries) > self.max_frames:
+                evicted = self._entries.pop(0)
+                self.evicted_total += 1
+            self._append_record(entry)
+            if evicted is not None:
+                self._compact()
+        child = self._m_quarantined.get(reason)
+        if child is None:
+            child = m.DLQ_QUARANTINED().labels(reason=reason, **self._labels)
+            self._m_quarantined[reason] = child
+        child.inc()
+        self.logger.error(
+            "frame quarantined to DLQ: id=%d reason=%s attempts=%d "
+            "bytes=%d error=%s", entry["id"], reason, attempts, len(frame),
+            error[:200])
+        self._maybe_emit(entry)
+        return entry["id"]
+
+    def _maybe_emit(self, entry: Dict[str, Any]) -> None:
+        if self._events is None:
+            return
+        now = time.monotonic()
+        last = self._last_event_t.get(entry["reason"], -_EVENT_INTERVAL_S)
+        if now - last < _EVENT_INTERVAL_S:
+            return
+        self._last_event_t[entry["reason"]] = now
+        self._events({
+            "kind": "frame_quarantined",
+            "dlq_id": entry["id"],
+            "reason": entry["reason"],
+            "error": entry["error"],
+            "attempts": entry["attempts"],
+            "tenant": entry["tenant"],
+            "seq": entry["seq"],
+            "frame_bytes": entry["frame_bytes"],
+            # dmlint: ignore[DM-L001] advisory depth in an event body: GIL-atomic len read, exactness not required
+            "dlq_depth": len(self._entries),
+        })
+
+    # -- admin verbs -------------------------------------------------------
+    def requeue(self, entry_id: Optional[int] = None
+                ) -> List[Tuple[int, bytes]]:
+        """Remove entries (one, or all with no id) and return their frames
+        for re-injection. Requeue is at-most-once: a frame handed back is
+        no longer the DLQ's to protect."""
+        with self._lock:
+            taken, kept = self._split(entry_id)
+            self._entries = kept
+            if taken:
+                self.requeued_total += len(taken)
+                self._compact()
+        if taken:
+            self._m_requeued.inc(len(taken))
+        return [(e["id"], e["frame"]) for e in taken]
+
+    def purge(self, entry_id: Optional[int] = None) -> int:
+        with self._lock:
+            taken, kept = self._split(entry_id)
+            self._entries = kept
+            if taken:
+                self.purged_total += len(taken)
+                self._compact()
+        return len(taken)
+
+    def _split(self, entry_id: Optional[int]
+               ) -> Tuple[List[Dict], List[Dict]]:
+        if entry_id is None:
+            return list(self._entries), []
+        taken = [e for e in self._entries if e["id"] == entry_id]
+        kept = [e for e in self._entries if e["id"] != entry_id]
+        return taken, kept
+
+    # -- observability -----------------------------------------------------
+    def depth_frames(self) -> float:
+        """Gauge read (scrape threads, Gauge.set_function): length read of
+        a list the GIL keeps internally consistent."""
+        # dmlint: ignore[DM-L001] lock-free gauge read: GIL-atomic len of a list replaced only under _lock
+        return float(len(self._entries))
+
+    def snapshot(self, limit: int = 64) -> Dict[str, Any]:
+        with self._lock:
+            entries = [{k: v for k, v in e.items() if k != "frame"}
+                       for e in self._entries[-limit:]]
+            return {
+                "depth_frames": len(self._entries),
+                "max_frames": self.max_frames,
+                "quarantined_total": self.quarantined_total,
+                "requeued_total": self.requeued_total,
+                "purged_total": self.purged_total,
+                "evicted_total": self.evicted_total,
+                "directory": str(self.directory) if self.directory else None,
+                "entries": entries,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

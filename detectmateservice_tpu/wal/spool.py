@@ -36,6 +36,24 @@ Observability reads (``depth_frames``/``spool_bytes``/
 ``oldest_unacked_age_seconds``) come from scrape threads via
 ``Gauge.set_function`` and are single-int/tuple reads — lock-free on
 purpose, same discipline as the heartbeat gauges.
+
+Disk-fault policy (``wal_on_disk_error``): an ``OSError`` out of the
+append/fsync/manifest path — a real EIO/ENOSPC or one injected at the
+``wal_append``/``wal_fsync`` fault sites — is ABSORBED here, never allowed
+to escape into the engine loop tick and kill the EngineLoop thread. Every
+absorbed error is counted (``wal_fsync_errors_total``) and the first error
+of a bad stretch emits a structured ``wal_degraded`` event + one log line
+(transition-edge logging, not a per-tick storm). Then the policy decides:
+
+* ``degrade`` (default) — keep serving NON-durably: ``append`` reports the
+  frame un-spooled (the engine processes it anyway, it just loses crash
+  replay), the ``wal_spool_degraded`` gauge goes to 1, and every later
+  append retries the disk so the first success re-arms durability (gauge
+  back to 0, ``wal_degraded`` event with ``state: restored``);
+* ``shed`` — same absorption, but the engine DROPS frames the spool could
+  not make durable (durability over availability);
+* ``halt`` — escalate as ``WalError``: the operator asked the stage to
+  stop rather than serve non-durably.
 """
 from __future__ import annotations
 
@@ -46,6 +64,7 @@ from collections import deque
 from pathlib import Path
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from .. import faults
 from ..utils.atomicio import fsync_dir, write_json_atomic
 from ..utils.threadcheck import assert_affinity
 from .segment import (
@@ -93,6 +112,9 @@ class IngressSpool:
                  retain_bytes: int = 1024 * 1024 * 1024,
                  retain_age_s: float = 86400.0,
                  fsync_observer: Optional[Callable[[float], None]] = None,
+                 on_disk_error: str = "degrade",
+                 events: Optional[Callable[[Dict], object]] = None,
+                 disk_error_observer: Optional[Callable[[], None]] = None,
                  logger: Optional[logging.Logger] = None,
                  clock: Callable[[], float] = time.time) -> None:
         self.directory = Path(directory)
@@ -102,6 +124,14 @@ class IngressSpool:
         self.retain_bytes = int(retain_bytes)
         self.retain_age_s = float(retain_age_s)
         self._fsync_observer = fsync_observer
+        if on_disk_error not in ("degrade", "shed", "halt"):
+            raise WalError(
+                f"wal_on_disk_error {on_disk_error!r} not in degrade|shed|halt")
+        self.on_disk_error = on_disk_error
+        self._events = events
+        self._disk_error_observer = disk_error_observer
+        self._degraded = False                  # serving non-durably
+        self.disk_errors = 0                    # absorbed OSErrors, total
         self.logger = logger or logging.getLogger("wal")
         self._clock = clock                     # wall clock (ages, stamps)
 
@@ -224,11 +254,51 @@ class IngressSpool:
         # the whole burst's appends out of the Python file buffer).
         self._fh = open(self._active.path, "ab", buffering=0)
 
+    # -- disk-fault policy ------------------------------------------------
+    def _disk_error(self, op: str, exc: OSError) -> None:
+        """Absorb one append/fsync/manifest ``OSError`` per the configured
+        policy. Counted always; logged + event-emitted once per degraded
+        TRANSITION (the first error of a bad stretch), not per tick."""
+        self.disk_errors += 1
+        if self._disk_error_observer is not None:
+            self._disk_error_observer()
+        if self.on_disk_error == "halt":
+            raise WalError(
+                f"WAL {op} failed with wal_on_disk_error=halt: {exc}"
+            ) from exc
+        if self._degraded:
+            return
+        self._degraded = True
+        self.logger.error(
+            "WAL degraded: %s failed (%s); serving %s until the disk "
+            "recovers (wal_on_disk_error=%s)", op, exc,
+            "non-durably" if self.on_disk_error == "degrade"
+            else "with frames shed", self.on_disk_error)
+        if self._events is not None:
+            self._events({"kind": "wal_degraded", "state": "degraded",
+                          "op": op, "errno": exc.errno, "error": str(exc),
+                          "policy": self.on_disk_error,
+                          "disk_errors_total": self.disk_errors})
+
+    def _rearm(self, op: str) -> None:
+        """First successful disk write after a degraded stretch: durability
+        is live again."""
+        self._degraded = False
+        self.logger.warning(
+            "WAL recovered: %s succeeded after %d absorbed disk errors; "
+            "durability re-armed", op, self.disk_errors)
+        if self._events is not None:
+            self._events({"kind": "wal_degraded", "state": "restored",
+                          "op": op, "policy": self.on_disk_error,
+                          "disk_errors_total": self.disk_errors})
+
     # -- write path (machine-checked: engine thread only) ----------------
     # dmlint: thread(engine)
-    def append(self, frame: bytes) -> int:
+    def append(self, frame: bytes) -> Optional[int]:
         """Durably (after the next fsync tick) record one ingress frame;
-        returns its sequence number."""
+        returns its sequence number — or ``None`` when a disk error was
+        absorbed under degrade/shed and the frame is NOT durable (the
+        engine then serves it non-durably or drops it per the policy)."""
         assert_affinity("engine")
         if self._closed:
             raise WalError("append on a closed spool")
@@ -238,7 +308,26 @@ class IngressSpool:
         if self._active.bytes and \
                 self._active.bytes + len(rec) > self.segment_bytes:
             self._roll()
-        self._fh.write(rec)
+        boundary = self._active.bytes
+        try:
+            inj = faults._ACTIVE
+            if inj is not None:
+                inj.fs("wal_append")
+            self._fh.write(rec)
+        except OSError as exc:
+            # torn-record hygiene: a partial write would leave a record the
+            # CRC framing has to truncate on the NEXT recovery — cut it back
+            # to the last known-good boundary now, while we can
+            try:
+                self._fh.truncate(boundary)
+            except OSError:
+                pass        # recovery's torn-tail scan is the backstop
+            self._disk_error("append", exc)
+            return None
+        # a successful buffered write does NOT re-arm: durability is only
+        # proven by a successful fsync (an fsync-broken disk happily takes
+        # writes into the page cache — re-arming here would flap the
+        # degraded gauge per append and hide the outage from WalDegraded)
         self._active.bytes += len(rec)
         self._active.last_seq = seq
         self._active.newest_append_unix = now
@@ -281,16 +370,29 @@ class IngressSpool:
         self._segments.append(self._active)
         self._fh = open(path, "ab", buffering=0)  # see _open_active
 
-    def _fsync(self) -> None:
+    def _fsync(self) -> bool:
         if self._fh is None:
-            return
+            return True
         t0 = time.monotonic()
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
+        try:
+            inj = faults._ACTIVE
+            if inj is not None:
+                inj.fs("wal_fsync")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError as exc:
+            # stamp the attempt so a broken disk is retried once per fsync
+            # interval, not once per engine loop iteration
+            self._last_fsync = time.monotonic()
+            self._disk_error("fsync", exc)
+            return False
         self._dirty_bytes = 0
         self._last_fsync = time.monotonic()
+        if self._degraded:
+            self._rearm("fsync")
         if self._fsync_observer is not None:
             self._fsync_observer(self._last_fsync - t0)
+        return True
 
     # dmlint: thread(engine)
     def tick(self, force: bool = False) -> None:
@@ -307,7 +409,10 @@ class IngressSpool:
                 force or now - self._last_manifest
                 >= self._manifest_interval_s):
             self._retain()
-            self._commit_manifest()
+            try:
+                self._commit_manifest()
+            except OSError as exc:
+                self._disk_error("manifest", exc)
             self._last_manifest = now
 
     def _commit_manifest(self) -> None:
@@ -377,6 +482,11 @@ class IngressSpool:
     def depth_frames(self) -> float:
         return float(self._last_appended - self._acked)
 
+    def degraded_value(self) -> float:
+        """1.0 while serving non-durably after a disk error (the
+        wal_spool_degraded gauge, read at scrape time)."""
+        return 1.0 if self._degraded else 0.0
+
     def spool_bytes(self) -> float:
         return float(sum(seg.bytes for seg in self._segments))
 
@@ -401,5 +511,8 @@ class IngressSpool:
             "spool_bytes": int(self.spool_bytes()),
             "oldest_unacked_age_seconds":
                 round(self.oldest_unacked_age_seconds(), 3),
+            "degraded": self._degraded,
+            "disk_errors": self.disk_errors,
+            "on_disk_error": self.on_disk_error,
             "segments": [seg.doc() for seg in self._segments],
         }

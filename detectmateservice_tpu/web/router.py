@@ -349,6 +349,90 @@ def _replicas_control(service, query, payload) -> Response:
                           "replica": verb(str(addr))})
 
 
+def _faults_status(service, query, payload) -> Response:
+    from .. import faults
+
+    inj = faults.active()
+    if inj is None:
+        return Response(200, {"armed": False})
+    tail = _int_param(query, "tail", default=100) or 0
+    return Response(200, inj.snapshot(fired_tail=tail))
+
+
+# dmlint: thread(admin)
+def _faults_control(service, query, payload) -> Response:
+    from .. import faults
+    from ..faults import FaultPlan, FaultPlanError
+
+    payload = payload or {}
+    action = str(payload.get("action", ""))
+    if action == "disarm":
+        previous = faults.disarm()
+        body = {"detail": "disarmed", "armed": False}
+        if previous is not None:
+            # the final fired log, so a chaos driver can collect its
+            # schedule artifact in the same call that ends the run
+            body["final"] = previous.snapshot(fired_tail=0)
+            body["final"]["armed"] = False
+            body["fired_schedule"] = previous.fired_schedule()
+        return Response(200, body)
+    if action != "arm":
+        raise ValueError(f"unknown action {action!r} "
+                         "(expected 'arm' or 'disarm')")
+    try:
+        plan = FaultPlan.from_dict(payload.get("plan") or {})
+    except FaultPlanError as exc:
+        raise ValueError(str(exc)) from exc
+    inj = faults.arm(plan, labels=dict(service._labels),
+                     events=service.health.emit_event,
+                     logger=service.logger)
+    service.health.emit_event({
+        "kind": "faults_armed", "seed": plan.seed,
+        "specs": len(plan.specs), "source": "admin",
+    })
+    return Response(200, inj.snapshot(fired_tail=0))
+
+
+def _dlq_status(service, query, payload) -> Response:
+    dlq = getattr(service.engine, "dlq", None)
+    if dlq is None:
+        return Response(404, {"detail": "this stage has no dead-letter "
+                                        "queue (engine not built)"})
+    limit = _int_param(query, "limit", default=64) or 0
+    return Response(200, dlq.snapshot(limit=limit))
+
+
+# dmlint: thread(admin)
+def _dlq_control(service, query, payload) -> Response:
+    dlq = getattr(service.engine, "dlq", None)
+    if dlq is None:
+        return Response(404, {"detail": "this stage has no dead-letter "
+                                        "queue (engine not built)"})
+    payload = payload or {}
+    action = str(payload.get("action", ""))
+    entry_id = payload.get("id")
+    if entry_id is not None:
+        try:
+            entry_id = int(entry_id)
+        except (TypeError, ValueError):
+            raise ValueError("id must be an integer DLQ entry id") from None
+    if action == "purge":
+        purged = dlq.purge(entry_id)
+        return Response(200, {"detail": "purged", "purged": purged,
+                              "depth_frames": int(dlq.depth_frames())})
+    if action == "requeue":
+        # at-most-once: once handed to the engine's requeue deque the
+        # frames are no longer the DLQ's to protect
+        taken = dlq.requeue(entry_id)
+        queued = service.engine.requeue_frames(
+            [frame for _id, frame in taken])
+        return Response(200, {"detail": "requeued", "requeued": queued,
+                              "ids": [i for i, _frame in taken],
+                              "depth_frames": int(dlq.depth_frames())})
+    raise ValueError(f"unknown action {action!r} "
+                     "(expected 'requeue' or 'purge')")
+
+
 # one row per route; dmlint DM-C007/8 keeps this table and the route table
 # in docs/usage.md synchronized in both directions
 ROUTES: Tuple[Route, ...] = (
@@ -371,6 +455,10 @@ ROUTES: Tuple[Route, ...] = (
           "model lifecycle status (?history=1 for the checkpoint log)"),
     Route("GET", "/admin/replay", _replay_status,
           "WAL replay status + the live ingress spool's stats"),
+    Route("GET", "/admin/faults", _faults_status,
+          "fault-injection status: armed plan, op counters, fired log"),
+    Route("GET", "/admin/dlq", _dlq_status,
+          "dead-letter queue: quarantined poison frames + totals"),
     Route("GET", "/admin/tenants", _tenants,
           "admission control: per-tier/per-tenant admitted+shed counters "
           "and the current degradation-ladder state"),
@@ -389,6 +477,10 @@ ROUTES: Tuple[Route, ...] = (
           "operator drain/undrain of one replica"),
     Route("POST", "/admin/model", _model_control,
           "model lifecycle verbs: promote/rollback/pin/unpin/cycle"),
+    Route("POST", "/admin/faults", _faults_control,
+          "arm a seeded fault plan or disarm the active one"),
+    Route("POST", "/admin/dlq", _dlq_control,
+          "requeue or purge quarantined frames (one id or all)"),
     Route("POST", "/admin/replay", _replay_control,
           "replay a recorded WAL spool: pipeline re-drive or offline "
           "shadow-scoring of a dmroll candidate"),

@@ -82,6 +82,19 @@ SCENARIOS = {
                        "aggressor only (exact per-tenant counters off "
                        "/admin/tenants), the load_shed event emitted, and "
                        "ShedRateHigh actually firing"),
+    "chaos_mesh": (("WalDegraded", "DeadLetterGrowing"),
+                   "a seeded dmfault plan composes three fault families "
+                   "under continued load: 5% socket-send latency, a "
+                   "wal_fsync EIO burst against the parser's durable "
+                   "spool (wal_on_disk_error=degrade), and a poison "
+                   "payload marker the processor site raises on — gates: "
+                   "zero non-poison loss, every poison frame quarantined "
+                   "in the DLQ and drained back through requeue after "
+                   "disarm, the engine loop alive through the whole fsync "
+                   "burst, WalDegraded + DeadLetterGrowing actually "
+                   "firing, and the fired fault log equal to the plan's "
+                   "precomputed schedule (the determinism artifact: the "
+                   "committed seed replays the run)"),
     "ingress_crash": (("SpoolAgeHigh",),
                       "the parser (durable_ingress on) wedges mid-burst "
                       "with frames banked unacked in its WAL spool, then "
@@ -359,6 +372,42 @@ def inject_recompiles(n: int = 4, spacing_s: float = 0.5) -> None:
         time.sleep(spacing_s)
 
 
+# chaos_mesh: the committed seed IS the reproduction recipe — rerunning
+# with this plan replays the same fault schedule op-for-op (the
+# fired_equals_planned_schedule gate below proves it on every run). The
+# wal_fsync op window is sized in fsync *attempts*: pre-burst the spool
+# fsyncs once per generator burst (~1-2 ops/s at the soak cadence, the
+# only times dirty bytes exist), degraded it retries every fsync
+# interval (~20/s, dirty stays set), so ops 8..308 is a ~15 s EIO burst
+# starting ~4-8 s into the chaos phase — held well past WalDegraded's
+# scaled `for:`, finished well before the window ends so the re-arm and
+# alert-clear are observed too.
+CHAOS_MESH_POISON = "POISON-PILL"
+CHAOS_MESH_PLAN = {
+    "seed": 411,
+    "specs": [
+        {"site": "sock_send", "kind": "latency", "rate": 0.05,
+         "delay_ms": 20.0},
+        {"site": "wal_fsync", "kind": "eio", "rate": 1.0,
+         "start_op": 8, "stop_op": 308},
+        {"site": "proc", "kind": "raise", "match": CHAOS_MESH_POISON},
+    ],
+}
+
+
+def admin_call(port: int, path: str, doc=None):
+    """One admin-plane round trip against an in-process stage — the soak
+    drives dmfault through the REAL HTTP surface an operator would."""
+    import urllib.request
+
+    data = json.dumps(doc).encode("utf-8") if doc is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scenario", choices=sorted(SCENARIOS), default="none")
@@ -392,11 +441,11 @@ def main() -> int:
     fault_defaults = {"none": 0.0, "stall": 45.0, "slow_sink": 45.0,
                       "recompile": 8.0, "replica_kill": 40.0,
                       "rollout": 45.0, "ingress_crash": 45.0,
-                      "noisy_neighbor": 45.0}
+                      "noisy_neighbor": 45.0, "chaos_mesh": 45.0}
     scale_defaults = {"none": 6.0, "stall": 6.0, "slow_sink": 12.0,
                       "recompile": 6.0, "replica_kill": 12.0,
                       "rollout": 12.0, "ingress_crash": 12.0,
-                      "noisy_neighbor": 12.0}
+                      "noisy_neighbor": 12.0, "chaos_mesh": 12.0}
     fault_s = (args.fault_seconds if args.fault_seconds is not None
                else fault_defaults[args.scenario])
     time_scale = (args.time_scale if args.time_scale is not None
@@ -487,7 +536,7 @@ def main() -> int:
         elif args.scenario == "rollout":
             services = boot_pipeline(Path(tmp), factory, args.burst,
                                      rollout_dir=Path(tmp) / "rollout")
-        elif args.scenario == "ingress_crash":
+        elif args.scenario in ("ingress_crash", "chaos_mesh"):
             services = boot_pipeline(Path(tmp), factory, args.burst,
                                      wal_dir=Path(tmp) / "wal")
         elif args.scenario == "noisy_neighbor":
@@ -661,6 +710,40 @@ def main() -> int:
                     aggressor.start()
                     aggressor.wait(timeout=fault_s + 60.0)
                     record["aggressor"] = aggressor.stop()["scorecard"]
+                elif args.scenario == "chaos_mesh":
+                    # arm the seeded plan through the parser's REAL admin
+                    # plane (arming zeroes the per-site op counters, so the
+                    # plan's op windows are chaos-phase-relative), then
+                    # plant the poison: marker frames sent straight into
+                    # the ingress OUTSIDE the generator's trace accounting
+                    # — the loss gate stays exact (generator loss must be
+                    # zero, poison must land in the DLQ; neither may
+                    # vanish into the other's ledger)
+                    parser_service = services[0]
+                    admin_port = parser_service.web_server.port
+                    armed = admin_call(
+                        admin_port, "/admin/faults",
+                        {"action": "arm", "plan": CHAOS_MESH_PLAN})
+                    record["fault_plan"] = armed["plan"]
+                    poison_lines = [
+                        f"type=CHAOS msg=audit(999): {CHAOS_MESH_POISON}"
+                        f"-{i} injected poison payload" for i in range(5)]
+                    poison_sock = factory.create_output(
+                        "inproc://soak-parser")
+                    # spread the sends across the first ~60% of the window:
+                    # DeadLetterGrowing is about ACTIVE growth (its
+                    # increase() conjunct), so the quarantine counter must
+                    # step while depth stands — five frames in one burst
+                    # would be a counter born at 5 that never increases
+                    poison_t0 = time.monotonic()
+                    gap_s = fault_s * 0.6 / len(poison_lines)
+                    for line in poison_lines:
+                        poison_sock.send(pack_batch([line.encode("utf-8")]))
+                        time.sleep(gap_s)
+                    poison_sock.close()
+                    record["poison_frames_sent"] = len(poison_lines)
+                    time.sleep(max(0.0, fault_s
+                                   - (time.monotonic() - poison_t0)))
                 elif args.scenario == "ingress_crash":
                     # wedge first so ingress frames bank UNACKED in the
                     # parser's spool (appended at recv, ack blocked behind
@@ -828,6 +911,123 @@ def main() -> int:
                           f"depth={record['wal']['depth_frames']} acked="
                           f"{record['wal']['acked_seq']} of "
                           f"{record['wal']['last_appended_seq']}")
+                if args.scenario == "chaos_mesh":
+                    # the dmfault contract, gated by execution: nothing
+                    # non-poison was lost, every poison frame reached the
+                    # DLQ, the engine loop outlived the fsync EIO burst
+                    # with durability re-armed, the whole fault family's
+                    # evidence trail (events + alerts) actually appeared,
+                    # the fired log equals the seed's precomputed schedule
+                    # (determinism proved by execution, not by assertion),
+                    # and requeue drains the quarantine back to zero
+                    parser_service = services[0]
+                    admin_port = parser_service.web_server.port
+                    n_poison = record["poison_frames_sent"]
+                    spool = parser_service.engine.spool
+                    record["wal"] = spool.stats()
+                    check("non_poison_loss_zero",
+                          chaos["scorecard"]["loss"] == 0,
+                          f"loss={chaos['scorecard']['loss']} of "
+                          f"{chaos['scorecard']['sent_frames']} generator "
+                          "frames (unique trace ids) across latency + "
+                          "fsync EIO + poison")
+                    check("engine_alive_through_fsync_eio",
+                          parser_service.engine.running,
+                          "the parser's engine loop survived "
+                          f"{record['wal']['disk_errors']} absorbed disk "
+                          "errors (the pre-dmfault build died at the "
+                          "first fsync EIO)")
+                    check("wal_degraded_and_rearmed",
+                          record["wal"]["disk_errors"] > 0
+                          and not record["wal"]["degraded"],
+                          f"disk_errors={record['wal']['disk_errors']} "
+                          "absorbed, durability re-armed after the burst "
+                          f"(degraded={record['wal']['degraded']})")
+                    dlq_doc = admin_call(admin_port, "/admin/dlq")
+                    record["dlq"] = dlq_doc
+                    reasons = {e["reason"] for e in dlq_doc["entries"]}
+                    check("poison_quarantined",
+                          dlq_doc["depth_frames"] == n_poison
+                          and dlq_doc["quarantined_total"] >= n_poison
+                          and reasons <= {"processing_error",
+                                          "recovery_replay"},
+                          f"depth={dlq_doc['depth_frames']} of {n_poison} "
+                          f"poison frames, quarantined_total="
+                          f"{dlq_doc['quarantined_total']}, "
+                          f"reasons={sorted(reasons)}")
+                    kinds = [e.get("kind") for e in
+                             parser_service.events.snapshot()["events"]]
+                    check("faults_armed_event_emitted",
+                          "faults_armed" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("fault_injected_event_emitted",
+                          "fault_injected" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("wal_degraded_event_emitted",
+                          "wal_degraded" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("frame_quarantined_event_emitted",
+                          "frame_quarantined" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    # disarm through the admin plane and collect the final
+                    # fired log in the same call — then prove determinism:
+                    # two fresh plans from the committed doc must compute
+                    # identical schedules, and every rate/window fault
+                    # that FIRED must be exactly the faults the schedule
+                    # PLANNED for the ops each site performed (match-spec
+                    # poison hits are payload-driven and excluded by
+                    # construction)
+                    from detectmateservice_tpu.faults import FaultPlan
+
+                    final = admin_call(admin_port, "/admin/faults",
+                                       {"action": "disarm"})
+                    fired = final.get("fired_schedule", [])
+                    ops = final.get("final", {}).get("ops", {})
+                    record["fired_schedule"] = fired
+                    record["fault_ops"] = ops
+                    plan_a = FaultPlan.from_dict(CHAOS_MESH_PLAN)
+                    plan_b = FaultPlan.from_dict(
+                        json.loads(json.dumps(CHAOS_MESH_PLAN)))
+                    sched_sites = ("wal_fsync", "sock_send")
+                    check("fault_schedule_deterministic",
+                          all(plan_a.schedule(s, ops.get(s, 0))
+                              == plan_b.schedule(s, ops.get(s, 0))
+                              for s in sched_sites),
+                          f"seed={CHAOS_MESH_PLAN['seed']}: two fresh "
+                          "plans computed identical schedules over "
+                          f"ops={ {s: ops.get(s, 0) for s in sched_sites} }")
+                    mismatches = {
+                        site: (len([f for f in fired
+                                    if f["site"] == site]),
+                               len(plan_a.schedule(site, ops.get(site, 0))))
+                        for site in sched_sites
+                        if [(f["op"], f["kind"]) for f in fired
+                            if f["site"] == site]
+                        != plan_a.schedule(site, ops.get(site, 0))}
+                    check("fired_equals_planned_schedule", not mismatches,
+                          "every fired rate/window fault matches the "
+                          "seed's precomputed schedule op-for-op"
+                          if not mismatches else
+                          f"fired != planned (site: fired, planned) "
+                          f"{mismatches}")
+                    # recovery: requeue the quarantine with the plan
+                    # disarmed — the frames must reprocess cleanly and
+                    # the DLQ must drain to zero
+                    requeued = admin_call(admin_port, "/admin/dlq",
+                                          {"action": "requeue"})
+                    deadline = time.monotonic() + 30
+                    while (time.monotonic() < deadline
+                           and parser_service.engine.dlq.depth_frames()):
+                        time.sleep(0.5)
+                    dlq_after = admin_call(admin_port, "/admin/dlq")
+                    record["dlq_after_requeue"] = dlq_after
+                    check("dlq_drained_after_requeue",
+                          requeued["requeued"] == n_poison
+                          and dlq_after["depth_frames"] == 0
+                          and dlq_after["requeued_total"] == n_poison,
+                          f"requeued {requeued['requeued']} frames, "
+                          f"depth={dlq_after['depth_frames']} after "
+                          "reprocessing with the plan disarmed")
                 if args.scenario == "rollout":
                     # the rollout contract, gated by execution: the swap
                     # was served, nothing was lost across it, the compile

@@ -1,0 +1,573 @@
+"""dmfault tests: seeded fault-plan determinism, the injector's site
+contracts, the spool's disk-fault degradation policy, poison-frame
+quarantine (the DLQ), and the two regression pins the subsystem exists
+for — the engine loop surviving fsync EIO, and a processor exception
+under durable ingress never being silently acked (the DLQ, not silence,
+is the destination).
+"""
+import errno
+import json
+import time
+
+import pytest
+
+from detectmateservice_tpu import faults
+from detectmateservice_tpu.faults import (
+    SITES,
+    FaultInjected,
+    FaultInjector,
+    FaultPlan,
+    FaultPlanError,
+)
+from detectmateservice_tpu.wal import DeadLetterSpool, IngressSpool, WalError
+
+from conftest import wait_until
+
+
+@pytest.fixture(autouse=True)
+def _never_leak_an_armed_plan():
+    """_ACTIVE is process-global: a test that arms and fails mid-assert
+    must not leave the rest of the suite chaotic."""
+    yield
+    faults.disarm()
+
+
+def make_plan(*specs, seed=411):
+    return FaultPlan.from_dict({"seed": seed, "specs": list(specs)})
+
+
+# -- the plan: validation + the determinism contract -------------------------
+
+
+class TestFaultPlan:
+    def test_same_seed_identical_schedule(self):
+        doc = {"seed": 1234, "specs": [
+            {"site": "wal_fsync", "kind": "eio", "rate": 0.3},
+            {"site": "sock_send", "kind": "latency", "rate": 0.1,
+             "delay_ms": 5.0},
+            {"site": "proc", "kind": "raise", "rate": 0.05,
+             "start_op": 10, "stop_op": 400},
+        ]}
+        a = FaultPlan.from_dict(doc)
+        b = FaultPlan.from_dict(json.loads(json.dumps(doc)))
+        for site in SITES:
+            assert a.schedule(site, 500) == b.schedule(site, 500)
+        # and the schedule is non-trivial (the rule did not rot to empty)
+        assert a.schedule("wal_fsync", 500)
+
+    def test_different_seed_different_schedule(self):
+        spec = {"site": "wal_fsync", "kind": "eio", "rate": 0.5}
+        a = make_plan(spec, seed=1)
+        b = make_plan(spec, seed=2)
+        assert a.schedule("wal_fsync", 500) != b.schedule("wal_fsync", 500)
+
+    def test_draw_is_pure_and_in_range(self):
+        plan = make_plan(seed=7)
+        vals = [plan.draw("proc", "raise", op) for op in range(200)]
+        assert vals == [plan.draw("proc", "raise", op) for op in range(200)]
+        assert all(0.0 <= v < 1.0 for v in vals)
+        assert len(set(vals)) > 150      # crc32 spreads, not collapses
+
+    def test_window_and_rate_semantics(self):
+        plan = make_plan({"site": "proc", "kind": "raise",
+                          "start_op": 5, "stop_op": 8})
+        fired = [op for op in range(12) if plan.due(plan.specs[0], op)]
+        assert fired == [5, 6, 7]        # half-open [start_op, stop_op)
+
+    def test_match_specs_are_payload_driven_not_op_driven(self):
+        plan = make_plan({"site": "proc", "kind": "raise", "match": "X"})
+        assert all(not plan.due(plan.specs[0], op) for op in range(20))
+        assert plan.schedule("proc", 20) == []
+
+    def test_doc_roundtrip(self):
+        plan = make_plan(
+            {"site": "sock_recv", "kind": "drop", "rate": 0.2,
+             "start_op": 3, "stop_op": 9},
+            {"site": "proc", "kind": "hang", "delay_ms": 10.0,
+             "match": "PILL"})
+        assert FaultPlan.from_dict(plan.doc()) == plan
+
+    @pytest.mark.parametrize("doc,msg", [
+        ({"specs": [{"site": "nope", "kind": "eio"}]}, "unknown fault site"),
+        ({"specs": [{"site": "wal_fsync", "kind": "latency"}]}, "no kind"),
+        ({"specs": [{"site": "proc", "kind": "raise", "rate": 1.5}]},
+         "outside"),
+        ({"specs": [{"site": "proc", "kind": "raise", "start_op": 5,
+                     "stop_op": 5}]}, "stop_op"),
+        ({"specs": [{"site": "wal_append", "kind": "eio",
+                     "match": "X"}]}, "processor-site only"),
+        ({"specs": [{"site": "proc", "kind": "raise",
+                     "surprise": 1}]}, "unknown fields"),
+        ({"seed": "not-a-number"}, "bad seed"),
+        ({"specs": "not-a-list"}, "must be a list"),
+        ([1, 2], "JSON object"),
+    ])
+    def test_malformed_plans_fail_loudly(self, doc, msg):
+        with pytest.raises(FaultPlanError, match=msg):
+            FaultPlan.from_dict(doc)
+
+
+# -- the injector: site contracts + fired-log determinism --------------------
+
+
+class TestInjectorSites:
+    def test_fs_raises_the_real_errno(self):
+        inj = FaultInjector(make_plan(
+            {"site": "wal_fsync", "kind": "eio", "stop_op": 1},
+            {"site": "wal_append", "kind": "enospc", "stop_op": 1}))
+        with pytest.raises(OSError) as e:
+            inj.fs("wal_fsync")
+        assert e.value.errno == errno.EIO
+        with pytest.raises(OSError) as e:
+            inj.fs("wal_append")
+        assert e.value.errno == errno.ENOSPC
+        # past the window: the site is a no-op again
+        assert inj.fs("wal_fsync") is False
+
+    def test_fs_torn_commit_returns_true(self):
+        inj = FaultInjector(make_plan(
+            {"site": "fs_commit", "kind": "torn", "stop_op": 1}))
+        assert inj.fs("fs_commit") is True
+        assert inj.fs("fs_commit") is False
+
+    def test_sock_latency_drop_error(self):
+        slept = []
+        inj = FaultInjector(make_plan(
+            {"site": "sock_send", "kind": "latency", "stop_op": 1,
+             "delay_ms": 25.0},
+            {"site": "sock_recv", "kind": "drop", "stop_op": 1},
+            {"site": "sock_dial", "kind": "error", "stop_op": 1}),
+            sleep=slept.append)
+        assert inj.sock("sock_send") is None
+        assert slept == [0.025]
+        assert inj.sock("sock_recv") == "drop"
+        with pytest.raises(OSError) as e:
+            inj.sock("sock_dial")
+        assert e.value.errno == errno.ECONNRESET
+
+    def test_proc_raise_and_poison_match(self):
+        inj = FaultInjector(make_plan(
+            {"site": "proc", "kind": "raise", "match": "PILL"}))
+        inj.proc([b"healthy", b"frames"])        # no marker: no fault
+        with pytest.raises(FaultInjected, match="poison"):
+            inj.proc([b"healthy", b"has-PILL-inside"])
+        # deterministic: the SAME payload poisons on every dispatch —
+        # including the single-frame isolation retry, which is what
+        # drives the frame into the DLQ instead of an endless retry
+        with pytest.raises(FaultInjected):
+            inj.proc([b"has-PILL-inside"])
+
+    def test_proc_slow_sleeps(self):
+        slept = []
+        inj = FaultInjector(make_plan(
+            {"site": "proc", "kind": "slow", "stop_op": 1,
+             "delay_ms": 40.0}), sleep=slept.append)
+        inj.proc([b"x"])
+        assert slept == [0.04]
+
+    def test_arm_disarm_swap(self):
+        assert faults.active() is None
+        inj = faults.arm(make_plan())
+        assert faults.active() is inj
+        assert faults.disarm() is inj
+        assert faults.active() is None
+        assert faults.disarm() is None           # idempotent
+
+    def test_snapshot_and_events(self):
+        events = []
+        inj = FaultInjector(
+            make_plan({"site": "wal_fsync", "kind": "eio", "stop_op": 2}),
+            events=events.append)
+        for _ in range(3):
+            try:
+                inj.fs("wal_fsync")
+            except OSError:
+                pass
+        snap = inj.snapshot()
+        assert snap["armed"] is True
+        assert snap["ops"]["wal_fsync"] == 3
+        assert snap["injected_total"] == 2
+        assert snap["fired_tail"] == [
+            {"site": "wal_fsync", "kind": "eio", "op": 0},
+            {"site": "wal_fsync", "kind": "eio", "op": 1}]
+        # rate-limited (1/s per site): the burst produced ONE event
+        assert [e["kind"] for e in events] == ["fault_injected"]
+
+
+class TestFaultSequenceDeterminism:
+    """Satellite pin: the same seed produces the identical fault sequence
+    when the same operations are performed — the replayability property
+    every chaos bisection depends on."""
+
+    DOC = {"seed": 20260805, "specs": [
+        {"site": "wal_fsync", "kind": "eio", "rate": 0.25},
+        {"site": "sock_send", "kind": "drop", "rate": 0.15},
+        {"site": "proc", "kind": "raise", "rate": 0.1},
+    ]}
+
+    @staticmethod
+    def _drive(inj, ops=300):
+        for _ in range(ops):
+            try:
+                inj.fs("wal_fsync")
+            except OSError:
+                pass
+            if inj.sock("sock_send") == "drop":
+                pass
+            try:
+                inj.proc([b"payload"])
+            except FaultInjected:
+                pass
+
+    def test_two_runs_identical_fired_log(self):
+        a = FaultInjector(FaultPlan.from_dict(self.DOC))
+        b = FaultInjector(FaultPlan.from_dict(self.DOC))
+        self._drive(a)
+        self._drive(b)
+        assert a.fired_schedule() == b.fired_schedule()
+        assert a.fired_schedule()                # and it is non-trivial
+
+    def test_fired_log_equals_precomputed_schedule(self):
+        plan = FaultPlan.from_dict(self.DOC)
+        inj = FaultInjector(plan)
+        self._drive(inj, ops=300)
+        for site in ("wal_fsync", "sock_send", "proc"):
+            fired = [(f["op"], f["kind"]) for f in inj.fired_schedule()
+                     if f["site"] == site]
+            assert fired == plan.schedule(site, 300)
+
+
+# -- the spool's disk-fault policy -------------------------------------------
+
+
+class TestSpoolDiskFaults:
+    def _spool(self, tmp_path, policy="degrade", events=None, observer=None):
+        return IngressSpool(str(tmp_path / "wal"), fsync_interval_ms=0,
+                            on_disk_error=policy, events=events,
+                            disk_error_observer=observer)
+
+    def test_fsync_eio_absorbed_then_rearmed(self, tmp_path):
+        events, errors = [], []
+        spool = self._spool(tmp_path, events=events.append,
+                            observer=lambda: errors.append(1))
+        # the first fsync fails; the append itself succeeded (the record
+        # reached the kernel) so the frame is served non-durably
+        faults.arm(make_plan(
+            {"site": "wal_fsync", "kind": "eio", "stop_op": 1}))
+        assert spool.append(b"one") == 1         # absorbed, NOT fatal
+        assert spool.stats()["degraded"] is True
+        # the next successful disk op re-arms durability
+        assert spool.append(b"two") == 2
+        assert spool.stats()["degraded"] is False
+        assert spool.disk_errors == 1
+        assert len(errors) == 1                  # wal_fsync_errors_total
+        # one event per TRANSITION, not per absorbed error
+        assert [(e["kind"], e["state"]) for e in events] == [
+            ("wal_degraded", "degraded"), ("wal_degraded", "restored")]
+        spool.close()
+
+    def test_append_eio_absorbed_under_degrade(self, tmp_path):
+        spool = self._spool(tmp_path)
+        faults.arm(make_plan(
+            {"site": "wal_append", "kind": "eio", "stop_op": 1}))
+        assert spool.append(b"lost-to-disk") is None     # absorbed, NOT durable
+        assert spool.stats()["degraded"] is True
+        assert spool.append(b"recovered") is not None
+        assert spool.stats()["degraded"] is False
+        spool.close()
+        # the absorbed frame is not in the spool; the later one is
+        from detectmateservice_tpu.wal import read_spool
+        assert [r.frame for r in read_spool(tmp_path / "wal")] \
+            == [b"recovered"]
+
+    def test_halt_policy_raises_walerror(self, tmp_path):
+        spool = self._spool(tmp_path, policy="halt")
+        faults.arm(make_plan(
+            {"site": "wal_append", "kind": "enospc", "stop_op": 1}))
+        with pytest.raises(WalError, match="halt"):
+            spool.append(b"frame")
+        faults.disarm()
+        spool.close()
+
+    def test_bad_policy_rejected(self, tmp_path):
+        with pytest.raises(WalError, match="not in degrade"):
+            self._spool(tmp_path, policy="explode")
+
+
+# -- the dead-letter queue ---------------------------------------------------
+
+
+class TestDeadLetterSpool:
+    def test_quarantine_requeue_purge(self, tmp_path):
+        dlq = DeadLetterSpool(str(tmp_path / "dlq"))
+        a = dlq.quarantine(b"poison-a", reason="processing_error",
+                           error="boom", attempts=3, seq=7)
+        dlq.quarantine(b"poison-b", reason="recovery_replay", attempts=3)
+        snap = dlq.snapshot()
+        assert snap["depth_frames"] == 2
+        assert snap["quarantined_total"] == 2
+        assert [e["reason"] for e in snap["entries"]] \
+            == ["processing_error", "recovery_replay"]
+        assert all("frame" not in e for e in snap["entries"])
+        taken = dlq.requeue(a)
+        assert taken == [(a, b"poison-a")]
+        assert dlq.purge() == 1                  # purge-all takes the rest
+        assert dlq.depth_frames() == 0
+        assert dlq.snapshot()["requeued_total"] == 1
+        assert dlq.snapshot()["purged_total"] == 1
+        dlq.close()
+
+    def test_entries_survive_reopen(self, tmp_path):
+        dlq = DeadLetterSpool(str(tmp_path / "dlq"))
+        dlq.quarantine(b"sticky", reason="processing_error", attempts=3)
+        dlq.close()
+        back = DeadLetterSpool(str(tmp_path / "dlq"))
+        assert back.requeue() == [(1, b"sticky")]
+        back.close()
+
+    def test_torn_last_record_skipped_on_load(self, tmp_path):
+        dlq = DeadLetterSpool(str(tmp_path / "dlq"))
+        dlq.quarantine(b"intact", reason="processing_error")
+        dlq.close()
+        with open(tmp_path / "dlq" / "dlq.jsonl", "ab", buffering=0) as fh:
+            fh.write(b'{"id": 2, "torn-by-a-cra')
+        back = DeadLetterSpool(str(tmp_path / "dlq"))
+        assert [f for _i, f in back.requeue()] == [b"intact"]
+        back.close()
+
+    def test_bounded_drop_oldest(self, tmp_path):
+        dlq = DeadLetterSpool(str(tmp_path / "dlq"), max_frames=2)
+        for name in (b"first", b"second", b"third"):
+            dlq.quarantine(name, reason="processing_error")
+        snap = dlq.snapshot()
+        assert snap["depth_frames"] == 2
+        assert snap["evicted_total"] == 1
+        assert [f for _i, f in dlq.requeue()] == [b"second", b"third"]
+        dlq.close()
+
+    def test_memory_only_without_directory(self):
+        dlq = DeadLetterSpool(None)
+        dlq.quarantine(b"x", reason="processing_error")
+        assert dlq.snapshot()["directory"] is None
+        assert dlq.depth_frames() == 1
+        dlq.close()
+
+
+# -- engine integration: the two regression pins -----------------------------
+
+
+def _durable_settings(tmp_path, tag, **kw):
+    from detectmateservice_tpu.settings import ServiceSettings
+
+    return ServiceSettings(
+        component_type="core", component_id=f"faults-{tag}",
+        engine_addr=f"inproc://faults-{tag}-in",
+        out_addr=[f"inproc://faults-{tag}-out"],
+        durable_ingress=True, wal_dir=str(tmp_path / "wal"),
+        wal_fsync_interval_ms=0, engine_recv_timeout=20,
+        log_to_file=False, log_to_console=False, **kw)
+
+
+class _EchoProcessor:
+    def process(self, data):
+        return data
+
+
+class _PoisonIntolerant:
+    """A processor with a deterministic poison bug: any payload carrying
+    the marker raises, everything else echoes."""
+
+    def process(self, data):
+        if b"PILL" in data:
+            raise ValueError("cannot digest this payload")
+        return data
+
+
+def _boot(tmp_path, tag, processor, **kw):
+    from detectmateservice_tpu.engine import Engine
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+
+    factory = InprocQueueSocketFactory(maxsize=4096)
+    engine = Engine(_durable_settings(tmp_path, tag, **kw), processor,
+                    socket_factory=factory)
+    sink = factory.create(f"inproc://faults-{tag}-out")
+    sink.recv_timeout = 50
+    sender = factory.create_output(f"inproc://faults-{tag}-in")
+    return engine, sender, sink
+
+
+def _drain(sink):
+    out = []
+    try:
+        while True:
+            out.append(sink.recv())
+    except Exception:
+        return out
+
+
+class TestEngineSurvivesFsyncEIO:
+    def test_loop_alive_through_injected_fsync_errors(self, tmp_path):
+        """Regression pin for the dmfault tentpole's motivating failure:
+        a disk error on the fsync path used to propagate out of tick()
+        and kill the EngineLoop thread. Under wal_on_disk_error=degrade
+        the loop must survive the whole burst, keep serving, and re-arm
+        durability when the disk recovers."""
+        engine, sender, sink = _boot(tmp_path, "eio", _EchoProcessor())
+        # every fsync fails for ops 0..5 — with fsync_interval 0 that is
+        # the first six appends' durability barriers
+        faults.arm(make_plan(
+            {"site": "wal_fsync", "kind": "eio", "stop_op": 6}))
+        engine.start()
+        for i in range(12):
+            sender.send(b"frame-%02d" % i)
+        wait_until(lambda: engine._spool.last_appended_seq >= 12, timeout=5)
+        wait_until(lambda: engine._spool.depth_frames() == 0, timeout=5)
+        assert engine.running, "the engine loop died on an fsync EIO"
+        delivered = set(_drain(sink))
+        assert {b"frame-%02d" % i for i in range(12)} <= delivered
+        assert engine._spool.disk_errors >= 1
+        assert engine._spool.stats()["degraded"] is False   # re-armed
+        engine.stop()
+
+
+class TestNoSilentAckUnderDurableIngress:
+    def test_processor_exception_quarantines_not_acks(self, tmp_path):
+        """Regression pin for the silent-ack bug: a processor exception
+        under durable_ingress must never ack-and-forget the frame. The
+        frame's terminal state is the DLQ (with reason + attempts); the
+        healthy neighbors are delivered; the spool still converges to
+        fully-acked (quarantine accounts for the frame — it does not
+        wedge the watermark into an endless crash-replay loop)."""
+        engine, sender, sink = _boot(tmp_path, "ack", _PoisonIntolerant())
+        engine.start()
+        good = [b"good-%02d" % i for i in range(6)]
+        for i, frame in enumerate(good):
+            if i == 3:
+                sender.send(b"has-PILL-inside")
+            sender.send(frame)
+        wait_until(lambda: engine._spool.last_appended_seq >= 7, timeout=5)
+        wait_until(lambda: engine.dlq.depth_frames() == 1, timeout=5)
+        # every healthy neighbor was delivered — isolation, not collateral
+        wait_until(lambda: engine._spool.depth_frames() == 0, timeout=5)
+        assert set(good) <= set(_drain(sink))
+        (entry,) = engine.dlq.snapshot()["entries"]
+        assert entry["reason"] == "processing_error"
+        assert entry["attempts"] == engine._dlq_max_attempts
+        assert "cannot digest" in entry["error"]
+        assert engine.running
+        engine.stop()
+
+    def test_injected_poison_match_reaches_dlq(self, tmp_path):
+        """Same pin, driven by the injector instead of a processor bug:
+        a match-spec poison frame exhausts its attempts and quarantines."""
+        engine, sender, sink = _boot(tmp_path, "match", _EchoProcessor())
+        faults.arm(make_plan(
+            {"site": "proc", "kind": "raise", "match": "POISON-PILL"}))
+        engine.start()
+        sender.send(b"ordinary")
+        sender.send(b"carries-POISON-PILL-marker")
+        wait_until(lambda: engine.dlq.depth_frames() == 1, timeout=5)
+        wait_until(lambda: engine._spool.depth_frames() == 0, timeout=5)
+        assert b"ordinary" in set(_drain(sink))
+        (entry,) = engine.dlq.snapshot()["entries"]
+        assert "poison" in entry["error"]
+        engine.stop()
+
+    def test_requeue_reprocesses_after_fix(self, tmp_path):
+        """The operator loop: disarm (deploy the fix), requeue, and the
+        frame reprocesses cleanly — at-most-once, DLQ drained."""
+        engine, sender, sink = _boot(tmp_path, "requeue", _EchoProcessor())
+        faults.arm(make_plan(
+            {"site": "proc", "kind": "raise", "match": "PILL"}))
+        engine.start()
+        sender.send(b"stuck-PILL-frame")
+        wait_until(lambda: engine.dlq.depth_frames() == 1, timeout=5)
+        faults.disarm()                          # "the fix shipped"
+        taken = engine.dlq.requeue()
+        assert engine.requeue_frames([f for _i, f in taken]) == 1
+        wait_until(lambda: b"stuck-PILL-frame" in set(_drain(sink)),
+                   timeout=5)
+        assert engine.dlq.depth_frames() == 0
+        engine.stop()
+
+
+class TestRecoveryReplayOfPoisonConverges:
+    def test_poison_in_unacked_suffix_quarantines_instead_of_looping(
+            self, tmp_path):
+        """THE DLQ-existence proof: before dmfault, a poison frame in the
+        WAL's unacked suffix was a crash-replay LOOP — every restart
+        replayed it, every replay failed it. Now recovery replays the
+        suffix, the poison frame exhausts its attempts, quarantines with
+        reason=recovery_replay, and the spool converges to fully-acked."""
+        from detectmateservice_tpu.engine import Engine
+        from detectmateservice_tpu.engine.socket import (
+            InprocQueueSocketFactory,
+        )
+
+        factory = InprocQueueSocketFactory(maxsize=256)
+        # bank both frames under a tolerant build: they append, process,
+        # and ack in memory — but the manifest commits the ack watermark
+        # only every ≥1 s, so an immediate crash loses the acks and the
+        # restart must replay BOTH frames (the at-least-once window)
+        engine = Engine(_durable_settings(tmp_path, "loop"),
+                        _EchoProcessor(), socket_factory=factory)
+        sender = factory.create_output("inproc://faults-loop-in")
+        engine.start()
+        sender.send(b"banked-good")
+        sender.send(b"banked-PILL-poison")
+        assert wait_until(
+            lambda: engine._spool.last_appended_seq >= 2, timeout=5)
+        engine.crash_abort()             # acks never reached the manifest
+
+        # the "restarted, fixed-forward process" still can't digest the
+        # poison — recovery must converge anyway
+        engine2 = Engine(_durable_settings(tmp_path, "loop2"),
+                         _PoisonIntolerant(), socket_factory=factory)
+        sink2 = factory.create("inproc://faults-loop2-out")
+        sink2.recv_timeout = 50
+        engine2.start()
+        assert wait_until(
+            lambda: engine2._spool.depth_frames() == 0, timeout=10)
+        assert b"banked-good" in set(_drain(sink2))
+        (entry,) = engine2.dlq.snapshot()["entries"]
+        assert entry["reason"] == "recovery_replay"
+        assert engine2.running
+        # convergence, not a loop: a THIRD start replays nothing
+        engine2.stop()
+        engine3 = Engine(_durable_settings(tmp_path, "loop3"),
+                         _PoisonIntolerant(), socket_factory=factory)
+        engine3.start()
+        time.sleep(0.3)
+        assert engine3._spool.acked_seq == engine3._spool.last_appended_seq
+        assert engine3.dlq.depth_frames() == 1   # still exactly the one
+        engine3.stop()
+
+
+# -- the atomic-commit fault seam --------------------------------------------
+
+
+class TestAtomicCommitFaults:
+    def test_torn_commit_preserves_previous_manifest(self, tmp_path):
+        """fs_commit torn: write_json_atomic aborts between temp write and
+        rename — a reader sees the PREVIOUS document, never a torn one."""
+        from detectmateservice_tpu.utils.atomicio import write_json_atomic
+
+        path = tmp_path / "doc.json"
+        write_json_atomic(path, {"v": 1})
+        faults.arm(make_plan(
+            {"site": "fs_commit", "kind": "torn", "stop_op": 1}))
+        # the temp sibling is written, then the commit aborts before the
+        # rename — the crash window the pattern exists to survive
+        with pytest.raises(OSError, match="torn"):
+            write_json_atomic(path, {"v": 2})
+        assert json.loads(path.read_text()) == {"v": 1}
+        write_json_atomic(path, {"v": 3})        # past the window: real
+        assert json.loads(path.read_text()) == {"v": 3}
+
+    def test_commit_eio_raises(self, tmp_path):
+        from detectmateservice_tpu.utils.atomicio import write_json_atomic
+
+        faults.arm(make_plan(
+            {"site": "fs_commit", "kind": "eio", "stop_op": 1}))
+        with pytest.raises(OSError):
+            write_json_atomic(tmp_path / "x.json", {"v": 1})

@@ -29,6 +29,7 @@ from detectmateservice_tpu.analysis import (
     hotloop,
     locks,
     markers,
+    robustness,
 )
 from detectmateservice_tpu.analysis.cli import (
     default_repo_root,
@@ -416,6 +417,143 @@ def open_segment(path):
         src = "import json\n\n\ndef f(fh):\n    json.dump({}, fh)\n"
         assert durability.check_module(
             "detectmateservice_tpu/engine/engine.py", src) == []
+
+
+# ---------------------------------------------------------------------------
+# known-bad corpus: robustness discipline (DM-R)
+# ---------------------------------------------------------------------------
+class TestRobustnessKnownBad:
+    def test_swallowed_exception_fires_once(self):
+        """The dmfault motivating bug, distilled: the pre-dmfault engine
+        loop swallowing a processor error and acking the frame anyway."""
+        src = """
+def dispatch(processor, frames, acks):
+    try:
+        processor.process(frames)
+    except Exception:
+        pass
+    acks.advance(len(frames))
+"""
+        found = robustness.check_module(
+            "detectmateservice_tpu/engine/x.py", src)
+        assert [f.rule for f in found] == ["DM-R001"]
+        assert "swallows" in found[0].message
+
+    def test_tuple_catch_including_broad_fires_once(self):
+        src = """
+def tick(obj):
+    try:
+        obj.poll()
+    except (ValueError, Exception):
+        return None
+"""
+        found = robustness.check_module("detectmateservice_tpu/y.py", src)
+        assert [f.rule for f in found] == ["DM-R001"]
+
+    def test_fingerprint_is_line_stable(self):
+        """Moving the handler down a line must not change the fingerprint
+        (fingerprints key baseline suppressions across refactors)."""
+        src = "def f(x):\n    try:\n        x()\n    except Exception:\n        pass\n"
+        shifted = "\n\n" + src
+        (a,) = robustness.check_module("detectmateservice_tpu/z.py", src)
+        (b,) = robustness.check_module("detectmateservice_tpu/z.py", shifted)
+        assert a.fingerprint == b.fingerprint
+
+    def test_two_swallows_in_one_scope_get_distinct_keys(self):
+        src = """
+def f(x):
+    try:
+        x()
+    except Exception:
+        pass
+    try:
+        x()
+    except Exception:
+        pass
+"""
+        found = robustness.check_module("detectmateservice_tpu/w.py", src)
+        assert len(found) == 2
+        assert found[0].key != found[1].key
+
+
+class TestRobustnessClean:
+    def test_logged_counted_raised_or_used_is_clean(self):
+        src = """
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def a(x):
+    try:
+        x()
+    except Exception:
+        log.warning("a failed")
+
+
+def b(x, m):
+    try:
+        x()
+    except Exception:
+        m.ERRORS().inc()
+
+
+def c(x):
+    try:
+        x()
+    except Exception:
+        raise
+
+
+def d(x):
+    try:
+        x()
+    except Exception as exc:
+        return str(exc)
+
+
+def e(x, stats):
+    try:
+        x()
+    except Exception:
+        stats.dropped += 1
+"""
+        assert robustness.check_module(
+            "detectmateservice_tpu/clean.py", src) == []
+
+    def test_narrow_and_bare_excepts_are_out_of_scope(self):
+        # narrow catches are legitimate; bare except is DM-B002's finding
+        src = """
+def f(x):
+    try:
+        x()
+    except ValueError:
+        pass
+    try:
+        x()
+    except:
+        pass
+"""
+        assert robustness.check_module(
+            "detectmateservice_tpu/n.py", src) == []
+
+    def test_tests_and_scripts_are_out_of_scope(self):
+        src = "def f(x):\n    try:\n        x()\n    except Exception:\n        pass\n"
+        assert robustness.check_module("tests/test_x.py", src) == []
+        assert robustness.check_module("scripts/soak.py", src) == []
+
+    def test_pragma_suppresses(self):
+        src = """
+def f(x):
+    try:
+        x()
+    # dmlint: ignore[DM-R001] probe teardown: failure means already closed
+    except Exception:
+        pass
+"""
+        pragmas = scan_pragmas(src)
+        assert robustness.check_module(
+            "detectmateservice_tpu/p.py", src, pragmas=pragmas) == []
 
 
 # ---------------------------------------------------------------------------
