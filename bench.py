@@ -433,6 +433,129 @@ def run_open_loop(det, closed_loop_lps: float) -> dict:
     }
 
 
+def child_warmstart() -> None:
+    """Cold-start vs warm-start time-to-first-score (dmwarm): boot the
+    bench detector twice against ONE persistent compile-cache dir — boot #1
+    with an empty cache (cold: every warm-set kernel backend-compiles and
+    persists), boot #2 with fresh jit objects in the same interpreter
+    (warm: the cache serves deserialized executables instead of compiles).
+    Reports the split plus the ledger's hit/miss counters, so the BENCH
+    record shows what a replica restart actually costs."""
+    import tempfile
+
+    import numpy as np
+
+    from detectmateservice_tpu.engine import device_obs
+    from detectmateservice_tpu.utils.profiling import enable_compilation_cache
+
+    cache_dir = enable_compilation_cache(
+        tempfile.mkdtemp(prefix="dmwarm_bench_"))
+    ledger = device_obs.get_ledger()
+    cfg = dict(BENCH_SCORER_CONFIG, max_batch=4096, dtype="float32",
+               upload_workers=0)
+
+    def boot() -> dict:
+        from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+        det = JaxScorerDetector(
+            config={"detectors": {"JaxScorerDetector": dict(cfg)}})
+        before = ledger.snapshot().get("compile_cache", {})
+        t0 = time.perf_counter()
+        det.setup_io()
+        warmup_s = time.perf_counter() - t0
+        # first score rides the max-batch bucket — always in the warm set,
+        # so this measures dispatch latency, never a hidden compile
+        det.score_tokens(np.zeros((cfg["max_batch"], cfg["seq_len"]),
+                                  dtype=np.int32))
+        first_score_s = time.perf_counter() - t0
+        after = ledger.snapshot().get("compile_cache", {})
+        return {"to_first_score_s": round(first_score_s, 3),
+                "warmup_s": round(warmup_s, 3),
+                "phases": ledger.warmup_phases(),
+                "cache_hits": after.get("hits", 0) - before.get("hits", 0),
+                "cache_misses": (after.get("misses", 0)
+                                 - before.get("misses", 0))}
+
+    cold = boot()
+    ledger.reset()   # second boot re-runs its own warm-up lifecycle
+    warm = boot()
+    import jax
+
+    _child_exit({
+        "platform": jax.devices()[0].platform,
+        "cache_dir": cache_dir,
+        "cold": cold,
+        "warm": warm,
+        "cold_start_to_first_score_s": cold["to_first_score_s"],
+        "warm_start_to_first_score_s": warm["to_first_score_s"],
+        "warm_speedup": round(cold["to_first_score_s"]
+                              / max(warm["to_first_score_s"], 1e-9), 2),
+        "warm_boot_cache_hits": warm["cache_hits"],
+    })
+
+
+def child_int8() -> None:
+    """int8 weight-only vs bf16 device-scoring throughput (dmwarm): the
+    same model, config, and training data — dtype the only difference —
+    measured on the isolated device-scoring path (score_tokens), where the
+    representation matters. Reports the ratio plus the parity-gate report
+    (int8 only serves at ZERO alert-decision flips on the parity corpus).
+    CPU-sim note: XLA:CPU runs bf16 GEMMs at f32 speed, so the measured
+    CPU win is pure int8 weight streaming; TPU adds the MXU's native
+    formats on top."""
+    import numpy as np
+
+    from detectmateservice_tpu.library.detectors import JaxScorerDetector
+
+    n_train = 512
+    n_score = int(os.environ.get("DETECTMATE_BENCH_INT8_N", "32768"))
+    chunk = 2048
+    base = dict(BENCH_SCORER_CONFIG, max_batch=chunk,
+                data_use_training=n_train, train_epochs=1, upload_workers=0)
+    train_msgs = make_messages(n_train, anomaly_rate=0.0)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(1, 16000,
+                          (n_score, base["seq_len"])).astype(np.int32)
+
+    results: dict = {}
+    for dtype in ("bfloat16", "int8w"):
+        det = JaxScorerDetector(config={"detectors": {
+            "JaxScorerDetector": dict(base, dtype=dtype)}})
+        det.setup_io()
+        for start in range(0, n_train, chunk):
+            det.process_batch(train_msgs[start:start + chunk])
+        det.flush()
+        det.flush_final()
+        det.score_tokens(tokens[:chunk])          # untimed warm pass
+        t0 = time.perf_counter()
+        for start in range(0, n_score, chunk):
+            det.score_tokens(tokens[start:start + chunk])
+        elapsed = time.perf_counter() - t0
+        entry = {"lines_per_s": round(n_score / elapsed, 1),
+                 "elapsed_s": round(elapsed, 3), "n": n_score}
+        if dtype == "int8w":
+            entry["parity"] = det._int8_report
+        results[dtype] = entry
+
+    import jax
+
+    speedup = (results["int8w"]["lines_per_s"]
+               / max(results["bfloat16"]["lines_per_s"], 1e-9))
+    parity = results["int8w"].get("parity") or {}
+    _child_exit({
+        "platform": jax.devices()[0].platform,
+        "bf16_lines_per_s": results["bfloat16"]["lines_per_s"],
+        "int8_lines_per_s": results["int8w"]["lines_per_s"],
+        "speedup": round(speedup, 3),
+        "speedup_target": 1.5,
+        "speedup_ok": speedup >= 1.5,
+        "parity_flips": parity.get("flips"),
+        "parity_rows": parity.get("rows"),
+        "int8_activated": parity.get("activated"),
+        "detail": results,
+    })
+
+
 # ----------------------------------------------------------------------
 # parent orchestration (no jax import on this path)
 # ----------------------------------------------------------------------
@@ -595,6 +718,18 @@ def main() -> None:
     host_child: _Child | None = _Child("host", RUN_TIMEOUT_S)
     host_result: dict | None = None
 
+    # ---- dmwarm plane (cold/warm-start split + int8-vs-bf16 A/B) ---------
+    # CPU-pinned and launched only after the CPU insurance plane quiesces:
+    # on the 1-core judge box a concurrent extra jax child would distort the
+    # headline number. Sequenced one at a time for the same reason. The
+    # ISSUE accepts the CPU-sim ratio, so these never touch the tunnel.
+    warm_child: _Child | None = None
+    warm_result: dict | None = None
+    warm_done = False
+    int8_child: _Child | None = None
+    int8_result: dict | None = None
+    int8_done = False
+
     # ---- TPU acquisition plane ------------------------------------------
     tpu_probe: _Child | None = _Child("probe", PROBE_TIMEOUT_S)
     last_probe_start = time.monotonic()
@@ -654,6 +789,28 @@ def main() -> None:
             cpu_run = _Child("run", RUN_TIMEOUT_S, platform="cpu",
                              arg=str(CPU_FULL_N))
 
+        # -- dmwarm plane: one CPU child at a time, once the insurance
+        # plane's children are out of the way
+        cpu_quiesced = cpu_smoke is None and cpu_run is None
+        if (warm_child is None and not warm_done and cpu_quiesced
+                and left() > REPORT_MARGIN_S + 60):
+            warm_child = _Child("warmstart",
+                                min(RUN_TIMEOUT_S, left() - REPORT_MARGIN_S),
+                                platform="cpu")
+        if warm_child is not None and warm_child.poll():
+            warm_result = harvest(warm_child)
+            warm_child = None
+            warm_done = True
+        if (int8_child is None and not int8_done and warm_done
+                and cpu_quiesced and left() > REPORT_MARGIN_S + 60):
+            int8_child = _Child("int8",
+                                min(RUN_TIMEOUT_S, left() - REPORT_MARGIN_S),
+                                platform="cpu")
+        if int8_child is not None and int8_child.poll():
+            int8_result = harvest(int8_child)
+            int8_child = None
+            int8_done = True
+
         # -- TPU plane: keep exactly one child in flight
         if tpu_probe is not None and tpu_probe.poll():
             res = harvest(tpu_probe)
@@ -708,13 +865,17 @@ def main() -> None:
         cpu_active = cpu_probe is not None or cpu_smoke is not None or cpu_run is not None
         tpu_abandoned = (tpu_run_failures >= MAX_TPU_RUN_FAILURES
                          or tpu_probe_timed_out)
+        dmwarm_active = warm_child is not None or int8_child is not None
+        dmwarm_pending = ((not warm_done or not int8_done)
+                          and left() > REPORT_MARGIN_S + 60)
         if (not tpu_active and not cpu_active and host_child is None
+                and not dmwarm_active and not dmwarm_pending
                 and (tpu_result is not None or tpu_abandoned)):
             break
         time.sleep(0.5)
 
     for child in (cpu_probe, cpu_smoke, cpu_run, tpu_probe, tpu_run,
-                  host_child):
+                  host_child, warm_child, int8_child):
         if child is not None:
             child.cancel()
             diags.append(child.diag)
@@ -740,6 +901,20 @@ def main() -> None:
             # (PR 7 acceptance): parse vs featurize vs transit seconds, and
             # cpu_floor_lines_per_s_per_core before/after, machine-checkable
             out["host_path"] = host_result
+        # top-level parsed summary (dmwarm): driver-archived BENCH_r0*.json
+        # records carry platform/lines_per_s/speedup without re-parsing the
+        # nested stage payloads
+        out["lines_per_s"] = best["lines_per_s"]
+        out["speedup"] = out["vs_baseline"]
+        if warm_result is not None:
+            # cold-start-to-first-score vs warm-start-to-first-score on a
+            # shared persistent compile cache (dmwarm acceptance)
+            out["warm_start"] = warm_result
+        if int8_result is not None:
+            # int8w-vs-bf16 device-scoring A/B at zero alert flips
+            out["int8"] = int8_result
+            if int8_result.get("speedup") is not None:
+                out["int8_speedup"] = int8_result["speedup"]
         if best.get("platform") == "cpu":
             cores = best.get("cpu_cores") or os.cpu_count() or 1
             per_core = best["lines_per_s"] / cores
@@ -769,11 +944,18 @@ def main() -> None:
             "value": 0.0,
             "unit": "lines/s",
             "vs_baseline": 0.0,
+            "platform": None,
+            "lines_per_s": 0.0,
+            "speedup": 0.0,
             "error": "all benchmark stages failed",
             "diagnostics": diags,
         }
         if host_result is not None:
             failure["host_path"] = host_result
+        if warm_result is not None:
+            failure["warm_start"] = warm_result
+        if int8_result is not None:
+            failure["int8"] = int8_result
         print(json.dumps(failure))
     sys.stdout.flush()
     sys.exit(0)
@@ -805,5 +987,11 @@ if __name__ == "__main__":
         child_run(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--host":
         child_host()    # no platform pin: this stage never imports jax
+    elif len(sys.argv) > 1 and sys.argv[1] == "--warmstart":
+        apply_child_platform_pin()
+        child_warmstart()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--int8":
+        apply_child_platform_pin()
+        child_int8()
     else:
         main()

@@ -78,6 +78,11 @@ ALERT_COVERED_SERIES = (
     "shed_ladder_state",
     "wal_spool_degraded",
     "dlq_depth_frames",
+    # dmwarm: warm-up wall time + shared-compile-cache effectiveness must
+    # stay alert-covered (ReplicaColdStartSlow) in both directions
+    "scorer_warmup_seconds",
+    "compile_cache_hits_total",
+    "compile_cache_misses_total",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

@@ -154,6 +154,26 @@ class Service:
         from .utils.backend import request_platform
 
         request_platform(settings.backend)
+        # shared persistent compile cache (dmwarm): armed BEFORE the
+        # component loads so the very first jit — warm-up included — is
+        # cache-backed. Replicas and dmroll candidates pointed at the same
+        # compile_cache_dir reuse each other's compiles; the settings
+        # validator already proved the dir writable. Gated on the setting so
+        # non-jax stages never pay the jax import.
+        self.compile_cache_dir: Optional[str] = None
+        if settings.compile_cache_enabled:
+            from .utils.profiling import enable_compilation_cache
+
+            self.compile_cache_dir = enable_compilation_cache(
+                settings.compile_cache_dir or "")
+            if self.compile_cache_dir:
+                self.logger.info("persistent compile cache armed at %s",
+                                 self.compile_cache_dir)
+            else:
+                self.logger.warning(
+                    "compile_cache_enabled but the persistent cache did not "
+                    "arm (no usable directory — set compile_cache_dir, or "
+                    "DETECTMATE_JAX_CACHE for the env path)")
         # multi-host chip plane: when a coordinator is configured, join this
         # process's devices into the global mesh BEFORE any component can
         # initialize a jax backend. The import stays behind the check — the

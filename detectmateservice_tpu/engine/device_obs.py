@@ -49,10 +49,19 @@ from collections import deque
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 from . import metrics as m
-from .health import DEGRADED, PASS
+from .health import DEGRADED, PASS, UNHEALTHY
 
 # the jax.monitoring event name that marks one XLA backend compile
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# the jax.monitoring event recorded when the persistent compilation cache
+# serves a compile by deserializing a stored executable (the backend compile
+# — and therefore COMPILE_EVENT — is skipped entirely on that path)
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+# duration event covering the deserialization itself — the ground truth for
+# the warm-up's cache_load phase split
+CACHE_RETRIEVAL_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
 
 # how long after the last unexpected recompile the watchdog check stays
 # degraded (long enough to survive a scrape/evaluation gap, short enough
@@ -87,6 +96,17 @@ class CompileLedger:
         self._totals = {"compiles": 0, "seconds": 0.0, "unexpected": 0}
         self._last_unexpected_mono: Optional[float] = None
         self._recent_unexpected: deque = deque(maxlen=64)  # monotonic stamps
+        # persistent compile-cache classifier (dmwarm): None = cache not
+        # armed, counters stay silent; a threshold = a recorded "compile"
+        # faster than it is a deserialized cache entry, not a real compile
+        self._cache_threshold_s: Optional[float] = None
+        self._cache_totals = {"hits": 0, "misses": 0}
+        self._cache_children: Optional[tuple] = None
+        self._cache_load_seconds = 0.0
+        # boot warm-up phase timings (scorer_warmup_seconds{phase}); the
+        # scorer records aot / cache_load / device_put once per boot
+        self._warmup_phases: Dict[str, float] = {}
+        self._warmup_children: Dict[str, Any] = {}
         # bucket-state provider (the scorer's adaptive batcher): lets
         # GET /admin/xla report the LIVE warm/retired compile-bucket sets
         # next to the compile history they explain
@@ -103,6 +123,8 @@ class CompileLedger:
                 self._labels = dict(labels)
                 self._compile_children.clear()
                 self._unexpected_child = None
+                self._cache_children = None
+                self._warmup_children.clear()
             if monitor is not self.monitor:
                 # a storm that predates this binding belongs to the previous
                 # service — a freshly-bound monitor starts with a clean
@@ -156,6 +178,66 @@ class CompileLedger:
                     eff[key] = value
         return eff
 
+    # -- persistent compile-cache classification (dmwarm) ----------------
+    def arm_cache_classifier(self, threshold_s: float) -> None:
+        """Arm hit/miss counting: the persistent compilation cache is on,
+        and a recorded compile returning in under ``threshold_s`` is a
+        deserialized cache entry (utils/profiling.enable_compilation_cache
+        calls this after configuring jax)."""
+        with self._lock:
+            self._cache_threshold_s = float(threshold_s)
+
+    @property
+    def cache_armed(self) -> bool:
+        with self._lock:
+            return self._cache_threshold_s is not None
+
+    def _cache_counters(self) -> tuple:
+        pair = self._cache_children
+        if pair is None:
+            pair = (m.COMPILE_CACHE_HITS().labels(**self._labels),
+                    m.COMPILE_CACHE_MISSES().labels(**self._labels))
+            self._cache_children = pair
+        return pair
+
+    def record_cache_hit(self) -> None:
+        """One persistent-cache hit observed DIRECTLY (the jax
+        ``cache_hits`` monitoring event — on that path the backend compile
+        is skipped entirely, so :meth:`record_compile` never sees it)."""
+        with self._lock:
+            self._cache_totals["hits"] += 1
+            hits_c, _ = self._cache_counters()
+        hits_c.inc()
+
+    def record_cache_retrieval(self, duration_s: float) -> None:
+        """Accumulate persistent-cache deserialization wall time (the jax
+        ``cache_retrieval_time_sec`` duration event) — the warm-up's
+        cache_load phase reads the running total."""
+        with self._lock:
+            self._cache_load_seconds += max(0.0, float(duration_s))
+
+    def cache_load_seconds(self) -> float:
+        with self._lock:
+            return self._cache_load_seconds
+
+    # -- boot warm-up phase timings (dmwarm) -----------------------------
+    def record_warmup_phase(self, phase: str, seconds: float) -> None:
+        """Record one boot warm-up phase's wall time
+        (``scorer_warmup_seconds{phase=aot|cache_load|device_put}``)."""
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            self._warmup_phases[phase] = round(seconds, 6)
+            child = self._warmup_children.get(phase)
+            if child is None:
+                child = m.SCORER_WARMUP_SECONDS().labels(
+                    phase=phase, **self._labels)
+                self._warmup_children[phase] = child
+        child.set(seconds)
+
+    def warmup_phases(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._warmup_phases)
+
     # -- warm-up lifecycle ----------------------------------------------
     def mark_warmup_complete(self) -> None:
         with self._lock:
@@ -175,6 +257,9 @@ class CompileLedger:
             self._events.clear()
             self._spans.clear()
             self._totals = {"compiles": 0, "seconds": 0.0, "unexpected": 0}
+            self._cache_totals = {"hits": 0, "misses": 0}
+            self._cache_load_seconds = 0.0
+            self._warmup_phases.clear()
             self._last_unexpected_mono = None
             self._recent_unexpected.clear()
             self._bucket_state_fn = None  # bound to a dead scorer otherwise
@@ -232,6 +317,18 @@ class CompileLedger:
                 "phase": phase,
                 "unexpected": unexpected,
             }
+            cache_c = None
+            if self._cache_threshold_s is not None:
+                # cache armed: a sub-threshold "compile" is a deserialized
+                # cache entry (the ISSUE's hit heuristic — most hits skip
+                # backend compile entirely and arrive via record_cache_hit
+                # instead); anything slower is a real compile that now
+                # populates the shared dir
+                hit = float(duration_s) < self._cache_threshold_s
+                event["cache"] = "hit" if hit else "miss"
+                self._cache_totals["hits" if hit else "misses"] += 1
+                hits_c, misses_c = self._cache_counters()
+                cache_c = hits_c if hit else misses_c
             unexpected_c = None
             if unexpected:
                 self._totals["unexpected"] += 1
@@ -247,6 +344,8 @@ class CompileLedger:
             self._events.append(event)
         compiles_c.inc()
         seconds_c.inc(float(duration_s))
+        if cache_c is not None:
+            cache_c.inc()
         if unexpected_c is not None:
             unexpected_c.inc()
         if emit:
@@ -296,6 +395,9 @@ class CompileLedger:
             totals["seconds"] = round(totals["seconds"], 6)
             warmed = self._warmed
             bucket_fn = self._bucket_state_fn
+            cache_armed = self._cache_threshold_s is not None
+            cache_totals = dict(self._cache_totals)
+            warmup_phases = dict(self._warmup_phases)
         if limit is not None and limit >= 0:
             events = events[-limit:]
             spans = spans[-limit:]
@@ -304,6 +406,8 @@ class CompileLedger:
             "totals": totals,
             "compiles": events,
             "batches": spans,
+            "compile_cache": {"armed": cache_armed, **cache_totals},
+            "warmup_phases": warmup_phases,
         }
         if bucket_fn is not None:
             try:
@@ -311,6 +415,37 @@ class CompileLedger:
             except Exception:  # noqa: BLE001 — a racing scorer must not kill the read
                 pass
         return doc
+
+
+class WarmupPendingCheck:
+    """Watchdog check: UNHEALTHY while the scorer's boot warm-up is in
+    flight. The replica supervisor dispatches to healthy AND degraded
+    replicas (router/router.py ``dispatchable``), so a booting replica that
+    has not finished AOT-compiling its warm set must probe UNHEALTHY — not
+    merely degraded — or scale-out would route traffic onto a replica whose
+    first dispatch pays a synchronous XLA compile (exactly the cold-start
+    this check makes impossible to hide). PASS once the ledger's
+    ``mark_warmup_complete`` lands; the scorer registers this check at the
+    top of ``setup_io`` so deep-health evaluated mid-warm-up refuses
+    ACTIVE."""
+
+    name = "scorer_warmup_pending"
+
+    def __init__(self, ledger: CompileLedger, monitor) -> None:
+        self._ledger = ledger
+        self._monitor = monitor
+
+    def evaluate(self, now: float) -> Tuple[str, str]:
+        if self._ledger.monitor is not self._monitor:
+            return PASS, "ledger bound to another service"
+        if not self._ledger.warmup_complete:
+            return UNHEALTHY, ("scorer warm-up in flight — refusing ACTIVE "
+                               "until the warm set is AOT-compiled")
+        phases = self._ledger.warmup_phases()
+        if phases:
+            total = sum(phases.values())
+            return PASS, f"warm-up complete in {total:.3f}s ({phases})"
+        return PASS, "warm-up complete"
 
 
 class RecompileStormCheck:
@@ -361,10 +496,11 @@ def activate(ledger: CompileLedger) -> CompileLedger:
 
 
 def _on_event_duration(event: str, duration: float, **kwargs) -> None:
-    if event != COMPILE_EVENT:
-        return
     try:
-        _ACTIVE.record_compile(duration)
+        if event == COMPILE_EVENT:
+            _ACTIVE.record_compile(duration)
+        elif event == CACHE_RETRIEVAL_EVENT:
+            _ACTIVE.record_cache_retrieval(duration)
     except Exception:  # noqa: BLE001 — telemetry must never break a compile
         pass
 
@@ -382,6 +518,38 @@ def install_listener() -> bool:
             return False
         monitoring.register_event_duration_secs_listener(_on_event_duration)
         _LISTENER_INSTALLED = True
+        return True
+
+
+_CACHE_LISTENER_INSTALLED = False
+
+
+def _on_cache_event(event: str, **kwargs) -> None:
+    if event != CACHE_HIT_EVENT:
+        return
+    try:
+        _ACTIVE.record_cache_hit()
+    # dmlint: ignore[DM-R001] hit counting is telemetry riding a compile —
+    except Exception:  # noqa: BLE001 — it must never break the compile
+        pass
+
+
+def install_cache_listener() -> bool:
+    """Register the persistent-cache hit listener (idempotent; once per
+    process). A cache hit deserializes the stored executable and skips the
+    backend compile — so COMPILE_EVENT never fires and only this event
+    carries the hit. Called by ``enable_compilation_cache`` when the cache
+    arms; returns False when jax is unavailable."""
+    global _CACHE_LISTENER_INSTALLED
+    with _INSTALL_LOCK:
+        if _CACHE_LISTENER_INSTALLED:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False
+        monitoring.register_event_listener(_on_cache_event)
+        _CACHE_LISTENER_INSTALLED = True
         return True
 
 

@@ -226,6 +226,41 @@ XLA_RECOMPILES_UNEXPECTED = _series(
     "stalls the engine loop for the full compile; a nonzero rate is a "
     "recompile storm (ops/alerts.yml RecompileStorm)",
 )
+# warm-start serving (dmwarm, PR 17): the cold-start contract. The warm-up
+# gauge splits boot→first-score into its three phases — aot (the
+# lower().compile() pass over the warm bucket set), cache_load (persistent-
+# cache deserialization time folded into those compiles), device_put
+# (params landing in HBM / mesh shards) — set once per boot, so a replica
+# whose aot phase blows past the fleet norm is visible per-phase
+# (ops/alerts.yml ReplicaColdStartSlow). The cache pair only moves while
+# the persistent compile cache is armed (compile_cache_enabled /
+# DETECTMATE_JAX_CACHE): hits are deserialized cache entries (direct
+# /jax/compilation_cache/cache_hits events, plus sub-threshold ledger
+# compiles), misses are real backend compiles that had to run — a fleet
+# whose replicas share a compile_cache_dir should see hits dominate from
+# the second boot on.
+WARMUP_PHASE_LABELS = ("component_type", "component_id", "phase")
+SCORER_WARMUP_SECONDS = _series(
+    Gauge,
+    "scorer_warmup_seconds",
+    "Wall seconds of the scorer's boot warm-up by phase: aot (warm-set "
+    "lower+compile), cache_load (persistent-cache deserialization), "
+    "device_put (params to HBM/mesh); set once per boot",
+    WARMUP_PHASE_LABELS,
+)
+COMPILE_CACHE_HITS = _series(
+    Counter,
+    "compile_cache_hits_total",
+    "Persistent compile-cache hits: compiles served by deserializing a "
+    "cached executable instead of running XLA (only moves while the cache "
+    "is armed)",
+)
+COMPILE_CACHE_MISSES = _series(
+    Counter,
+    "compile_cache_misses_total",
+    "Persistent compile-cache misses: real XLA backend compiles that ran "
+    "with the cache armed (each one then populates the shared dir)",
+)
 # HBM residency, refreshed AT SCRAPE TIME (Gauge.set_function bound to
 # jax Device.memory_stats) — absent on backends without memory stats (CPU)
 HBM_LABELS = ("component_type", "component_id", "device", "kind")

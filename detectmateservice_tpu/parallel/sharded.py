@@ -112,6 +112,15 @@ class ShardedScorer:
             out_shardings=(self._param_sharding, self._opt_sharding, None),
             donate_argnums=(0, 1),
         )
+        # dmwarm (PR 17): AOT-compiled executables keyed (kind, padded_B) —
+        # the detector's setup_io lowers+compiles the warm bucket set here
+        # so mesh dispatch executes without entering the jit compile path
+        self._aot: Dict[Tuple[str, int], Any] = {}
+        # weight-only int8 serving (models/quant.py): installed by the
+        # detector after its parity gate passes; None = float path serves
+        self._qparams = None
+        self._qscore = None
+        self._qnormscore = None
 
     @property
     def data_parallelism(self) -> int:
@@ -124,6 +133,79 @@ class ShardedScorer:
         the swap itself is a reference assignment, never a recompile."""
         self.params = jax.device_put(params, self._param_sharding)
         self.opt_state = jax.device_put(opt_state, self._opt_sharding)
+
+    # -- AOT warm-start (dmwarm) -----------------------------------------
+    def aot_compile_bucket(self, kind: str, tokens: np.ndarray,
+                           *extra) -> None:
+        """Lower+compile one (kind, bucket) sharded executable and KEEP it
+        (jax's AOT compile does not seed the jit's dispatch cache). The
+        batch pads to the mesh's data-axis multiple first, so the key is
+        the padded shape every later dispatch of this bucket produces."""
+        jit_fn = {"score": self._score, "normscore": self._normscore,
+                  "token_nlls": self._token_nlls}[kind]
+        tokens, _ = self._pad_batch(np.asarray(tokens))
+        tokens = jax.device_put(tokens, self._batch_sharding)
+        args = (self.params, tokens, *extra)
+        with device_obs.get_ledger().context(bucket=tokens.shape[0],
+                                             backend="mesh",
+                                             where="sharded"):
+            if self._seq_axis is None:
+                self._aot[(kind, tokens.shape[0])] = (
+                    jit_fn.lower(*args).compile())
+            else:
+                from ..ops.attention import ring_context
+
+                with ring_context(self.mesh, batch_axis=self._data_axis,
+                                  axis_name=self._seq_axis):
+                    self._aot[(kind, tokens.shape[0])] = (
+                        jit_fn.lower(*args).compile())
+
+    def _aot_call(self, kind: str, batch: int, *args):
+        """The kept executable for (kind, batch), called directly — returns
+        None when absent or on aval drift (caller falls back to the jit)."""
+        comp = self._aot.get((kind, batch))
+        if comp is None:
+            return None
+        try:
+            return comp(*args)
+        # dmlint: ignore[DM-R001] aval drift returns None — the caller
+        except Exception:  # noqa: BLE001 — falls back to the traced jit
+            return None
+
+    # -- weight-only int8 serving (dmwarm) -------------------------------
+    def install_quantized(self, qparams) -> None:
+        """Install a quantized tree (models/quant.quantize_tree of the live
+        params): the int8 payloads shard exactly like their float leaves,
+        the per-channel scales along the leaf's last-axis placement. The
+        detector's parity gate decides whether this tree ever serves."""
+        from ..models.quant import dequantize_tree, quant_shardings
+
+        qshard = quant_shardings(self.params, self._param_sharding,
+                                 self.mesh)
+        qparams = jax.device_put(qparams, qshard)
+        if self._qscore is None:
+            scorer = self.scorer
+            compute_dtype = scorer.config.dtype
+
+            def _qscore_impl(qp, tokens):
+                return scorer._score_impl(
+                    dequantize_tree(qp, compute_dtype), tokens)
+
+            def _qnormscore_impl(qp, tokens, mu, sigma):
+                return scorer._normscore_impl(
+                    dequantize_tree(qp, compute_dtype), tokens, mu, sigma)
+
+            self._qscore = jax.jit(
+                _qscore_impl, in_shardings=(qshard, self._batch_sharding))
+            self._qnormscore = jax.jit(
+                _qnormscore_impl,
+                in_shardings=(qshard, self._batch_sharding, None, None))
+        self._qparams = qparams
+
+    def clear_quantized(self) -> None:
+        """Back to the float path (parity flip, or a fresh candidate swap
+        whose requant has not been judged yet)."""
+        self._qparams = None
 
     def _traced(self, fn, *args, bucket: Optional[int] = None):
         """Invoke a jitted fn; on a seq mesh, tracing happens inside
@@ -177,9 +259,17 @@ class ShardedScorer:
         """Asynchronous scoring: dispatch and return the device array without
         forcing a host readback (rows beyond the caller's real batch are
         padding — the caller slices). Lets the detector's pipelined hot path
-        overlap readback with the next batch's featurization."""
+        overlap readback with the next batch's featurization. Routing: the
+        int8 quantized path when live, then the bucket's AOT executable,
+        then the jit (whose compile the ledger attributes)."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
+        if self._qparams is not None:
+            return self._traced(self._qscore, self._qparams, tokens,
+                                bucket=tokens.shape[0])
+        out = self._aot_call("score", tokens.shape[0], self.params, tokens)
+        if out is not None:
+            return out
         return self._traced(self._score, self.params, tokens,
                             bucket=tokens.shape[0])
 
@@ -187,6 +277,10 @@ class ShardedScorer:
         """[n, S] → [n_padded, S] per-position NLLs on device."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
+        out = self._aot_call("token_nlls", tokens.shape[0],
+                             self.params, tokens)
+        if out is not None:
+            return out
         return self._traced(self._token_nlls, self.params, tokens,
                             bucket=tokens.shape[0])
 
@@ -194,6 +288,13 @@ class ShardedScorer:
         """Per-position-normalized scores (models.logbert.positional_z_max)."""
         tokens, _ = self._pad_batch(np.asarray(tokens))
         tokens = jax.device_put(tokens, self._batch_sharding)
+        if self._qparams is not None:
+            return self._traced(self._qnormscore, self._qparams, tokens,
+                                mu, sigma, bucket=tokens.shape[0])
+        out = self._aot_call("normscore", tokens.shape[0],
+                             self.params, tokens, mu, sigma)
+        if out is not None:
+            return out
         return self._traced(self._normscore, self.params, tokens, mu, sigma,
                             bucket=tokens.shape[0])
 

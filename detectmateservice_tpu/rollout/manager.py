@@ -261,6 +261,13 @@ class RolloutManager:
                 "rows": int(len(rows)),
                 "model": getattr(self.detector.config, "model", "unknown"),
                 **fit_info}
+        # persist the AOT warm-set spec (dmwarm): a promote on a RESTARTED
+        # replica pre-warms the buckets the recording boot warmed, so the
+        # cutover stays compile-free even when the promoting process never
+        # dispatched those shapes itself
+        warm_spec = self._warm_set_spec()
+        if warm_spec is not None:
+            meta["warm_set"] = warm_spec
         self.store.record(version, meta, status="shadowing")
         self._begin_shadow(version, params, opt_state, source="fine_tune")
         info = {"version": version, "rows": int(len(rows)),
@@ -300,7 +307,11 @@ class RolloutManager:
             version = self.store.allocate_version()
             ckpt_dir = str(self.store.version_dir(version))
             self.detector.save_params_checkpoint(ckpt_dir, params, opt_state)
-            self.store.record(version, {"source": tag}, status="shadowing")
+            meta: Dict[str, Any] = {"source": tag}
+            warm_spec = self._warm_set_spec()
+            if warm_spec is not None:
+                meta["warm_set"] = warm_spec
+            self.store.record(version, meta, status="shadowing")
             self._begin_shadow(version, params, opt_state, source=tag,
                                min_samples=min_samples, timeout_s=timeout_s)
             return version
@@ -346,7 +357,9 @@ class RolloutManager:
         stats = shadow.evaluator.stats()
         if verdict == "promote":
             swap = self._install(shadow.params, shadow.opt_state,
-                                 shadow.version, source=shadow.source)
+                                 shadow.version, source=shadow.source,
+                                 warm_set=self._stored_warm_set(
+                                     shadow.version))
             self.store.set_live(shadow.version, divergence=stats)
             self._count_swap("promoted")
             self._set_version_info(shadow.version)
@@ -367,10 +380,32 @@ class RolloutManager:
             self._last_cycle_info = outcome
         return outcome
 
+    def _warm_set_spec(self) -> Optional[Dict[str, Any]]:
+        """The detector's live AOT warm-set spec (None for components
+        without one)."""
+        spec_fn = getattr(self.detector, "warm_set_spec", None)
+        if not callable(spec_fn):
+            return None
+        try:
+            return spec_fn()
+        # dmlint: ignore[DM-R001] warm-set spec is manifest metadata — it
+        except Exception:  # noqa: BLE001 — must not block a rollout cycle
+            return None
+
+    def _stored_warm_set(self, version: int) -> Optional[Dict[str, Any]]:
+        """The warm-set spec recorded with a stored version, if any."""
+        try:
+            return self.store.entry(version).get("meta", {}).get("warm_set")
+        # dmlint: ignore[DM-R001] absent entry / legacy manifest — install
+        except Exception:  # noqa: BLE001 — warms the live set instead
+            return None
+
     def _install(self, params: Any, opt_state: Any, version: int,
-                 source: str) -> Dict[str, Any]:
+                 source: str,
+                 warm_set: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         swap = self.detector.install_candidate(params, opt_state,
-                                               version=version)
+                                               version=version,
+                                               warm_set=warm_set)
         swap["source"] = source
         return swap
 
@@ -409,7 +444,8 @@ class RolloutManager:
         directory = str(self.store.root / entry["dir"])
         params, opt_state, meta = self.detector.load_params_checkpoint(
             directory)
-        swap = self._install(params, opt_state, version, source=action)
+        swap = self._install(params, opt_state, version, source=action,
+                             warm_set=entry.get("meta", {}).get("warm_set"))
         self.store.set_live(version)
         result = "promoted" if action == "promote" else "rolled_back"
         self._count_swap(result)

@@ -92,7 +92,12 @@ class CheckpointStore:
                status: str = "candidate") -> Dict[str, Any]:
         """Commit a fully-saved version into the manifest (atomic), then
         apply keep-N pruning. Caller guarantees ``save_scorer_state``
-        already landed in ``version_dir(version)``."""
+        already landed in ``version_dir(version)``.
+
+        ``meta`` may carry a ``warm_set`` spec (the detector's
+        ``warm_set_spec()`` — dmwarm): install paths read it back so a
+        promote on a restarted process AOT pre-warms the bucket set the
+        recording boot warmed before cutover."""
         with self._lock:
             doc = self._load()
             entry = {

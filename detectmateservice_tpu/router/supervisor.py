@@ -28,6 +28,17 @@ States (exported as the ``router_replica_state`` gauge):
 * ``DRAINED (0)``    — settled (window emptied) or timed out (window moved
   to the requeue queue for redelivery to healthy peers — at-least-once).
 
+The **warm-up gate** (dmwarm): a scorer replica registers the
+``scorer_warmup_pending`` deep-health check at the top of ``setup_io``
+(``engine/device_obs.WarmupPendingCheck``), and it reports UNHEALTHY —
+not degraded — until the warm bucket set is AOT-compiled and
+``mark_warmup_complete`` lands. Because this supervisor's verdict is the
+deep-health state, a booting replica stays out of dispatch until its warm
+set is compiled: scale-out never routes a frame onto a replica whose
+first dispatch would pay a synchronous XLA compile. No router-side code
+is warm-up-aware; the gate rides the existing unhealthy→no-dispatch
+state machine.
+
 The **ack watermark**: the router counts lines dispatched per replica; the
 probe reads the replica's cumulative ``data_read_lines_total`` from its
 ``/metrics``. Because each replica has exactly ONE feeder (this router —

@@ -390,6 +390,24 @@ class ServiceSettings(BaseModel):
     # memory-only quarantine otherwise
     dlq_dir: Optional[str] = None
 
+    # -- warm-start serving: dmwarm (utils/profiling.py, PR 17) -----------
+    # When true, the JAX persistent compilation cache is armed in Service
+    # construction — BEFORE the component's first jit — so a restarted
+    # replica (or a dmroll candidate swap on the same host) reuses every
+    # already-seen (kernel, bucket) compile instead of paying cold-start.
+    # Point every replica of a tier at the SAME compile_cache_dir and HPA
+    # scale-out boots against a warm cache (docs/walkthrough.md "make
+    # scale-out honest"). Off (the default) keeps the env-only behavior
+    # (DETECTMATE_JAX_CACHE), which is OFF on CPU backends.
+    compile_cache_enabled: bool = False
+    # shared cache root; entries land under a machine-fingerprint
+    # subdirectory (utils/profiling._machine_fingerprint) so heterogeneous
+    # hosts can share the directory without ever loading each other's
+    # machine-tuned artifacts. An explicit dir persists EVERY compile
+    # (min-compile-time floor drops to 0) — required for CPU-sim parity
+    # runs, harmless on TPU. None + enabled = the env/default-home path.
+    compile_cache_dir: Optional[str] = None
+
     # -- multi-tenant admission control: dmshed (shed/) -------------------
     # When true, the engine ingress runs per-tenant token-bucket admission
     # BEFORE spooling/processing each frame: frames carry an optional
@@ -505,6 +523,26 @@ class ServiceSettings(BaseModel):
         if self.durable_ingress and not self.wal_dir:
             raise ValueError(
                 "durable_ingress requires wal_dir (the WAL spool directory)")
+        return self
+
+    # -- compile-cache cross-validation -----------------------------------
+    @model_validator(mode="after")
+    def _check_compile_cache(self) -> "ServiceSettings":
+        """A non-writable ``compile_cache_dir`` must fail at startup, not at
+        the first compile (where enable_compilation_cache swallows the
+        OSError and the operator's shared cache silently never fills)."""
+        if self.compile_cache_enabled and self.compile_cache_dir:
+            probe = os.path.join(self.compile_cache_dir,
+                                 f".dmwarm_probe_{os.getpid()}")
+            try:
+                os.makedirs(self.compile_cache_dir, exist_ok=True)
+                with open(probe, "w", encoding="utf-8") as fh:
+                    fh.write("ok")
+                os.unlink(probe)
+            except OSError as exc:
+                raise ValueError(
+                    f"compile_cache_dir {self.compile_cache_dir!r} is not "
+                    f"writable: {exc}")
         return self
 
     # -- shed cross-validation --------------------------------------------
