@@ -83,6 +83,10 @@ ALERT_COVERED_SERIES = (
     "scorer_warmup_seconds",
     "compile_cache_hits_total",
     "compile_cache_misses_total",
+    # dmdrift: the drift statistic and the predictive scale-out signal
+    # must stay alert-covered (ModelDriftSustained / CapacityHeadroomLow)
+    "model_drift_score",
+    "capacity_headroom_ratio",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

@@ -204,6 +204,24 @@ class DetectMateClient:
                 return None
             raise
 
+    def drift(self) -> Any:
+        """Drift-monitor snapshot (``GET /admin/drift``): live-vs-baseline
+        KS/PSI, hysteresis state, top drifting feature columns. HTTP 404
+        (stage without ``drift_enabled``) surfaces as None, mirroring
+        ``model_status``."""
+        try:
+            return self._request("GET", "/admin/drift")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+
+    def slo(self) -> Any:
+        """SLO burn-rate snapshot (``GET /admin/slo``): multi-window error
+        ratios/burn rates, per-stage dwell attribution, and the capacity
+        model when ``capacity_enabled``."""
+        return self._request("GET", "/admin/slo")
+
     def dlq_status(self, limit: Optional[int] = None) -> Any:
         """Dead-letter-queue snapshot (``GET /admin/dlq``): depth, totals,
         and the newest quarantined entries (frame bytes omitted)."""
@@ -833,6 +851,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(/admin/tenants)")
     tenants_p.add_argument("--limit", type=int, default=None,
                            help="only the top N tenants by shed count")
+    sub.add_parser(
+        "drift", help="drift monitor: live-vs-baseline KS/PSI, hysteresis "
+                      "state, top drifting features (/admin/drift)")
+    sub.add_parser(
+        "slo", help="multi-window SLO burn rates, per-stage dwell "
+                    "attribution, and the capacity model (/admin/slo)")
     dlq_p = sub.add_parser(
         "dlq", help="dead-letter queue: inspect, requeue, or purge "
                     "quarantined poison frames (/admin/dlq)")
@@ -893,6 +917,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             if result is None:
                 print("admission control is not enabled on this stage "
                       "(shed_enabled)", file=sys.stderr)
+                return 1
+            print(json.dumps(result, indent=2))
+            return 0
+        if args.command == "drift":
+            result = client.drift()
+            if result is None:
+                print("drift monitoring is not enabled on this stage "
+                      "(drift_enabled)", file=sys.stderr)
                 return 1
             print(json.dumps(result, indent=2))
             return 0

@@ -371,6 +371,39 @@ class Service:
                     "model lifecycle disabled for this stage",
                     settings.component_type)
 
+        # continuous observability (obs/): drift rides the rollout
+        # subsystem's reservoir + store (the settings validator enforces
+        # rollout_enabled), capacity taps the scorer directly; the SLO
+        # tracker is threadless and always available behind GET /admin/slo.
+        self.drift = None
+        self.capacity = None
+        if settings.drift_enabled and self.rollout is not None:
+            from .obs import DriftMonitor
+
+            self.drift = DriftMonitor(
+                settings, sampler=self.rollout.sampler,
+                store=self.rollout.store, rollout=self.rollout,
+                labels=dict(self._labels), monitor=self.health,
+                logger=self.logger)
+            self.drift.start()
+        if settings.capacity_enabled:
+            if callable(getattr(self.library_component, "set_capacity_tap",
+                                None)):
+                from .obs import CapacityMonitor
+
+                self.capacity = CapacityMonitor(
+                    self.library_component, settings,
+                    labels=dict(self._labels), logger=self.logger)
+                self.capacity.start()
+            else:
+                self.logger.warning(
+                    "capacity_enabled but component %r has no capacity "
+                    "tap; capacity model disabled for this stage",
+                    settings.component_type)
+        from .obs import SloTracker
+
+        self.slo = SloTracker()
+
         self._running_metric = m.ENGINE_RUNNING().labels(**self._labels)
         self._starts_metric = m.ENGINE_STARTS().labels(**self._labels)
         self._running_metric.state("stopped")
@@ -494,6 +527,15 @@ class Service:
         self._service_exit_event.set()
 
     def _teardown(self) -> None:
+        # obs monitors stop FIRST: drift may be mid-run_cycle against the
+        # rollout manager and capacity holds a tap into the detector —
+        # both must quiesce before the things they observe are torn down
+        for mon, what in ((self.drift, "drift"), (self.capacity, "capacity")):
+            if mon is not None:
+                try:
+                    mon.stop()
+                except Exception as exc:
+                    self.logger.error("%s monitor stop failed: %s", what, exc)
         if self.rollout is not None:
             try:
                 self.rollout.stop()

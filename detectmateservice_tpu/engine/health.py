@@ -80,6 +80,14 @@ EVENT_KINDS = {
     "model_canary_holdback": "the shadow gate rejected a candidate",
     "model_pinned": "an operator pinned the served model version",
     "model_unpinned": "an operator lifted the model pin",
+    "drift_detected": "the live score distribution diverged from the "
+                      "pinned baseline past the hysteresis gate",
+    "drift_cleared": "drift stats returned under threshold (typically "
+                     "after a promote re-pinned the baseline)",
+    "drift_baseline_pinned": "the drift monitor (re)pinned its reference "
+                             "score distribution (boot, resume, promote)",
+    "drift_cycle": "sustained drift pulled a rollout cycle forward of "
+                   "its interval clock",
     "load_shed": "ingress admission control refused frames (tenant over "
                  "quota, or its tier gated by the degradation ladder)",
     "shed_ladder_transition": "the overload degradation ladder changed state",

@@ -412,6 +412,38 @@ MODEL_VERSION_INFO = _series(
     "model family (0 = the boot-time fit, never hot-swapped)",
     MODEL_VERSION_LABELS)
 
+# drift & capacity observability (obs/): the dmdrift contract. Drift score
+# compares the LIVE score distribution (the dmroll reservoir's paired
+# rows+scores) against the baseline pinned at promote time: stat="ks" is
+# the two-sample Kolmogorov–Smirnov statistic, stat="psi" the population
+# stability index over baseline-quantile bins; features_over_threshold is
+# how many token columns exceed the per-feature PSI ceiling — together the
+# ModelDriftSustained signal. Capacity is the calibrated per-replica
+# throughput model (busy-time arithmetic while traffic flows, a bounded
+# idle micro-probe otherwise); headroom is offered rate ÷ modeled capacity
+# — the router republishes both under its own labels as the tier-wide
+# predictive scale-out signal (CapacityHeadroomLow, ops/k8s-replicas.yaml).
+DRIFT_LABELS = ("component_type", "component_id", "stat")
+MODEL_DRIFT_SCORE = _series(
+    Gauge, "model_drift_score",
+    "Live-vs-baseline score-distribution divergence, by statistic: "
+    "stat=\"ks\" (two-sample Kolmogorov–Smirnov) or stat=\"psi\" "
+    "(population stability index)",
+    DRIFT_LABELS)
+MODEL_DRIFT_FEATURES = _series(
+    Gauge, "model_drift_features_over_threshold",
+    "Token feature columns whose per-feature PSI against the pinned "
+    "baseline exceeds drift_feature_psi_threshold")
+REPLICA_CAPACITY = _series(
+    Gauge, "replica_capacity_lines_per_s",
+    "Modeled scoring capacity of this replica (lines/s at full device "
+    "busy): rows ÷ device-seconds over the live window, or the idle "
+    "micro-probe's measured rate when no traffic flows")
+CAPACITY_HEADROOM = _series(
+    Gauge, "capacity_headroom_ratio",
+    "Offered line rate ÷ modeled capacity (0 = idle, 1 = saturated); the "
+    "predictive scale-out signal beside the reactive backlog gauge")
+
 # durable ingress spool (wal/, PR 11): the dmwal observability contract.
 # Depth/bytes/age are computed AT SCRAPE TIME (Gauge.set_function bound to
 # the live spool — a wedged engine thread cannot freeze them, the same

@@ -173,16 +173,23 @@ class _InflightSlot:
 
     __slots__ = ("scores", "raws", "real", "error", "done",
                  "t_enqueue", "t_start", "bucket", "path", "trace_id",
-                 "release")
+                 "release", "tokens")
 
     def __init__(self, raws, real: int, bucket: int = 0,
                  path: str = "device", trace_id: Optional[str] = None,
-                 release: Optional[str] = None):
+                 release: Optional[str] = None,
+                 tokens: Optional[np.ndarray] = None):
         import threading
 
         self.scores = None
         self.raws = raws
         self.real = real
+        # the REAL (unpadded) token rows, retained only while a rollout
+        # sampler is attached: the drain path offers rows PAIRED with
+        # their scores (dmdrift needs the live score distribution against
+        # the rows that produced it). Memory bound: pipeline_depth slots x
+        # bucket x seq_len x 4 bytes, None on untapped detectors.
+        self.tokens = tokens
         self.error: Optional[Exception] = None
         self.done = threading.Event()
         self.t_enqueue = time.monotonic()
@@ -465,6 +472,9 @@ class JaxScorerDetector(CoreDetector):
         # token batch to it, and install_candidate is the
         # pre-warm-then-hot-swap seam promoted candidates cut over through
         self._rollout_sampler = None
+        # dmdrift (obs/capacity.py): per-batch (rows, device-seconds)
+        # callback feeding the capacity model; None costs one branch
+        self._capacity_tap = None
         self._model_version = 0
         # dmwarm (PR 17): AOT-compiled executables for the warm bucket set,
         # keyed (kind, bucket). setup_io lowers+compiles them so the first
@@ -1326,8 +1336,6 @@ class JaxScorerDetector(CoreDetector):
             n = len(detect_idx)
             det_tokens = tokens[detect_idx]
             det_raws = [batch[i] for i in detect_idx]
-            if self._rollout_sampler is not None:
-                self._rollout_sampler.offer_rows(det_tokens)
             coalescer = self._get_coalescer()
             if coalescer is not None:
                 # continuous batching: hold the rows toward a warm bucket;
@@ -1423,8 +1431,6 @@ class JaxScorerDetector(CoreDetector):
             raws = matchkern.SpanRaws(fb.blob, fb.spans[idx])
             n_ok = len(idx)
         if n_ok:
-            if self._rollout_sampler is not None:
-                self._rollout_sampler.offer_rows(tokens)
             coalescer = self._get_coalescer()
             if coalescer is not None:
                 # SpanRaws segments stay lazy inside the coalescer — no
@@ -1597,6 +1603,10 @@ class JaxScorerDetector(CoreDetector):
         every coalesced dispatch rides a pre-warmed compile shape."""
         self._ensure_scorer()
         n = len(tokens)
+        # retain real token rows on the slot only while a rollout sampler
+        # is attached: the drain path offers rows PAIRED with their scores
+        # (dmdrift reads the live score distribution off the reservoir)
+        keep_tokens = self._rollout_sampler is not None
         cap = self.config.host_score_max_batch
         # dmlint: ignore[DM-L001] ref-atomic mirror swap (see _score_host)
         if 0 < n <= cap and self._host_params is not None:
@@ -1615,7 +1625,8 @@ class JaxScorerDetector(CoreDetector):
                 slot = _InflightSlot(list(msgs), n, bucket=bucket,
                                      path="host",
                                      trace_id=self._current_trace_id(),
-                                     release=release)
+                                     release=release,
+                                     tokens=tokens if keep_tokens else None)
                 if t_enqueue is not None:
                     slot.t_enqueue = t_enqueue
                 slot.t_start = time.monotonic()
@@ -1656,7 +1667,9 @@ class JaxScorerDetector(CoreDetector):
             slot = _InflightSlot(msgs[start:start + real], real,
                                  bucket=bucket, path="device",
                                  trace_id=self._current_trace_id(),
-                                 release=release)
+                                 release=release,
+                                 tokens=(tokens[start:start + real]
+                                         if keep_tokens else None))
             if t_enqueue is not None:
                 slot.t_enqueue = t_enqueue
             self._inflight.append(slot)
@@ -1936,6 +1949,11 @@ class JaxScorerDetector(CoreDetector):
             return []
         raws, real = slot.raws, slot.real
         scores = np.asarray(slot.scores)[:real]
+        if self._rollout_sampler is not None and slot.tokens is not None:
+            # drain-time tap (dmdrift): rows enter the reservoir PAIRED
+            # with the scores this batch produced — the drift monitor's
+            # live distribution is exactly what the dispatch path scored
+            self._rollout_sampler.offer_rows(slot.tokens[:real], scores)
         if slot.path != "host":
             # np.asarray above forced the readback: scoring-call start →
             # now is the batch's device compute + readback time (the host
@@ -2115,13 +2133,25 @@ class JaxScorerDetector(CoreDetector):
             self._ledger.record_span(bucket, slot.real, path, queue_wait_s,
                                      max(0.0, device_s), slot.trace_id,
                                      release=slot.release)
+        tap = self._capacity_tap
+        if tap is not None:
+            # dmdrift capacity arithmetic: real rows + the device-time this
+            # batch cost, from the one site every scored batch reports to
+            tap(slot.real, max(0.0, device_s))
 
     # -- model rollout (rollout/manager.py seams) ------------------------
     def set_rollout_sampler(self, sampler) -> None:
         """Attach the dispatch-path traffic tap (rollout/sampler.py). One
-        ``offer_rows`` call per dispatched micro-batch — the sampler bounds
+        ``offer_rows`` call per DRAINED micro-batch — rows enter paired
+        with the scores they produced (dmdrift) — and the sampler bounds
         its own memory and does its own thinning."""
         self._rollout_sampler = sampler
+
+    def set_capacity_tap(self, tap) -> None:
+        """Attach the dmdrift capacity tap (obs/capacity.py): called as
+        ``tap(n_rows, device_seconds)`` per observed batch, any dispatch
+        path. None detaches."""
+        self._capacity_tap = tap
 
     def model_version(self) -> int:
         """The installed checkpoint version (0 = the boot-time fit)."""

@@ -553,6 +553,37 @@ class Rule:
         }
 
 
+class RecordingRule:
+    """One recording rule: evaluate the expr and write the result back into
+    the store under the recorded name (``level:metric:operation`` names
+    tokenize natively — ``:`` is an identifier character in the PromQL
+    grammar above, exactly as in Prometheus). Evaluated BEFORE the alert
+    rules each tick, so an alert expr referencing the recorded series
+    (``PipelineSloBurnRecorded``) reads this tick's value — matching
+    Prometheus's rule-group ordering semantics closely enough for a soak
+    verdict."""
+
+    def __init__(self, record: str, expr: str) -> None:
+        self.record = record
+        self.expr_text = expr
+        self.expr = parse_expr(expr)
+        self.evaluations = 0
+        self.samples_recorded = 0
+
+    def evaluate(self, store: SampleStore, t: float,
+                 time_scale: float = 1.0) -> int:
+        result = self.expr.eval(store, t, time_scale)
+        self.evaluations += 1
+        written = 0
+        for labels, value in _as_vector(result):
+            if value is None or value != value:   # empty / NaN: no sample
+                continue
+            store.add(self.record, dict(labels), t, float(value))
+            written += 1
+        self.samples_recorded += written
+        return written
+
+
 def load_rules(alerts_path) -> List[Rule]:
     """Parse ``ops/alerts.yml`` into :class:`Rule` objects. Every expression
     must be inside the supported grammar — a PromQLError here means the rule
@@ -572,19 +603,46 @@ def load_rules(alerts_path) -> List[Rule]:
     return rules
 
 
-class RuleEvaluator:
-    """Evaluate every rule on each scrape tick; collect the firing story."""
+def load_recording_rules(rules_path) -> List[RecordingRule]:
+    """Parse ``ops/recording_rules.yml`` into :class:`RecordingRule`
+    objects — same grammar pin as :func:`load_rules`: every recorded expr
+    must parse, or the file drifted outside the live-testable subset."""
+    import yaml
 
-    def __init__(self, rules: List[Rule], time_scale: float = 1.0) -> None:
+    doc = yaml.safe_load(open(rules_path, "r", encoding="utf-8"))
+    rules = []
+    for group in (doc or {}).get("groups", []):
+        for rule in group.get("rules", []):
+            if "record" not in rule:
+                continue
+            rules.append(RecordingRule(rule["record"], str(rule["expr"])))
+    return rules
+
+
+class RuleEvaluator:
+    """Evaluate every rule on each scrape tick; collect the firing story.
+    Recording rules (when given) run first each tick, so alert exprs can
+    reference the recorded series by name."""
+
+    def __init__(self, rules: List[Rule], time_scale: float = 1.0,
+                 recording: Optional[List[RecordingRule]] = None) -> None:
         self.rules = rules
+        self.recording = list(recording or [])
         self.time_scale = max(1e-9, float(time_scale))
 
     def tick(self, store: SampleStore, t: float) -> Dict[str, str]:
+        for rec in self.recording:
+            rec.evaluate(store, t, self.time_scale)
         return {rule.name: rule.evaluate(store, t, self.time_scale)
                 for rule in self.rules}
 
     def report(self) -> Dict[str, Dict[str, Any]]:
         return {rule.name: rule.report() for rule in self.rules}
+
+    def recording_report(self) -> Dict[str, Dict[str, Any]]:
+        return {rec.record: {"evaluations": rec.evaluations,
+                             "samples_recorded": rec.samples_recorded}
+                for rec in self.recording}
 
     def fired(self) -> List[str]:
         return [rule.name for rule in self.rules

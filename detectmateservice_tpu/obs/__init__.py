@@ -1,0 +1,23 @@
+"""dmdrift (obs/): continuous drift + capacity observability.
+
+Two monitors close the loop between *what the model was trained on* and
+*what the fleet can actually serve*:
+
+* :mod:`.drift` — streaming score-distribution drift against a baseline
+  pinned at promote time (KS + PSI over the dmroll reservoir's paired
+  rows+scores, per-feature PSI on the token columns), with hysteresis-gated
+  ``drift_detected``/``drift_cleared`` events and an early
+  ``RolloutManager.run_cycle(reason="drift")`` kick — retraining follows
+  the data, not the clock.
+* :mod:`.capacity` — a calibrated per-replica capacity model
+  (``replica_capacity_lines_per_s``) from dispatch-tap arithmetic while
+  traffic flows and a bounded idle micro-probe otherwise, plus
+  ``capacity_headroom_ratio`` (offered ÷ modeled) as the predictive
+  scale-out signal, and the threadless :class:`~.capacity.SloTracker`
+  behind ``GET /admin/slo``.
+"""
+from .capacity import CapacityMonitor, SloTracker
+from .drift import DriftBaseline, DriftMonitor, ks_statistic, psi
+
+__all__ = ["CapacityMonitor", "DriftBaseline", "DriftMonitor",
+           "SloTracker", "ks_statistic", "psi"]

@@ -7,26 +7,35 @@ micro-batch, engine thread), a seeded ratio filter thins it, and a classic
 Algorithm-R reservoir bounds memory to ``capacity`` rows no matter how long
 the service runs. Rows are stored as copies of the tokenized [S] int32
 vectors — raw bytes never enter the sampler, so its memory bound is exactly
-``capacity * seq_len * 4`` bytes.
+``capacity * seq_len * 4`` bytes (plus one fp32 score per row when the
+offerer pairs scores with rows — the dmdrift tap).
 
 Determinism: the RNG is seeded, and both the ratio filter and the reservoir
 replacement indices are drawn from it in offer order — the same offered
 sequence always yields the same reservoir (pinned by tests/test_rollout.py).
 The clock is injected for the same reason: ``last_offer_age`` (the
 staleness the manager reports) is testable without sleeping.
+
+Scores ride ALONGSIDE the rows (dmdrift, obs/drift.py): the drain path
+offers each scored batch together with its [n] fp32 scores, and the
+reservoir keeps row i's score in the same slot — ``snapshot(with_scores=
+True)`` returns both copies under ONE lock acquisition, so a drift
+evaluation never reads a reservoir mid-mutation or pairs a row with
+another row's score. Rows offered without scores carry NaN.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
 
 class TrafficSampler:
     """Bounded reservoir over dispatched token rows (thread-safe: the
-    engine thread offers, the rollout manager snapshots/drains)."""
+    engine thread offers, the rollout manager and drift monitor
+    snapshot/drain)."""
 
     def __init__(self, capacity: int, ratio: float, seed: int = 0,
                  clock: Callable[[], float] = time.monotonic) -> None:
@@ -40,18 +49,26 @@ class TrafficSampler:
         self._clock = clock
         self._lock = threading.Lock()
         self._rows: List[np.ndarray] = []
+        self._row_scores: List[float] = []   # parallel to _rows (NaN = none)
         self._seen = 0          # rows that passed the ratio filter
         self._offered = 0       # rows offered by the dispatch path
         self._last_offer: Optional[float] = None
 
-    def offer_rows(self, tokens: np.ndarray) -> int:
-        """Offer an [n, S] token batch from the dispatch path; returns how
-        many rows entered the reservoir. One RNG draw per offered batch for
-        the ratio filter plus one per accepted row once the reservoir is
-        full — cheap enough for the hot path's per-micro-batch cadence."""
+    def offer_rows(self, tokens: np.ndarray,
+                   scores: Optional[np.ndarray] = None) -> int:
+        """Offer an [n, S] token batch from the dispatch path (optionally
+        with its [n] scores); returns how many rows entered the reservoir.
+        One RNG draw per offered batch for the ratio filter plus one per
+        accepted row once the reservoir is full — cheap enough for the hot
+        path's per-micro-batch cadence. The RNG draw sequence is identical
+        with and without scores, so pairing scores in cannot perturb which
+        rows a seeded run samples."""
         n = len(tokens)
         if n == 0:
             return 0
+        if scores is not None and len(scores) != n:
+            raise ValueError(
+                f"scores must pair 1:1 with tokens ({len(scores)} != {n})")
         with self._lock:
             self._offered += n
             self._last_offer = self._clock()
@@ -60,8 +77,10 @@ class TrafficSampler:
             for i in picked:
                 self._seen += 1
                 row = np.array(tokens[i], dtype=np.int32, copy=True)
+                score = float(scores[i]) if scores is not None else float("nan")
                 if len(self._rows) < self.capacity:
                     self._rows.append(row)
+                    self._row_scores.append(score)
                     taken += 1
                 else:
                     # Algorithm R: row j of the filtered stream replaces a
@@ -69,6 +88,7 @@ class TrafficSampler:
                     slot = int(self._rng.integers(0, self._seen))
                     if slot < self.capacity:
                         self._rows[slot] = row
+                        self._row_scores[slot] = score
                         taken += 1
             return taken
 
@@ -76,12 +96,22 @@ class TrafficSampler:
         with self._lock:
             return len(self._rows)
 
-    def snapshot(self) -> np.ndarray:
-        """Copy of the reservoir as one [k, S] matrix (empty → [0, 0])."""
+    def snapshot(self, with_scores: bool = False
+                 ) -> Union[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
+        """Copy of the reservoir as one [k, S] matrix (empty → [0, 0]).
+        With ``with_scores``, returns ``(rows, scores)`` — the [k] fp32
+        score paired with each row (NaN where the offerer had none) —
+        both copied under ONE lock acquisition, so a concurrent
+        ``offer_rows`` can neither tear the matrix nor skew a row against
+        another row's score."""
         with self._lock:
             if not self._rows:
-                return np.zeros((0, 0), np.int32)
-            return np.stack(self._rows)
+                rows = np.zeros((0, 0), np.int32)
+                scores = np.zeros(0, np.float32)
+            else:
+                rows = np.stack(self._rows)
+                scores = np.array(self._row_scores, np.float32)
+        return (rows, scores) if with_scores else rows
 
     def last_offer_age(self) -> Optional[float]:
         with self._lock:
@@ -91,10 +121,12 @@ class TrafficSampler:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            scored = sum(1 for s in self._row_scores if s == s)  # non-NaN
             return {
                 "capacity": self.capacity,
                 "ratio": self.ratio,
                 "held_rows": len(self._rows),
+                "scored_rows": scored,
                 "rows_offered": self._offered,
                 "rows_sampled": self._seen,
                 "last_offer_age_s": (

@@ -125,6 +125,17 @@ class CheckpointStore:
             entry["meta"].update(meta_updates)
             self._write(doc)
 
+    def update_meta(self, version: int, **meta_updates: Any) -> None:
+        """Merge metadata into a version's manifest entry without touching
+        its status — the dmdrift baseline-pinning path (``drift_baseline``
+        rides the live entry so a restarted monitor resumes against the
+        same reference distribution)."""
+        with self._lock:
+            doc = self._load()
+            entry = self._entry_locked(doc, version)
+            entry["meta"].update(meta_updates)
+            self._write(doc)
+
     def set_live(self, version: int, **meta_updates: Any) -> None:
         """Mark ``version`` live (the dispatch path's params); the previous
         live entry becomes ``superseded`` — the natural rollback target."""

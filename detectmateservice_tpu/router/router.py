@@ -83,6 +83,13 @@ class ReplicaRouter:
         self._requeue: deque = deque()       # (lines, wire) awaiting redelivery
         self._requeue_total = 0
         self._m_requeue = m.ROUTER_REQUEUE().labels(**self._labels)
+        # dmdrift fleet aggregates: the supervisor probe reads each
+        # replica's replica_capacity_lines_per_s off its exposition; the
+        # router republishes the fleet sum (and dispatch-rate ÷ capacity)
+        # under its own labels — the predictive scale-out signal
+        self._m_capacity = m.REPLICA_CAPACITY().labels(**self._labels)
+        self._m_headroom = m.CAPACITY_HEADROOM().labels(**self._labels)
+        self._cap_rate_anchor: Optional[tuple] = None  # (t, total_sent_lines)
 
         admin_urls = list(settings.router_admin_urls or [])
         self.replicas: List[Replica] = []
@@ -300,6 +307,9 @@ class ReplicaRouter:
         with self._lock:
             if result.backlog is not None:
                 replica.backlog = float(result.backlog)
+            if result.capacity is not None:
+                replica.capacity = float(result.capacity)
+                self._update_capacity_aggregate_locked()
             if result.component_id:
                 replica.component_id = result.component_id
             if result.started_unix is not None:
@@ -463,10 +473,29 @@ class ReplicaRouter:
         with self._lock:
             return replica.snapshot()
 
+    def _update_capacity_aggregate_locked(self) -> None:
+        """Republish fleet capacity + headroom from the per-replica probe
+        readings: fleet capacity is the sum over replicas that reported
+        one, offered rate is the router's own dispatch rate differenced
+        between aggregate updates (probe cadence — no hot-path cost)."""
+        caps = [r.capacity for r in self.replicas if r.capacity]
+        if not caps:
+            return
+        fleet = float(sum(caps))
+        now = time.monotonic()
+        total_sent = float(sum(r.sent_lines for r in self.replicas))
+        anchor = self._cap_rate_anchor
+        self._cap_rate_anchor = (now, total_sent)
+        self._m_capacity.set(fleet)
+        if anchor is not None and now > anchor[0] and fleet > 0:
+            offered = max(0.0, total_sent - anchor[1]) / (now - anchor[0])
+            self._m_headroom.set(offered / fleet)
+
     # dmlint: thread(any) — reads under the lock only
     def snapshot(self) -> dict:
         with self._lock:
             replicas = [r.snapshot() for r in self.replicas]
+            caps = [r.capacity for r in self.replicas if r.capacity]
             return {
                 "policy": self._policy.name,
                 "credit_window": self._credit,
@@ -478,6 +507,8 @@ class ReplicaRouter:
                 "dispatchable": sum(
                     1 for r in replicas
                     if r["state"] == STATE_NAMES[STATE_ACTIVE]),
+                "fleet_capacity_lines_per_s": (
+                    round(float(sum(caps)), 3) if caps else None),
             }
 
     def _find(self, addr: str) -> Replica:

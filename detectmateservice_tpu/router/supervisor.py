@@ -103,6 +103,7 @@ class ProbeResult:
     read_lines: Optional[float] = None  # replica's cumulative data_read_lines_total
     component_id: Optional[str] = None
     started_unix: Optional[float] = None  # replica process start time (restart signal)
+    capacity: Optional[float] = None  # replica's replica_capacity_lines_per_s
 
 
 class Replica:
@@ -122,6 +123,7 @@ class Replica:
         self.state = STATE_ACTIVE
         self.state_detail = "never probed"
         self.backlog = 0.0
+        self.capacity: Optional[float] = None  # dmdrift calibrated lines/s
         # unacked credit window: (lines, wire) FIFO; maxlen is enforced by
         # the dispatchable() credit check, not the deque, so a full window
         # backpressures instead of silently evicting unacked frames
@@ -217,6 +219,7 @@ class Replica:
             "state_value": self.state,
             "detail": self.state_detail,
             "backlog": self.backlog,
+            "capacity_lines_per_s": self.capacity,
             "inflight": len(self.window),
             "frames_total": self.frames_total,
             "requeued_total": self.requeued_total,
@@ -229,10 +232,12 @@ class Replica:
 
 # -- the default HTTP probe --------------------------------------------------
 
-# one compiled matcher per poll loop, not per line: value rows of the two
-# series the probe reads off the replica's exposition
+# one compiled matcher per poll loop, not per line: value rows of the
+# series the probe reads off the replica's exposition (ack watermark,
+# ingress backlog, and the dmdrift capacity model's calibrated rate)
 _SERIES_ROW_RE = re.compile(
-    r'^(data_read_lines_total|engine_ingress_backlog)\{([^}]*)\}\s+([0-9.eE+-]+)',
+    r'^(data_read_lines_total|engine_ingress_backlog|'
+    r'replica_capacity_lines_per_s)\{([^}]*)\}\s+([0-9.eE+-]+)',
     re.M)
 _CID_RE = re.compile(r'component_id="([^"]*)"')
 
@@ -269,36 +274,40 @@ class HttpProbe:
         detail = ", ".join(failing) if failing else "all checks passing"
         cid = report.get("component_id") or replica.component_id
         started = report.get("started_unix")
-        backlog, read_lines = self._watermark(replica, cid)
+        backlog, read_lines, capacity = self._watermark(replica, cid)
         return ProbeResult(status, detail, backlog=backlog,
                            read_lines=read_lines, component_id=cid,
                            started_unix=(float(started)
-                                         if started is not None else None))
+                                         if started is not None else None),
+                           capacity=capacity)
 
     def _get_json(self, url: str) -> Any:
         with urllib.request.urlopen(url, timeout=self._timeout) as resp:
             return json.loads(resp.read())
 
     def _watermark(self, replica: Replica, cid: Optional[str]
-                   ) -> Tuple[Optional[float], Optional[float]]:
+                   ) -> Tuple[Optional[float], Optional[float],
+                              Optional[float]]:
         if not cid:
-            return None, None
+            return None, None, None
         try:
             with urllib.request.urlopen(replica.admin_url + "/metrics",
                                         timeout=self._timeout) as resp:
                 text = resp.read().decode("utf-8", errors="replace")
         except (urllib.error.URLError, OSError, TimeoutError):
-            return None, None
-        backlog = read_lines = None
+            return None, None, None
+        backlog = read_lines = capacity = None
         for name, labels, value in _SERIES_ROW_RE.findall(text):
             cid_match = _CID_RE.search(labels)
             if cid_match is None or cid_match.group(1) != cid:
                 continue
             if name == "engine_ingress_backlog":
                 backlog = float(value)
+            elif name == "replica_capacity_lines_per_s":
+                capacity = float(value)
             else:
                 read_lines = (read_lines or 0.0) + float(value)
-        return backlog, read_lines
+        return backlog, read_lines, capacity
 
 
 class ReplicaSupervisor(threading.Thread):

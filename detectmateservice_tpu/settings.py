@@ -331,6 +331,59 @@ class ServiceSettings(BaseModel):
     # keep-N checkpoint rotation (live/pinned/newest never pruned)
     rollout_keep_checkpoints: int = Field(default=4, ge=1, le=64)
 
+    # -- drift & capacity observability: dmdrift (obs/) -------------------
+    # When true, a background DriftMonitor (obs/drift.py) compares the live
+    # score distribution (the dmroll TrafficSampler reservoir, which also
+    # carries per-row scores) against a baseline pinned at promote time and
+    # persisted in the CheckpointStore manifest: rolling two-sample KS and
+    # PSI over scores plus per-feature PSI on the token rows, exported as
+    # model_drift_score{stat} / model_drift_features_over_threshold, with
+    # hysteresis-gated drift_detected/drift_cleared events and a
+    # GET /admin/drift snapshot (docs/drift.md). Requires rollout_enabled —
+    # the detector's reservoir and versioned store are the substrate.
+    drift_enabled: bool = False
+    # evaluation cadence of the drift monitor thread
+    drift_interval_s: float = Field(default=30.0, ge=0.05)
+    # rows kept in the pinned baseline (score sample + per-feature
+    # histogram edges); bounded so the manifest entry stays small
+    drift_baseline_size: int = Field(default=512, ge=16, le=65536)
+    # an evaluation is skipped (stats hold their last value) until at least
+    # this many scored rows are in the live window
+    drift_min_rows: int = Field(default=64, ge=8)
+    # detection thresholds: KS statistic on scores, PSI on scores, and the
+    # per-feature PSI above which a token column counts as drifting
+    drift_ks_threshold: float = Field(default=0.25, ge=0.0, le=1.0)
+    drift_psi_threshold: float = Field(default=0.2, ge=0.0)
+    drift_feature_psi_threshold: float = Field(default=0.25, ge=0.0)
+    # hysteresis: drift_detected only after this many CONSECUTIVE
+    # over-threshold evaluations; drift_cleared only after this many
+    # consecutive clean ones — no event flapping at the threshold
+    drift_trigger_intervals: int = Field(default=3, ge=1, le=1000)
+    drift_clear_intervals: int = Field(default=2, ge=1, le=1000)
+    # sustained drift kicks RolloutManager.run_cycle(reason="drift") early,
+    # but never more often than this cooldown (0 disables the auto-cycle —
+    # drift then only pages, it does not retrain)
+    drift_min_cycle_interval_s: float = Field(default=900.0, ge=0.0)
+    # When true, a CapacityMonitor (obs/capacity.py) maintains the modeled
+    # per-replica scoring capacity: pure arithmetic from the dispatch tap
+    # (rows ÷ device-seconds) while traffic is live, a bounded synthetic
+    # micro-probe through rollout_scores during idle windows — exported as
+    # replica_capacity_lines_per_s + capacity_headroom_ratio (offered rate
+    # ÷ modeled capacity), the predictive scale-out signal the router
+    # aggregates (ops/k8s-replicas.yaml).
+    capacity_enabled: bool = False
+    # capacity model refresh cadence
+    capacity_interval_s: float = Field(default=15.0, ge=0.05)
+    # rows per idle micro-probe burst (rides the warm train-bucket compile
+    # shape; bounded so a probe can never starve live traffic)
+    capacity_probe_rows: int = Field(default=256, ge=1, le=65536)
+    # only probe after the dispatch path has been idle this long (0 = never
+    # probe; live-traffic arithmetic is then the only capacity source)
+    capacity_probe_idle_s: float = Field(default=30.0, ge=0.0)
+    # sliding window over which offered rate and busy-time capacity are
+    # averaged
+    capacity_window_s: float = Field(default=60.0, ge=1.0)
+
     # -- durable ingress: dmwal (wal/, PR 11) -----------------------------
     # When true, the engine appends every ingress frame to a WAL-backed
     # spool (wal/spool.py) BEFORE processing it, acks the sequence once the
@@ -515,6 +568,16 @@ class ServiceSettings(BaseModel):
             raise ValueError(
                 "rollout_enabled requires rollout_dir (the versioned "
                 "checkpoint store root)")
+        return self
+
+    # -- drift cross-validation -------------------------------------------
+    @model_validator(mode="after")
+    def _check_drift(self) -> "ServiceSettings":
+        if self.drift_enabled and not self.rollout_enabled:
+            raise ValueError(
+                "drift_enabled requires rollout_enabled: the drift monitor "
+                "reads the dmroll traffic reservoir and pins its baseline "
+                "in the rollout checkpoint store")
         return self
 
     # -- durable-ingress cross-validation ---------------------------------

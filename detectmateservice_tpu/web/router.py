@@ -144,6 +144,26 @@ def _model(service, query, payload) -> Response:
     return Response(200, rollout.status())
 
 
+def _drift(service, query, payload) -> Response:
+    drift = getattr(service, "drift", None)
+    if drift is None:
+        return Response(404, {"detail": "drift monitoring is not enabled "
+                                        "on this stage (drift_enabled)"})
+    return Response(200, drift.status())
+
+
+def _slo(service, query, payload) -> Response:
+    tracker = getattr(service, "slo", None)
+    if tracker is None:
+        return Response(404, {"detail": "service has no SLO tracker"})
+    body = tracker.snapshot()
+    capacity = getattr(service, "capacity", None)
+    # the capacity model rides along: burn says how fast the budget goes,
+    # headroom says whether more traffic would make it worse
+    body["capacity"] = capacity.status() if capacity is not None else None
+    return Response(200, body)
+
+
 def _load_status(service, query, payload) -> Response:
     from ..loadgen.generator import LOADGEN
 
@@ -459,6 +479,12 @@ ROUTES: Tuple[Route, ...] = (
           "fault-injection status: armed plan, op counters, fired log"),
     Route("GET", "/admin/dlq", _dlq_status,
           "dead-letter queue: quarantined poison frames + totals"),
+    Route("GET", "/admin/drift", _drift,
+          "drift monitor snapshot: live-vs-baseline stats, hysteresis "
+          "state, top drifting features"),
+    Route("GET", "/admin/slo", _slo,
+          "multi-window SLO burn rates, per-stage dwell attribution, and "
+          "the capacity model"),
     Route("GET", "/admin/tenants", _tenants,
           "admission control: per-tier/per-tenant admitted+shed counters "
           "and the current degradation-ladder state"),

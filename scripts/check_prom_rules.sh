@@ -16,8 +16,10 @@ if ! command -v promtool >/dev/null 2>&1; then
 fi
 
 promtool check rules "$REPO/ops/alerts.yml"
-# prometheus.yml resolves rule_files relative to itself (alerts.yml sits
-# alongside), so check it from its own directory
+promtool check rules "$REPO/ops/recording_rules.yml"
+# prometheus.yml resolves rule_files relative to itself (alerts.yml and
+# recording_rules.yml sit alongside), so check it from its own directory
 cd "$REPO/ops"
 promtool check config prometheus.yml
-echo "check_prom_rules: ops/alerts.yml + ops/prometheus.yml OK"
+echo "check_prom_rules: ops/alerts.yml + ops/recording_rules.yml +" \
+     "ops/prometheus.yml OK"

@@ -95,6 +95,20 @@ SCENARIOS = {
                    "firing, and the fired fault log equal to the plan's "
                    "precomputed schedule (the determinism artifact: the "
                    "committed seed replays the run)"),
+    "drift": (("ModelDriftSustained",),
+              "the live traffic mix shifts hard mid-stream (a second "
+              "generator streams 100% anomalous comms alongside the "
+              "baseline mix); the dmdrift monitor watches the live score "
+              "distribution walk away from the baseline pinned over the "
+              "pre-shift window, emits drift_detected, and kicks the "
+              "dmroll cycle early — fine-tune on the drifted sample → "
+              "shadow → promote → baseline re-pin → drift_cleared; "
+              "gates: zero unique-frame loss across the swap, "
+              "ModelDriftSustained actually firing (off the recorded "
+              "burn-rate evaluator), drift_cleared landing after the "
+              "promotion re-pin, and the calibrated "
+              "replica_capacity_lines_per_s within 25% of a closed-loop "
+              "probe on the same host"),
     "ingress_crash": (("SpoolAgeHigh",),
                       "the parser (durable_ingress on) wedges mid-burst "
                       "with frames banked unacked in its WAL spool, then "
@@ -114,7 +128,7 @@ AUDIT_TEMPLATE = ("arch=<*> syscall=<*> success=<*> exit=<*> pid=<*> "
 
 
 def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None,
-                   tenants_file=None):
+                   tenants_file=None, drift=False):
     """The three service settings + component configs of the soak pipeline.
     Frame sizes are kept uniform (engine_frame_batch == loadgen burst) so
     wire frames map ~1:1 through every stage and the FIFO trace attachment
@@ -152,12 +166,31 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None,
         # and a huge interval — the harness drives cycles explicitly
         rollout = dict(
             rollout_enabled=True, rollout_dir=str(rollout_dir),
-            rollout_interval_s=3600.0, rollout_sample_ratio=1.0,
+            rollout_interval_s=3600.0,
+            # drift scenario thins the reservoir tap: Algorithm R replaces
+            # slots with probability capacity/seen, so a lower ratio keeps
+            # `seen` small enough that a mid-stream mix shift turns the
+            # reservoir over within a CI-sized fault window
+            rollout_sample_ratio=0.05 if drift else 1.0,
             rollout_sample_capacity=256, rollout_min_fit_rows=64,
             rollout_train_epochs=1, rollout_min_shadow_samples=128,
             rollout_shadow_timeout_s=60.0, rollout_max_mean_delta=3.0,
             rollout_max_flip_ratio=0.05, rollout_auto_promote=True,
             rollout_keep_checkpoints=4)
+        if drift:
+            # dmdrift, CI-sized: a fast evaluation tick, hysteresis deep
+            # enough that ModelDriftSustained's (scaled) hold elapses while
+            # the gauges are pinned high, a cooldown long enough for
+            # exactly one kicked cycle per run, and a capacity model that
+            # falls back to the idle micro-probe seconds after load stops
+            rollout.update(
+                drift_enabled=True, drift_interval_s=2.0,
+                drift_baseline_size=256, drift_min_rows=64,
+                drift_trigger_intervals=5, drift_clear_intervals=2,
+                drift_min_cycle_interval_s=300.0,
+                capacity_enabled=True, capacity_interval_s=2.0,
+                capacity_probe_rows=256, capacity_probe_idle_s=5.0,
+                capacity_window_s=30.0)
     detector = ServiceSettings(
         component_type="detectors.jax_scorer.JaxScorerDetector",
         component_id="soak-detector", trace_stage="detector",
@@ -183,7 +216,11 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None,
     detector_cfg = {"detectors": {"JaxScorerDetector": {
         "method_type": "jax_scorer", "auto_config": False, "model": "mlp",
         "data_use_training": 64, "train_epochs": 1, "min_train_steps": 8,
-        "seq_len": 8, "dim": 16, "max_batch": 2 * burst,
+        # the drift scenario needs the VARIABLES in the token row: the
+        # 8-word audit template alone fills seq_len=8, and a reservoir of
+        # identical rows can never show a content shift (KS would pin at
+        # exactly 0 no matter how anomalous the traffic mix turns)
+        "seq_len": 24 if drift else 8, "dim": 16, "max_batch": 2 * burst,
         # pipeline_depth 0 = drain every dispatch before returning: outputs
         # leave in the same engine iteration as their ingest, which is what
         # keeps the FIFO trace attachment exact (a deferred output would
@@ -203,14 +240,15 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None,
 
 
 def boot_pipeline(tmp: Path, factory, burst: int, rollout_dir=None,
-                  wal_dir=None, tenants_file=None):
+                  wal_dir=None, tenants_file=None, drift=False):
     from detectmateservice_tpu.core import Service
 
     services = []
     for settings, config in build_settings(tmp, burst,
                                            rollout_dir=rollout_dir,
                                            wal_dir=wal_dir,
-                                           tenants_file=tenants_file):
+                                           tenants_file=tenants_file,
+                                           drift=drift):
         service = Service(settings, component_config=config,
                           socket_factory=factory)
         service.setup_io()
@@ -441,11 +479,15 @@ def main() -> int:
     fault_defaults = {"none": 0.0, "stall": 45.0, "slow_sink": 45.0,
                       "recompile": 8.0, "replica_kill": 40.0,
                       "rollout": 45.0, "ingress_crash": 45.0,
-                      "noisy_neighbor": 45.0, "chaos_mesh": 45.0}
+                      "noisy_neighbor": 45.0, "chaos_mesh": 45.0,
+                      # drift must outlive reservoir turnover + hysteresis
+                      # + the kicked cycle + the post-promote clear window
+                      "drift": 75.0}
     scale_defaults = {"none": 6.0, "stall": 6.0, "slow_sink": 12.0,
                       "recompile": 6.0, "replica_kill": 12.0,
                       "rollout": 12.0, "ingress_crash": 12.0,
-                      "noisy_neighbor": 12.0, "chaos_mesh": 12.0}
+                      "noisy_neighbor": 12.0, "chaos_mesh": 12.0,
+                      "drift": 30.0}
     fault_s = (args.fault_seconds if args.fault_seconds is not None
                else fault_defaults[args.scenario])
     time_scale = (args.time_scale if args.time_scale is not None
@@ -458,6 +500,7 @@ def main() -> int:
     from detectmateservice_tpu.loadgen.alerteval import (
         RuleEvaluator,
         SampleStore,
+        load_recording_rules,
         load_rules,
     )
     from detectmateservice_tpu.loadgen.corpus import (
@@ -491,13 +534,14 @@ def main() -> int:
 
     def new_generator(factory, seconds: float, settle: float,
                       rate=None, tenant=None, listen=True,
-                      component_id="soak-loadgen"):
+                      component_id="soak-loadgen", mix_override=None):
         profile = LoadProfile(
             target_addr="inproc://soak-parser",
             listen_addr="inproc://soak-collector" if listen else None,
             rate=rate if rate is not None else victim_rate,
             burst=args.burst, seconds=seconds,
-            mix=mix, settle_s=settle,
+            mix=mix_override if mix_override is not None else mix,
+            settle_s=settle,
             tenant=tenant if tenant is not None
             else ("victim" if noisy else None))
         return LoadGenerator(profile, labels=dict(
@@ -513,8 +557,13 @@ def main() -> int:
     factory = InprocQueueSocketFactory(maxsize=65536)
     InprocQueueSocketFactory(maxsize=64)._pair("inproc://soak-collector")
     store = SampleStore()
-    evaluator = RuleEvaluator(load_rules(REPO / "ops" / "alerts.yml"),
-                              time_scale=time_scale)
+    # recording rules evaluate each tick BEFORE the alert rules, so alerts
+    # referencing recorded names (PipelineSloBurnRecorded) read this-tick
+    # values — the same order Prometheus guarantees within a group interval
+    evaluator = RuleEvaluator(
+        load_rules(REPO / "ops" / "alerts.yml"),
+        time_scale=time_scale,
+        recording=load_recording_rules(REPO / "ops" / "recording_rules.yml"))
     t_start_utc = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     t0 = time.monotonic()
 
@@ -536,6 +585,10 @@ def main() -> int:
         elif args.scenario == "rollout":
             services = boot_pipeline(Path(tmp), factory, args.burst,
                                      rollout_dir=Path(tmp) / "rollout")
+        elif args.scenario == "drift":
+            services = boot_pipeline(Path(tmp), factory, args.burst,
+                                     rollout_dir=Path(tmp) / "rollout",
+                                     drift=True)
         elif args.scenario in ("ingress_crash", "chaos_mesh"):
             services = boot_pipeline(Path(tmp), factory, args.burst,
                                      wal_dir=Path(tmp) / "wal")
@@ -795,6 +848,41 @@ def main() -> int:
                         min_samples=10**9,
                         timeout_s=max(5.0, fault_s - 10.0))
                     time.sleep(fault_s)
+                elif args.scenario == "drift":
+                    # the "fault" is traffic: a second generator streams
+                    # 100% anomalous comms alongside the baseline mix the
+                    # outer generator keeps offering (its scorecard stays
+                    # the exact zero-loss ledger). The dmdrift monitor is
+                    # on its own: it must notice the live score
+                    # distribution walking away from the pinned baseline,
+                    # emit drift_detected, kick the dmroll cycle early,
+                    # and come back clean after the promotion re-pins —
+                    # the harness only watches.
+                    det_service = services[1]
+                    shift_mix = PayloadMix.from_dict({
+                        "anomaly": 1.0, "json": 0.0, "invalid_utf8": 0.0})
+                    shifter = new_generator(
+                        factory, fault_s, settle=2.0,
+                        rate=args.rate, listen=False,
+                        component_id="soak-loadgen-shift",
+                        mix_override=shift_mix)
+                    shifter.start()
+                    cleared_at = None
+                    while time.monotonic() - fault_t0 < fault_s:
+                        st = det_service.drift.status()
+                        if (cleared_at is None and st["ticks"] > 0
+                                and not st["drifting"]
+                                and any(e.get("kind") == "drift_cleared"
+                                        for e in st["events"])):
+                            cleared_at = time.monotonic() - fault_t0
+                            print(f"[soak] drift detected, retrained, and "
+                                  f"cleared {cleared_at:.0f}s into the "
+                                  "shift; holding load to the window end")
+                        time.sleep(1.0)
+                    shifter.wait(timeout=fault_s + 60.0)
+                    record["shift_traffic"] = shifter.stop()["scorecard"]
+                    record["drift_cleared_after_s"] = (
+                        None if cleared_at is None else round(cleared_at, 1))
                 fault_held_s = time.monotonic() - fault_t0
                 generator.wait(timeout=lead_s + fault_s + tail_s
                                + fault_s + 60.0 + 60.0)
@@ -1065,6 +1153,113 @@ def main() -> int:
                           unexpected == 0,
                           f"scorer_xla_recompiles_unexpected_total="
                           f"{unexpected}")
+                if args.scenario == "drift":
+                    # the dmdrift contract, gated by execution: the monitor
+                    # (not the harness) noticed the shift, retrained
+                    # through the kicked cycle, came back clean after the
+                    # promotion re-pinned the baseline, nothing was lost
+                    # across the hot-swap, and the capacity model the
+                    # router would scale on agrees with a closed-loop
+                    # probe run right now on the same host
+                    det_service = services[1]
+                    det = det_service.library_component
+                    dstatus = det_service.drift.status()
+                    rstatus = det_service.rollout.status()
+                    record["drift_status"] = dstatus
+                    record["rollout_status"] = rstatus
+                    check("drift_loss_zero_across_swap",
+                          chaos["scorecard"]["loss"] == 0,
+                          f"loss={chaos['scorecard']['loss']} of "
+                          f"{chaos['scorecard']['sent_frames']} baseline-"
+                          "mix frames (unique trace ids)")
+                    kinds = [e.get("kind") for e in
+                             det_service.events.snapshot()["events"]]
+                    check("drift_baseline_pinned_event",
+                          "drift_baseline_pinned" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("drift_detected_event",
+                          "drift_detected" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("drift_cycle_event",
+                          "drift_cycle" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("drift_cleared_event",
+                          "drift_cleared" in kinds,
+                          f"event kinds seen: {sorted(set(kinds))}")
+                    check("drift_kicked_cycle_promoted",
+                          (rstatus["live_version"] is not None
+                           and det.model_version()
+                           == rstatus["live_version"]),
+                          f"detector serves v{det.model_version()}, store "
+                          f"live v{rstatus['live_version']} (fine-tuned on "
+                          "the drifted sample via the kicked cycle)")
+                    # the flag must have CLEARED after the promotion
+                    # re-pinned the baseline from the shifted traffic.
+                    # (By check time the load has reverted to the
+                    # baseline mix, which correctly re-registers as
+                    # drift against the v1 baseline — end-state
+                    # `drifting` is the detector working, not a bug.)
+                    check("drift_cleared_after_promotion",
+                          (record.get("drift_cleared_after_s") is not None
+                           and (dstatus["baseline"] or {}).get("version")
+                           == rstatus["live_version"]),
+                          f"cleared_after_s="
+                          f"{record.get('drift_cleared_after_s')} with "
+                          f"baseline "
+                          f"v{(dstatus['baseline'] or {}).get('version')} "
+                          f"re-pinned at the v{rstatus['live_version']} "
+                          f"promotion ({dstatus['ticks']} evaluations)")
+                    # traffic-arithmetic evidence first: the model was fed
+                    # by the live dispatch tap throughout the load phases
+                    cstatus = det_service.capacity.status()
+                    record["capacity_status_under_load"] = cstatus
+                    modeled = cstatus["capacity_lines_per_s"]
+                    check("capacity_model_populated",
+                          modeled is not None and modeled > 0,
+                          f"replica_capacity_lines_per_s={modeled} "
+                          f"(source={cstatus['capacity_source']})")
+                    # then the calibration gate: traffic arithmetic under
+                    # a shared-GIL drain reads the CONTENDED device rate,
+                    # so let the pipeline finish its backlog and the
+                    # monitor refresh off the idle micro-probe before
+                    # comparing against a fresh closed-loop bench — both
+                    # sides then measure the same uncontended host
+                    flip_deadline = time.monotonic() + 150.0
+                    while time.monotonic() < flip_deadline:
+                        cstatus = det_service.capacity.status()
+                        if cstatus["capacity_source"] == "probe":
+                            break
+                        time.sleep(1.0)
+                    record["capacity_status"] = cstatus
+                    modeled = cstatus["capacity_lines_per_s"]
+                    bench = det_service.capacity.probe_now()
+                    record["capacity_bench_lines_per_s"] = bench
+                    ratio = (modeled / bench
+                             if modeled and bench else None)
+                    check("capacity_within_25pct_of_bench",
+                          ratio is not None and 0.75 <= ratio <= 1.25,
+                          f"modeled {modeled} "
+                          f"(source={cstatus['capacity_source']}) vs "
+                          f"closed-loop bench {bench} lines/s "
+                          f"(ratio={ratio})")
+                    from prometheus_client import generate_latest
+                    scrape = generate_latest().decode()
+                    series_present = [
+                        s for s in ("model_drift_score",
+                                    "model_drift_features_over_threshold",
+                                    "replica_capacity_lines_per_s",
+                                    "capacity_headroom_ratio")
+                        if any(line.startswith(s)
+                               for line in scrape.splitlines())]
+                    check("drift_capacity_series_scraped",
+                          len(series_present) == 4,
+                          f"series on /metrics: {series_present}")
+                    ledger_doc = device_obs.get_ledger().snapshot()
+                    unexpected = ledger_doc["totals"]["unexpected"]
+                    check("no_unexpected_recompiles_across_swap",
+                          unexpected == 0,
+                          f"scorer_xla_recompiles_unexpected_total="
+                          f"{unexpected}")
         finally:
             if generator is not None:
                 try:
@@ -1075,6 +1270,7 @@ def main() -> int:
             teardown_pipeline(services)
 
     record["alerts"] = evaluator.report()
+    record["recording_rules"] = evaluator.recording_report()
     record["elapsed_s"] = round(time.monotonic() - t0, 1)
     record["checks"] = checks
     record["pass"] = all(c["ok"] for c in checks)

@@ -1,0 +1,509 @@
+"""dmdrift — streaming drift detection + calibrated capacity (obs/, PR 18).
+
+Covers the observability-layer contract end to end:
+
+* the statistics: identical distributions score ~0 on both KS and PSI,
+  progressively shifted/scaled ones score monotonically higher, and both
+  stats stay finite on degenerate inputs;
+* baseline lifecycle: fit → JSON → CheckpointStore manifest
+  (``update_meta``) → ``from_dict`` round-trips to the same reference
+  distribution, a restarted monitor RESUMES the persisted baseline
+  instead of re-pinning on whatever (possibly drifted) traffic it boots
+  into, and a live-version change re-pins from current traffic — which
+  is what drives ``drift_cleared`` after a promotion;
+* the hysteresis gate: a single noisy window flaps neither way, detection
+  and clearing each require their full consecutive streak, and the
+  events fire exactly once per transition;
+* the early-cycle kick: sustained drift calls
+  ``RolloutManager.run_cycle(reason="drift")`` bounded by the cooldown,
+  and a deferred (skipped) cycle does NOT consume the cooldown;
+* the dmdrift sampler extension: ``snapshot(with_scores=True)`` can never
+  tear rows against scores under concurrent ``offer_rows`` mutation
+  (satellite regression for the one-lock snapshot);
+* the capacity model: traffic arithmetic, the idle micro-probe fallback,
+  and last-known-hold when neither source is available; plus the
+  SloTracker burn-rate / dwell-attribution math on scripted counters.
+
+Everything runs with injected clocks and direct ``tick()`` calls — no
+sleeps, no monitor threads, no flake.
+"""
+import json
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from detectmateservice_tpu.obs import (
+    CapacityMonitor,
+    DriftBaseline,
+    DriftMonitor,
+    SloTracker,
+    ks_statistic,
+    psi,
+)
+from detectmateservice_tpu.rollout import CheckpointStore, TrafficSampler
+from detectmateservice_tpu.settings import ServiceSettings
+
+LABELS = {"component_type": "detectors.jax_scorer.JaxScorerDetector",
+          "component_id": "drift-test"}
+
+
+def drift_settings(**over):
+    base = dict(
+        drift_interval_s=30.0, drift_baseline_size=256, drift_min_rows=16,
+        drift_ks_threshold=0.25, drift_psi_threshold=0.2,
+        drift_feature_psi_threshold=0.25, drift_trigger_intervals=3,
+        drift_clear_intervals=2, drift_min_cycle_interval_s=900.0)
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+def capacity_settings(**over):
+    base = dict(capacity_interval_s=15.0, capacity_probe_rows=64,
+                capacity_probe_idle_s=30.0, capacity_window_s=60.0)
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeSampler:
+    """Drift-monitor test double: the reservoir IS the test input."""
+
+    def __init__(self):
+        self.rows = np.zeros((0, 0), np.int32)
+        self.scores = np.zeros(0, np.float32)
+
+    def set(self, scores, rows=None):
+        self.scores = np.asarray(scores, np.float32)
+        self.rows = (np.asarray(rows, np.int32) if rows is not None
+                     else np.zeros((len(self.scores), 0), np.int32))
+
+    def snapshot(self, with_scores=False):
+        return (self.rows, self.scores) if with_scores else self.rows
+
+    def stats(self):
+        return {"held_rows": len(self.rows)}
+
+
+class FakeRollout:
+    def __init__(self, result=None):
+        self.result = result or {"version": 2, "reason": "drift"}
+        self.calls = []
+
+    def run_cycle(self, reason, block=False):
+        self.calls.append(reason)
+        return dict(self.result)
+
+
+def normal(n, loc=0.0, scale=1.0, seed=0):
+    return np.random.default_rng(seed).normal(loc, scale, n)
+
+
+# ---------------------------------------------------------------------------
+# statistics: ~0 on identical, monotone under shift/scale
+# ---------------------------------------------------------------------------
+class TestStatistics:
+    def test_identical_distribution_scores_near_zero(self):
+        base = DriftBaseline.fit(None, None, normal(4000, seed=1),
+                                 keep=512, pinned_unix=0.0)
+        live = normal(2000, seed=2)
+        assert ks_statistic(base.scores, live) < 0.08
+        assert psi(base.score_props, live, base.score_edges) < 0.05
+
+    def test_shifted_distributions_score_monotonically_higher(self):
+        base = DriftBaseline.fit(None, None, normal(4000, seed=1),
+                                 keep=512, pinned_unix=0.0)
+        ks_vals, psi_vals = [], []
+        for shift in (0.0, 0.5, 1.0, 2.0, 4.0):
+            live = normal(2000, loc=shift, seed=3)
+            ks_vals.append(ks_statistic(base.scores, live))
+            psi_vals.append(psi(base.score_props, live, base.score_edges))
+        assert ks_vals == sorted(ks_vals)
+        assert psi_vals == sorted(psi_vals)
+        assert ks_vals[-1] > 0.9 and psi_vals[-1] > 1.0
+
+    def test_scaled_distributions_score_monotonically_higher(self):
+        base = DriftBaseline.fit(None, None, normal(4000, seed=1),
+                                 keep=512, pinned_unix=0.0)
+        vals = [psi(base.score_props, normal(2000, scale=s, seed=4),
+                    base.score_edges) for s in (1.0, 2.0, 4.0, 8.0)]
+        assert vals == sorted(vals)
+        assert vals[-1] > 0.5
+
+    def test_degenerate_inputs_stay_finite(self):
+        assert ks_statistic(np.array([]), normal(10)) == 0.0
+        base = DriftBaseline.fit(None, None, np.full(100, 7.0),
+                                 keep=512, pinned_unix=0.0)
+        # constant baseline: PSI must not divide by zero or log(0)
+        value = psi(base.score_props, np.full(50, 7.0), base.score_edges)
+        assert np.isfinite(value)
+        assert DriftBaseline.fit(None, None, np.full(10, np.nan),
+                                 keep=512, pinned_unix=0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# baseline: manifest round-trip + restart resume
+# ---------------------------------------------------------------------------
+class TestBaselinePersistence:
+    def test_round_trip_through_checkpoint_store_manifest(self, tmp_path):
+        rng = np.random.default_rng(5)
+        rows = rng.integers(0, 50, size=(600, 6)).astype(np.int32)
+        scores = normal(600, seed=5)
+        original = DriftBaseline.fit(1, rows, scores, keep=256,
+                                     pinned_unix=123.456)
+        store = CheckpointStore(tmp_path / "s")
+        store.record(1, {"tag": "seed"})
+        store.set_live(1)
+        store.update_meta(1, drift_baseline=original.to_dict())
+
+        raw = store.entry(1)["meta"]["drift_baseline"]
+        restored = DriftBaseline.from_dict(json.loads(json.dumps(raw)))
+        live = normal(400, loc=1.5, seed=6)
+        assert ks_statistic(restored.scores, live) == pytest.approx(
+            ks_statistic(original.scores, live), abs=1e-6)
+        assert psi(restored.score_props, live, restored.score_edges) \
+            == pytest.approx(
+                psi(original.score_props, live, original.score_edges),
+                abs=1e-4)
+        assert len(restored.feature_edges) == rows.shape[1]
+        # update_meta merged alongside, not over, existing meta
+        assert store.entry(1)["meta"]["tag"] == "seed"
+        assert store.entry(1)["status"] == "live"
+
+    def test_schema_guard(self):
+        with pytest.raises(ValueError, match="schema"):
+            DriftBaseline.from_dict({"schema": "bogus", "scores": []})
+
+    def test_restarted_monitor_resumes_persisted_baseline(self, tmp_path):
+        store = CheckpointStore(tmp_path / "s")
+        store.record(1, {})
+        store.set_live(1)
+        sampler = FakeSampler()
+        sampler.set(normal(500, seed=7))
+        first = DriftMonitor(drift_settings(), sampler, store=store,
+                             labels=LABELS, clock=FakeClock(),
+                             wall_clock=lambda: 1000.0)
+        first.tick()
+        assert first.status()["baseline"]["persisted"] is True
+
+        # "restart" onto ALREADY-DRIFTED traffic: the resumed baseline must
+        # be the persisted reference, so the shift is visible immediately
+        drifted = FakeSampler()
+        drifted.set(normal(500, loc=3.0, seed=8))
+        second = DriftMonitor(drift_settings(drift_trigger_intervals=1),
+                              sampler=drifted, store=store, labels=LABELS,
+                              clock=FakeClock(), wall_clock=lambda: 2000.0)
+        second.tick()
+        snap = second.status()
+        assert snap["baseline"]["pinned_unix"] == pytest.approx(1000.0)
+        assert snap["stats"]["ks"] > 0.8
+        assert snap["drifting"] is True
+
+
+# ---------------------------------------------------------------------------
+# hysteresis + events + early cycle
+# ---------------------------------------------------------------------------
+class TestDriftMonitor:
+    def _monitor(self, **settings_over):
+        sampler = FakeSampler()
+        sampler.set(normal(500, seed=9))
+        rollout = FakeRollout()
+        clock = FakeClock()
+        monitor = DriftMonitor(drift_settings(**settings_over), sampler,
+                               rollout=rollout, labels=LABELS, clock=clock)
+        monitor.tick()                     # pins the in-memory baseline
+        assert monitor.status()["baseline"] is not None
+        return monitor, sampler, rollout, clock
+
+    def _kinds(self, monitor):
+        return [e["kind"] for e in monitor.status()["events"]]
+
+    def test_hysteresis_requires_full_streak_and_does_not_flap(self):
+        monitor, sampler, _, _ = self._monitor(drift_trigger_intervals=3,
+                                               drift_clear_intervals=2)
+        shifted = normal(500, loc=3.0, seed=10)
+        clean = normal(500, seed=11)
+
+        # alternating over/under windows must never latch: streaks reset
+        for _ in range(4):
+            sampler.set(shifted)
+            monitor.tick()
+            sampler.set(clean)
+            monitor.tick()
+        assert monitor.status()["drifting"] is False
+        assert "drift_detected" not in self._kinds(monitor)
+
+        # three CONSECUTIVE over-threshold windows latch, exactly one event
+        sampler.set(shifted)
+        monitor.tick()
+        monitor.tick()
+        assert monitor.status()["drifting"] is False
+        monitor.tick()
+        assert monitor.status()["drifting"] is True
+        monitor.tick()
+        assert self._kinds(monitor).count("drift_detected") == 1
+
+        # one clean window is not enough to clear; two are, one event
+        sampler.set(clean)
+        monitor.tick()
+        assert monitor.status()["drifting"] is True
+        monitor.tick()
+        assert monitor.status()["drifting"] is False
+        assert self._kinds(monitor).count("drift_cleared") == 1
+
+    def test_sustained_drift_kicks_cycle_bounded_by_cooldown(self):
+        monitor, sampler, rollout, clock = self._monitor(
+            drift_trigger_intervals=2, drift_min_cycle_interval_s=100.0)
+        sampler.set(normal(500, loc=3.0, seed=12))
+        monitor.tick()
+        monitor.tick()                     # latches drifting → first kick
+        assert rollout.calls == ["drift"]
+        assert "drift_cycle" in self._kinds(monitor)
+
+        # still drifting inside the cooldown: no second kick
+        clock.advance(50.0)
+        monitor.tick()
+        assert rollout.calls == ["drift"]
+
+        # cooldown elapsed and still drifting: kick again
+        clock.advance(51.0)
+        monitor.tick()
+        assert rollout.calls == ["drift", "drift"]
+
+    def test_deferred_cycle_does_not_consume_cooldown(self):
+        monitor, sampler, rollout, clock = self._monitor(
+            drift_trigger_intervals=1, drift_min_cycle_interval_s=1000.0)
+        rollout.result = {"skipped": "a candidate is already shadowing"}
+        sampler.set(normal(500, loc=3.0, seed=13))
+        monitor.tick()
+        clock.advance(1.0)
+        monitor.tick()
+        # the skipped cycle retried immediately — the cooldown only starts
+        # once a cycle actually runs
+        assert rollout.calls == ["drift", "drift"]
+        assert "drift_cycle" not in self._kinds(monitor)
+        rollout.result = {"version": 2, "reason": "drift"}
+        clock.advance(1.0)
+        monitor.tick()
+        assert rollout.calls == ["drift", "drift", "drift"]
+        clock.advance(1.0)
+        monitor.tick()                     # now inside the cooldown
+        assert len(rollout.calls) == 3
+
+    def test_version_change_repins_and_clears_after_promotion(self, tmp_path):
+        store = CheckpointStore(tmp_path / "s")
+        store.record(1, {})
+        store.set_live(1)
+        sampler = FakeSampler()
+        sampler.set(normal(500, seed=14))
+        monitor = DriftMonitor(
+            drift_settings(drift_trigger_intervals=2, drift_clear_intervals=2),
+            sampler, store=store, labels=LABELS, clock=FakeClock())
+        monitor.tick()
+        assert monitor.status()["baseline"]["version"] == 1
+
+        sampler.set(normal(500, loc=3.0, seed=15))
+        monitor.tick()
+        monitor.tick()
+        assert monitor.status()["drifting"] is True
+
+        # the promotion lands: the new model was fine-tuned on the drifted
+        # stream, so the monitor re-pins from CURRENT traffic and the very
+        # same reservoir now reads clean → drift_cleared follows
+        store.record(2, {})
+        store.set_live(2)
+        monitor.tick()
+        snap = monitor.status()
+        assert snap["baseline"]["version"] == 2
+        assert snap["baseline"]["persisted"] is True
+        monitor.tick()
+        assert monitor.status()["drifting"] is False
+        kinds = [e["kind"] for e in monitor.status()["events"]]
+        assert "drift_cleared" in kinds
+        # and the re-pin landed in the v2 manifest entry
+        assert "drift_baseline" in store.entry(2)["meta"]
+
+    def test_insufficient_rows_defers_evaluation(self):
+        sampler = FakeSampler()
+        sampler.set(normal(4, seed=16))
+        monitor = DriftMonitor(drift_settings(drift_min_rows=64), sampler,
+                               labels=LABELS, clock=FakeClock())
+        snap = monitor.tick()
+        assert snap["stats"]["ks"] is None
+        assert snap["drifting"] is False
+
+    def test_settings_cross_validation(self):
+        with pytest.raises(Exception, match="rollout_enabled"):
+            ServiceSettings(component_type="detectors.X",
+                            drift_enabled=True)
+
+
+# ---------------------------------------------------------------------------
+# sampler: the one-lock scored snapshot under concurrent mutation
+# ---------------------------------------------------------------------------
+class TestSamplerScoredSnapshot:
+    def test_scores_pair_with_rows(self):
+        sampler = TrafficSampler(capacity=32, ratio=1.0, seed=1)
+        tokens = np.arange(48, dtype=np.int32).reshape(48, 1)
+        sampler.offer_rows(tokens, scores=tokens[:, 0].astype(np.float32))
+        rows, scores = sampler.snapshot(with_scores=True)
+        assert rows.shape[0] == len(scores) == 32
+        np.testing.assert_array_equal(rows[:, 0].astype(np.float32), scores)
+        assert sampler.stats()["scored_rows"] == 32
+
+    def test_unscored_offers_carry_nan_and_identical_sampling(self):
+        a = TrafficSampler(capacity=16, ratio=0.5, seed=7)
+        b = TrafficSampler(capacity=16, ratio=0.5, seed=7)
+        tokens = np.arange(200, dtype=np.int32).reshape(200, 1)
+        a.offer_rows(tokens)
+        b.offer_rows(tokens, scores=tokens[:, 0].astype(np.float32))
+        rows_a = a.snapshot()
+        rows_b, scores_b = b.snapshot(with_scores=True)
+        # pairing scores in cannot perturb WHICH rows a seeded run samples
+        np.testing.assert_array_equal(rows_a, rows_b)
+        _, scores_a = a.snapshot(with_scores=True)
+        assert np.all(np.isnan(scores_a))
+        assert not np.any(np.isnan(scores_b))
+
+    def test_snapshot_never_tears_under_concurrent_offers(self):
+        sampler = TrafficSampler(capacity=128, ratio=1.0, seed=3)
+        stop = threading.Event()
+        failures = []
+
+        def writer(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                vals = rng.integers(0, 10_000, size=32).astype(np.int32)
+                sampler.offer_rows(vals.reshape(32, 1),
+                                   scores=vals.astype(np.float32))
+
+        threads = [threading.Thread(target=writer, args=(s,), daemon=True)
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(300):
+                rows, scores = sampler.snapshot(with_scores=True)
+                if rows.shape[0] != len(scores):
+                    failures.append("length skew")
+                    break
+                if rows.shape[0] and not np.array_equal(
+                        rows[:, 0].astype(np.float32), scores):
+                    failures.append("row/score pairing torn")
+                    break
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not failures
+
+
+# ---------------------------------------------------------------------------
+# capacity model + SLO burn math
+# ---------------------------------------------------------------------------
+class TestCapacityMonitor:
+    def test_traffic_arithmetic(self):
+        clock = FakeClock()
+        monitor = CapacityMonitor(
+            detector=SimpleNamespace(),  # no probe surface needed
+            settings=capacity_settings(capacity_window_s=60.0,
+                                       capacity_probe_idle_s=1e9),
+            labels=LABELS, clock=clock)
+        clock.advance(10.0)
+        monitor.on_batch(1000, 1.0)
+        monitor.on_batch(500, 0.5)
+        clock.advance(20.0)
+        doc = monitor.tick()
+        assert doc["capacity_lines_per_s"] == pytest.approx(1000.0)
+        assert doc["source"] == "traffic"
+        # offered over the 30 s the replica has existed, not the full window
+        assert doc["offered_lines_per_s"] == pytest.approx(1500 / 30.0)
+        assert doc["headroom_ratio"] == pytest.approx(0.05)
+
+    def test_idle_probe_fallback_and_hold(self):
+        clock = FakeClock()
+        calls = []
+
+        def rollout_scores(params, tokens):
+            calls.append(len(tokens))
+            return np.zeros(len(tokens), np.float32)
+
+        detector = SimpleNamespace(
+            rollout_ready=lambda: True,
+            rollout_scores=rollout_scores,
+            config=SimpleNamespace(vocab_size=50, seq_len=4))
+        monitor = CapacityMonitor(
+            detector,
+            settings=capacity_settings(capacity_probe_rows=64,
+                                       capacity_probe_idle_s=5.0),
+            labels=LABELS, clock=clock)
+        clock.advance(10.0)                # idle since start > 5 s
+        doc = monitor.tick()
+        assert doc["source"] == "probe"
+        assert doc["capacity_lines_per_s"] > 0
+        assert calls == [64]
+        assert monitor.status()["last_probe"]["rows"] == 64
+
+        # probe surface goes away (mid-fit): last-known capacity holds
+        detector.rollout_ready = lambda: False
+        clock.advance(10.0)
+        held = monitor.tick()
+        assert held["capacity_lines_per_s"] == doc["capacity_lines_per_s"]
+        assert monitor.status()["capacity_source"] == "probe"
+
+    def test_probe_requires_ready_scorer(self):
+        monitor = CapacityMonitor(
+            SimpleNamespace(rollout_ready=lambda: False),
+            settings=capacity_settings(), labels=LABELS)
+        assert monitor.probe_now() is None
+
+
+class TestSloTracker:
+    class Scripted(SloTracker):
+        def __init__(self, clock):
+            super().__init__(clock=clock)
+            self.doc = {"e2e_count": 0.0, "e2e_under": 0.0,
+                        "dwell": {}, "transit_s": 0.0, "process_s": 0.0,
+                        "queue_wait_s": 0.0, "device_s": 0.0}
+
+        def _collect(self):
+            return json.loads(json.dumps(self.doc))
+
+    def test_burn_rate_and_dwell_attribution(self):
+        clock = FakeClock()
+        tracker = self.Scripted(clock)
+        tracker.doc.update(e2e_count=100.0, e2e_under=100.0,
+                           dwell={"parser": 1.0, "detector": 3.0})
+        tracker.observe()
+
+        clock.advance(250.0)
+        tracker.doc.update(e2e_count=300.0, e2e_under=240.0,
+                           dwell={"parser": 2.0, "detector": 6.0})
+        snap = tracker.snapshot()
+        five = snap["burn"]["5m"]
+        # 200 new traces, 60 over the SLO → 30% error ratio, 30x burn
+        assert five["traces"] == 200
+        assert five["error_ratio"] == pytest.approx(0.3)
+        assert five["burn_rate"] == pytest.approx(30.0)
+        assert five["covered_s"] == pytest.approx(250.0)
+        assert snap["e2e"]["traces_over_slo"] == 60
+        assert snap["stages"]["dwell_share"]["detector"] \
+            == pytest.approx(0.75)
+        assert sum(snap["stages"]["dwell_share"].values()) \
+            == pytest.approx(1.0)
+
+    def test_empty_windows_report_none_not_zero_division(self):
+        snap = self.Scripted(FakeClock()).snapshot()
+        assert snap["burn"]["5m"]["error_ratio"] is None
+        assert snap["e2e"]["cumulative_error_ratio"] is None

@@ -425,7 +425,22 @@ class TestAlertEval:
         assert len(rules) >= 10
         names = {rule.name for rule in rules}
         assert {"EngineLoopStalled", "BatchOccupancyLow",
-                "PipelineLatencyBudgetBurnFast"} <= names
+                "PipelineLatencyBudgetBurnFast", "ModelDriftSustained",
+                "CapacityHeadroomLow", "PipelineSloBurnRecorded"} <= names
+
+    def test_every_recording_rules_yml_expression_parses(self):
+        """Same contract for ops/recording_rules.yml (dmdrift): every
+        ``record:`` rule must stay inside the evaluator's PromQL subset so
+        the drift soak can pre-compute the recorded series the
+        PipelineSloBurnRecorded alert reads."""
+        rules = ae.load_recording_rules(REPO / "ops" / "recording_rules.yml")
+        assert len(rules) >= 6
+        names = {rule.record for rule in rules}
+        assert {"slo:pipeline_e2e_error_ratio:rate5m",
+                "slo:pipeline_e2e_error_ratio:rate1h",
+                "slo:pipeline_stage_dwell_share:rate5m"} <= names
+        # recorded names are colon-namespaced: never bare-metric lookalikes
+        assert all(":" in rule.record for rule in rules)
 
     def test_unsupported_syntax_fails_loudly(self):
         with pytest.raises(ae.PromQLError):
