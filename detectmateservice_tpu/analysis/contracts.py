@@ -87,6 +87,10 @@ ALERT_COVERED_SERIES = (
     # must stay alert-covered (ModelDriftSustained / CapacityHeadroomLow)
     "model_drift_score",
     "capacity_headroom_ratio",
+    # dmtel: a growing collector backlog means trace assembly is falling
+    # behind span arrival and tail-sampled evidence is about to be lost
+    # (TelemetryCollectorBacklog)
+    "telemetry_collector_backlog",
 )
 
 _METRIC_TOKEN_RE = re.compile(r"\b([a-z][a-z0-9_]*)\s*(?:\{|\[|$|\s|\))")

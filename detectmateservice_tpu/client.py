@@ -101,9 +101,27 @@ class DetectMateClient:
 
     def trace(self, chrome: bool = False) -> Any:
         """Read the pipeline flight recorder (slowest + sampled traces);
-        ``chrome=True`` returns a Perfetto-loadable trace-event document."""
+        ``chrome=True`` returns a Perfetto-loadable trace-event document —
+        cross-stage when the target is the telemetry collector, local hops
+        only elsewhere."""
         suffix = "?format=chrome" if chrome else ""
         return self._request("GET", "/admin/trace" + suffix)
+
+    def traces(self, trace_id: Optional[str] = None,
+               fmt: Optional[str] = None,
+               limit: Optional[int] = None) -> Any:
+        """Read the telemetry collector's assembled cross-stage traces
+        (``GET /admin/traces``): the retained ring, one trace by id, or a
+        perfetto/otlp export. Only the collector stage answers 200."""
+        params = []
+        if trace_id:
+            params.append(f"id={trace_id}")
+        if fmt:
+            params.append(f"format={fmt}")
+        if limit is not None:
+            params.append(f"limit={int(limit)}")
+        suffix = ("?" + "&".join(params)) if params else ""
+        return self._request("GET", "/admin/traces" + suffix)
 
     def health(self, deep: bool = False) -> Any:
         """Read the self-diagnosis state (``GET /admin/health``). A non-200
@@ -661,6 +679,41 @@ def _parse_mix(spec: str) -> dict:
     return mix
 
 
+def trace_waterfall(trace: dict, width: int = 48) -> str:
+    """One assembled trace as a stage waterfall: each hop a bar positioned
+    by its recv offset inside the trace's e2e window, so wire/queue gaps
+    and the widest stage read directly off the terminal."""
+    lines = [
+        "trace %s  verdict=%s  complete=%s" % (
+            trace.get("trace_id"), trace.get("verdict", "?"),
+            trace.get("complete")),
+    ]
+    e2e = trace.get("e2e_seconds")
+    if e2e is not None:
+        lines[0] += f"  e2e={e2e * 1000.0:.3f}ms"
+    if trace.get("tenant_bucket") is not None:
+        lines[0] += f"  tenant_bucket={trace['tenant_bucket']}"
+    if trace.get("flags"):
+        lines[0] += "  flags=%s" % ",".join(trace["flags"])
+    hops = trace.get("hops") or []
+    if not hops:
+        lines.append("  (no hop spans — flag-only trace)")
+        return "\n".join(lines)
+    t0 = trace.get("ingest_ns") or hops[0]["recv_ns"]
+    t1 = max(h["send_ns"] for h in hops)
+    span = max(1, t1 - t0)
+    name_w = max(len(h["stage"]) for h in hops)
+    for hop in hops:
+        start = round((hop["recv_ns"] - t0) / span * width)
+        end = max(start + 1, round((hop["send_ns"] - t0) / span * width))
+        bar = " " * start + "#" * (end - start)
+        dwell_ms = max(0, hop["send_ns"] - hop["recv_ns"]) / 1e6
+        offset_ms = max(0, hop["recv_ns"] - t0) / 1e6
+        lines.append("  %-*s |%-*s| %8.3fms  (+%.3fms)" % (
+            name_w, hop["stage"], width, bar[:width], dwell_ms, offset_ms))
+    return "\n".join(lines)
+
+
 def run_load(client: DetectMateClient, args) -> int:
     """``client.py load``: drive the open-loop load generator. ``start
     --wait`` polls until the run's schedule (+ settle) completes, stops it,
@@ -880,9 +933,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     faults_p.add_argument("--tail", type=int, default=None,
                           help="status: show the last N fired faults")
     trace = sub.add_parser(
-        "trace", help="read the pipeline flight recorder (/admin/trace)")
+        "trace", help="pipeline traces: the local flight recorder "
+                      "(/admin/trace), or — against the telemetry "
+                      "collector stage — `trace list` and `trace show "
+                      "<id>` over the assembled cross-stage traces "
+                      "(/admin/traces)")
+    trace.add_argument("action", nargs="?", default=None,
+                       choices=["list", "show"],
+                       help="list: the collector's retained traces; "
+                            "show: one trace as a stage waterfall; omit "
+                            "for the local flight-recorder snapshot")
+    trace.add_argument("trace_id", nargs="?", default=None,
+                       help="show: the 16-hex trace id")
     trace.add_argument("--chrome", action="store_true",
-                       help="fetch Chrome trace-event JSON (Perfetto-loadable)")
+                       help="fetch Chrome trace-event JSON (Perfetto-"
+                            "loadable; cross-stage on the collector)")
     trace.add_argument("-o", "--out",
                        help="write the result to a file instead of stdout")
     reconf = sub.add_parser("reconfigure")
@@ -937,7 +1002,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 config = yaml.safe_load(fh) or {}
             result = client.reconfigure(config, persist=args.persist)
         elif args.command == "trace":
-            result = client.trace(chrome=args.chrome)
+            if args.action == "list":
+                result = client.traces()
+            elif args.action == "show":
+                if not args.trace_id:
+                    print("trace show requires a trace id "
+                          "(see `trace list`)", file=sys.stderr)
+                    return 2
+                result = client.traces(trace_id=args.trace_id)
+                if not args.out:
+                    print(trace_waterfall(result))
+                    return 0
+            else:
+                result = client.trace(chrome=args.chrome)
             if args.out:
                 with open(args.out, "w", encoding="utf-8") as fh:
                     json.dump(result, fh, indent=2)

@@ -33,7 +33,7 @@ from typing import Any, Dict, Optional, Type
 
 from .config import ComponentLoader, ComponentResolver, ConfigClassLoader, ConfigManager
 from .config.manager import ConfigError
-from .engine import Engine, EngineSocketFactory
+from .engine import Engine, EngineSocketFactory, make_socket_factory
 from .engine import metrics as m
 from .engine.health import (
     EventLog,
@@ -404,6 +404,29 @@ class Service:
 
         self.slo = SloTracker()
 
+        # cross-stage telemetry collector (telemetry/, dmtel): one stage
+        # per pipeline runs it, like the router — assembles the span stream
+        # every traced engine exports into whole-pipeline traces behind
+        # GET /admin/traces. It reuses this service's socket factory so an
+        # inproc test/smoke pipeline and its collector share one transport
+        # namespace.
+        self.telemetry = None
+        if settings.telemetry_collector:
+            from .telemetry import TelemetryCollector
+
+            factory = socket_factory or make_socket_factory(
+                getattr(settings, "transport_backend", "auto"), self.logger)
+            self.telemetry = TelemetryCollector(
+                settings, factory, labels=dict(self._labels),
+                monitor=self.health, logger=self.logger)
+            self.telemetry.start()
+            self.logger.info(
+                "telemetry collector listening on %s (healthy sample "
+                "ratio %.3f, SLO %.0f ms)",
+                settings.telemetry_collector_addr,
+                settings.telemetry_sample_healthy_ratio,
+                settings.telemetry_slo_ms)
+
         self._running_metric = m.ENGINE_RUNNING().labels(**self._labels)
         self._starts_metric = m.ENGINE_STARTS().labels(**self._labels)
         self._running_metric.state("stopped")
@@ -545,6 +568,14 @@ class Service:
             self.stop()
         except Exception as exc:
             self.logger.error("engine stop during teardown failed: %s", exc)
+        # the collector outlives the engine stop above so the exporters'
+        # final flushes still land; one last pump() inside stop() flushes
+        # its own assembly tail
+        if self.telemetry is not None:
+            try:
+                self.telemetry.stop()
+            except Exception as exc:
+                self.logger.error("telemetry collector stop failed: %s", exc)
         # clean-shutdown checkpoint: after the engine stopped (so the final
         # flush landed) but before component teardown releases the state
         if (self.settings.checkpoint_dir and self.library_component is not None
@@ -637,9 +668,12 @@ class Service:
         logger.propagate = False
         have = {type(h).__name__ + getattr(h, "_dm_tag", "") for h in logger.handlers}
         if self.settings.log_format == "json":
-            fmt: logging.Formatter = JsonLogFormatter(static=dict(
-                component_type=self.settings.component_type,
-                component_id=self.settings.component_id or "unknown"))
+            fmt: logging.Formatter = JsonLogFormatter(
+                static=dict(
+                    component_type=self.settings.component_type,
+                    component_id=self.settings.component_id or "unknown"),
+                # trace correlation buckets tenants the same way metrics do
+                tenant_buckets=self.settings.shed_tenant_buckets)
         else:
             fmt = logging.Formatter(
                 "[%(asctime)s] %(levelname)s %(name)s: %(message)s"

@@ -47,6 +47,7 @@ from .framing import (
     TraceContext,
     frame_msg_count,
     pack_batch,
+    peek_trace_id,
     unpack_batch,
     unwrap_tenant,
     unwrap_trace,
@@ -54,7 +55,7 @@ from .framing import (
     wrap_trace,
 )
 from .health import Heartbeat
-from .tracing import FlightRecorder
+from .tracing import FRAME_CONTEXT, FlightRecorder
 from .socket import (
     EngineSocket,
     EngineSocketFactory,
@@ -196,6 +197,23 @@ class Engine:
             self._dwell_obs = m.PIPELINE_STAGE_DWELL().labels(**self._labels).observe
             self._transit_obs = m.PIPELINE_TRANSIT().labels(**self._labels).observe
             self._e2e_obs = m.PIPELINE_E2E_LATENCY().labels(**self._labels).observe
+
+        # cross-stage telemetry (telemetry/spans.py, dmtel): the hop records
+        # the tracing path already stamps also leave the process as spans —
+        # offer() is the hot loop's only added surface (one bounded deque
+        # append per frame; everything else runs on the sender thread). The
+        # per-thread FRAME_CONTEXT mirrors the in-flight frame's trace id +
+        # tenant for log↔trace correlation (JsonLogFormatter) and for the
+        # approximate tenant attribution of spans — same best-effort pairing
+        # contract as _tenant_pending.
+        self._frame_ctx = FRAME_CONTEXT
+        self._telemetry = None
+        if self._trace_enabled and getattr(settings, "telemetry_addr", None):
+            from ..telemetry.spans import SpanExporter
+            self._telemetry = SpanExporter(
+                settings, self._factory, self._trace_stage, self._labels,
+                self.logger,
+                events=(health.emit_event if health is not None else None))
 
         # multi-tenant admission control (shed/): tenant blocks are stripped
         # at ingress UNCONDITIONALLY (clean downgrade for tenant-unaware
@@ -521,6 +539,8 @@ class Engine:
         self._hb_ingest.beat()
         self._hb_output.wait_end()
         self._running = True
+        if self._telemetry is not None:
+            self._telemetry.start()
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._run_loop, name="EngineLoop", daemon=True
@@ -547,6 +567,11 @@ class Engine:
 
     def _close_all(self) -> None:
         self._sockets_closed = True
+        if self._telemetry is not None:
+            # final flush happens in stop(): the sender thread drains the
+            # queue once more before joining, so short-lived runs lose
+            # nothing that was offered before the stop
+            self._telemetry.stop()
         try:
             self._pair_sock.close()
         except TransportError:
@@ -636,6 +661,9 @@ class Engine:
             # untraced inbound (or a damaged block): this stage originates
             ctx = TraceContext.new(now)
         self._trace_pending.append((ctx, now))
+        # log↔trace correlation: records logged while this frame is in
+        # flight carry its id (one GIL-atomic attribute store per frame)
+        self._frame_ctx.trace_id = ctx.trace_id
         return raw
 
     def _stamp_trace(self, payload: bytes, now_ns: int) -> bytes:
@@ -649,10 +677,19 @@ class Engine:
         ctx, recv_ns = self._trace_pending.popleft()
         ctx.hops.append(Hop(self._trace_stage, recv_ns, now_ns))
         self._dwell_obs(max(0, now_ns - recv_ns) / 1e9)
+        tel = self._telemetry
         if self._trace_observe_e2e:
             e2e = max(0, now_ns - ctx.ingest_ns) / 1e9
-            self._e2e_obs(e2e)
+            if tel is not None:
+                # exemplar: the histogram bucket links to the trace the
+                # collector assembled (OpenMetrics exposition only)
+                self._e2e_obs(e2e, {"trace_id": f"{ctx.trace_id:016x}"})
+            else:
+                self._e2e_obs(e2e)
             self.trace_recorder.record(ctx, e2e)
+        if tel is not None:
+            tel.offer(ctx.trace_id, ctx.ingest_ns, recv_ns, now_ns, False,
+                      getattr(self._frame_ctx, "tenant", None))
         return wrap_trace(payload, ctx)
 
     def _finalize_traces(self) -> None:
@@ -666,19 +703,33 @@ class Engine:
         # frames did not leave this burst (filtered / deferred outputs) must
         # not re-stamp a later burst's frames with a stale tenant
         self._tenant_pending.clear()
+        fc = self._frame_ctx
         if not self._trace_pending:
+            # burst done: log records must stop carrying the last frame's id
+            fc.trace_id = None
+            fc.tenant = None
             return
         now = time.time_ns()
         terminal = (self._trace_terminal if self._trace_terminal is not None
                     else not self._out_socks and self.router is None)
+        tel = self._telemetry
+        tenant = getattr(fc, "tenant", None)
         while self._trace_pending:
             ctx, recv_ns = self._trace_pending.popleft()
             ctx.hops.append(Hop(self._trace_stage, recv_ns, now))
             self._dwell_obs(max(0, now - recv_ns) / 1e9)
             if terminal:
                 e2e = max(0, now - ctx.ingest_ns) / 1e9
-                self._e2e_obs(e2e)
+                if tel is not None:
+                    self._e2e_obs(e2e, {"trace_id": f"{ctx.trace_id:016x}"})
+                else:
+                    self._e2e_obs(e2e)
                 self.trace_recorder.record(ctx, e2e)
+            if tel is not None:
+                tel.offer(ctx.trace_id, ctx.ingest_ns, recv_ns, now,
+                          terminal, tenant)
+        fc.trace_id = None
+        fc.tenant = None
 
     def _strip_tenant(self, raw: bytes,
                       err_c) -> Tuple[Optional[bytes], Optional[str]]:
@@ -706,6 +757,11 @@ class Engine:
             tenant, frame_msg_count(raw), time.monotonic())
         if ok:
             return True
+        if self._telemetry is not None:
+            # the frame dies here, before trace ingest, so its upstream
+            # spans would assemble into a quietly-incomplete trace — the
+            # flag makes the shed visible (and keeps the trace, tail rule)
+            self._telemetry.offer_flag(peek_trace_id(raw), "shed")
         if not self._out_socks and self.router is None:
             self._send_nack(reason or "quota", tier, tenant)
         return False
@@ -771,6 +827,9 @@ class Engine:
             raw, tenant = self._strip_tenant(raw, err_c)
             if not raw:
                 return []
+        # unconditional store (None clears a previous frame's tenant): log
+        # records and spans for this frame attribute to the right tenant
+        self._frame_ctx.tenant = tenant
         if self._note_tenant is not None:
             self._note_tenant(tenant)
         if (self.admission is not None and not self._replaying
@@ -1015,6 +1074,7 @@ class Engine:
                         nxt, tenant = self._strip_tenant(nxt, err_c)
                         if not nxt:
                             return None
+                    self._frame_ctx.tenant = tenant
                     if self._note_tenant is not None:
                         self._note_tenant(tenant)
                     if (self.admission is not None
@@ -1153,8 +1213,23 @@ class Engine:
     # reason and last error. Deterministic poison converges in ONE pass;
     # a transient error just costs the bounded retries.
 
+    def _telemetry_flag(self, flag: str,
+                        trace_id: Optional[int] = None) -> None:
+        """Cold-path verdict annotation for the trace being processed. The
+        failing MESSAGE's own trace id is unknowable post-expand, so this
+        pairs with the oldest pending context — approximate under
+        re-chunking, the same documented contract as _tenant_pending; the
+        point is that the trace of a failing burst is flagged and kept."""
+        tel = self._telemetry
+        if tel is None:
+            return
+        if trace_id is None and self._trace_pending:
+            trace_id = self._trace_pending[0][0].trace_id
+        tel.offer_flag(trace_id, flag)
+
     def _quarantine_msg(self, msg: bytes, reason: str, exc: BaseException,
                         attempts: int) -> None:
+        self._telemetry_flag("quarantined")
         if self._dlq is None or not msg:
             return
         self._dlq.quarantine(
@@ -1175,6 +1250,7 @@ class Engine:
             return batch_fn(chunk)
         except Exception as exc:
             err_c.inc(len(chunk))
+            self._telemetry_flag("error")
             self.logger.error(
                 "process_batch() raised: %s — isolating %d messages",
                 exc, len(chunk))
@@ -1224,6 +1300,7 @@ class Engine:
             except Exception as exc:
                 last = exc
         err_c.inc()
+        self._telemetry_flag("error")
         self.logger.error("process() raised on all %d attempts: %s",
                           self._dlq_max_attempts, last)
         self._quarantine_msg(msg, reason, last, self._dlq_max_attempts)
@@ -1242,6 +1319,7 @@ class Engine:
             return outs, n_lines
         except Exception as exc:
             err_c.inc(len(frames))
+            self._telemetry_flag("error")
             self.logger.error(
                 "process_frames() raised: %s — isolating %d frames",
                 exc, len(frames))

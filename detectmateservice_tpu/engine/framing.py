@@ -40,6 +40,7 @@ loses the frame.
 from __future__ import annotations
 
 import itertools
+import json
 import os
 from typing import List, NamedTuple, Optional, Tuple
 
@@ -63,6 +64,16 @@ MAGIC_SHM = b"\xd7DM\x03"
 #
 #     0xD7 'D' 'M' 0x04 | varint id_len | tenant id utf-8 | payload
 MAGIC_TEN = b"\xd7DM\x04"
+# Span frame (dmtel): a batch of completed hop spans shipped from an engine's
+# telemetry sender thread to the collector (telemetry/collector.py). Spans are
+# operator-facing telemetry, not pipeline payload — they never mix with data
+# frames on a data link and the collector is their only receiver — so the body
+# is JSON (a list of span dicts, docs/transport.md "span wire format") rather
+# than a packed binary block: the encode cost is paid on the sender THREAD,
+# off the hot loop, and debuggability of the telemetry channel itself wins:
+#
+#     0xD7 'D' 'M' 0x05 | varint body_len | span JSON utf-8
+MAGIC_SPAN = b"\xd7DM\x05"
 
 
 class FramingError(ValueError):
@@ -395,6 +406,40 @@ def peek_tenant_id(data: bytes) -> Optional[str]:
         return data[pos:start].decode("utf-8")
     except UnicodeDecodeError:
         return None
+
+
+# -- span frames (dmtel telemetry channel) -----------------------------------
+
+
+def pack_spans(spans: List[dict]) -> bytes:
+    """Span dicts → one span frame for the telemetry channel. Runs on the
+    exporter's sender thread (telemetry/spans.py), never the hot loop."""
+    body = json.dumps(spans, separators=(",", ":")).encode("utf-8")
+    out = bytearray(MAGIC_SPAN)
+    _put_varint(out, len(body))
+    out += body
+    return bytes(out)
+
+
+def unpack_spans(data: bytes) -> Optional[List[dict]]:
+    """Span frame → span dicts; None when ``data`` is not a span frame.
+    Raises FramingError on a garbled body — unlike a damaged v2 trace block
+    there is no payload to salvage behind it, the frame IS the telemetry."""
+    if not data.startswith(MAGIC_SPAN):
+        return None
+    body_len, pos = _get_varint(data, len(MAGIC_SPAN))
+    end = pos + body_len
+    if end > len(data):
+        raise FramingError("span body length exceeds frame size")
+    if end != len(data):
+        raise FramingError("trailing bytes after span frame body")
+    try:
+        spans = json.loads(data[pos:end].decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise FramingError(f"undecodable span frame body: {exc}")
+    if not isinstance(spans, list):
+        raise FramingError("span frame body is not a JSON list")
+    return spans
 
 
 def unpack_batch(data: bytes) -> Optional[List[bytes]]:

@@ -46,6 +46,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import metrics as m
+from . import tracing
 
 # check / roll-up status values, in increasing severity
 PASS = "pass"
@@ -99,6 +100,10 @@ EVENT_KINDS = {
                       "instrumented site (chaos runs only)",
     "faults_armed": "a seeded fault-injection plan was armed (settings "
                     "file or POST /admin/faults)",
+    "telemetry_export_degraded": "the engine-side span exporter is shedding "
+                                 "spans (bounded queue full or the "
+                                 "telemetry link is down); traces assembled "
+                                 "by the collector will be incomplete",
 }
 
 
@@ -687,11 +692,23 @@ class JsonLogFormatter(logging.Formatter):
     """``log_format: json`` — every log record becomes one JSON object per
     line, carrying the component identity so a fleet's stdout streams can be
     aggregated without regex parsing. Health transitions attach their full
-    event under ``event`` (the ``dm_event`` record extra)."""
+    event under ``event`` (the ``dm_event`` record extra).
 
-    def __init__(self, static: Optional[Dict[str, str]] = None) -> None:
+    Log↔trace correlation (dmtel): records emitted on a thread with an
+    active frame context (tracing.FRAME_CONTEXT — the engine loop while a
+    frame is in flight) carry ``trace_id`` and ``tenant_bucket``, so
+    ``grep trace_id`` joins a stage's logs with the spans the telemetry
+    collector assembled and the DLQ entry the same frame may have left."""
+
+    def __init__(self, static: Optional[Dict[str, str]] = None,
+                 tenant_buckets: int = 16) -> None:
         super().__init__()
         self._static = dict(static or {})
+        self._tenant_buckets = max(1, tenant_buckets)
+        # runtime import: shed → engine.metrics → this module would cycle
+        # at package-import time, but formatters are built long after
+        from ..shed.quota import tenant_bucket
+        self._bucket_fn = tenant_bucket
 
     def format(self, record: logging.LogRecord) -> str:
         doc: Dict[str, Any] = {
@@ -701,6 +718,15 @@ class JsonLogFormatter(logging.Formatter):
             "message": record.getMessage(),
         }
         doc.update(self._static)
+        trace_id = tracing.current_trace_id()
+        if trace_id is not None:
+            doc["trace_id"] = f"{trace_id:016x}"
+        tenant = tracing.current_tenant()
+        if tenant is not None:
+            # the bounded bucket, never the raw tenant id — logs feed the
+            # same aggregation pipelines as metrics (shed/quota.py rationale)
+            doc["tenant_bucket"] = self._bucket_fn(tenant,
+                                                   self._tenant_buckets)
         event = getattr(record, "dm_event", None)
         if event is not None:
             doc["event"] = event

@@ -577,3 +577,54 @@ DLQ_REQUEUED = _series(
     Counter, "dlq_requeued_total",
     "Quarantined frames re-driven through the pipeline via "
     "POST /admin/dlq requeue")
+
+# cross-stage telemetry (telemetry/, dmtel). The exporter side
+# (telemetry/spans.py) runs inside every traced engine: its only hot-loop
+# footprint is one bounded deque append per frame, so the single series it
+# owns counts what the bounded queue/sender REFUSED (queue full, dead
+# telemetry link) — spans are shed, never the pipeline. Everything else is
+# collector-side (telemetry/collector.py): spans counted by their assembled
+# trace's tail-sampling verdict, traces assembled vs dropped (healthy traces
+# the sampler declined) vs incomplete (watermark/timeout flush without a
+# terminal hop), duplicate hop spans deduped (router at-least-once requeue
+# makes duplicates NORMAL, not an error), OTLP push outcomes, and the
+# backlog gauge (open traces + unparsed frames) behind the
+# TelemetryCollectorBacklog alert.
+TELEMETRY_EXPORT_DROPPED = _series(
+    Counter, "telemetry_spans_export_dropped_total",
+    "Spans dropped by the engine-side exporter instead of blocking the hot "
+    "loop (bounded queue full, or the telemetry link refused the frame)")
+VERDICT_LABELS = ("component_type", "component_id", "verdict")
+TELEMETRY_SPANS = _series(
+    Counter, "telemetry_spans_total",
+    "Hop spans ingested by the telemetry collector, by the tail-sampling "
+    "verdict of the trace they were assembled into",
+    VERDICT_LABELS)
+TELEMETRY_TRACES_ASSEMBLED = _series(
+    Counter, "telemetry_traces_assembled_total",
+    "Pipeline traces fully assembled by the collector (terminal hop seen "
+    "and the completion watermark passed)")
+TELEMETRY_TRACES_DROPPED = _series(
+    Counter, "telemetry_traces_dropped_total",
+    "Healthy assembled traces the tail sampler declined to retain "
+    "(1 - telemetry_sample_healthy_ratio of healthy traffic)")
+TELEMETRY_TRACES_INCOMPLETE = _series(
+    Counter, "telemetry_traces_incomplete_total",
+    "Traces flushed by the collector without a terminal hop after "
+    "telemetry_trace_timeout_s (a stage died, shed mid-pipeline, or its "
+    "exporter dropped the span)")
+TELEMETRY_SPANS_DEDUPED = _series(
+    Counter, "telemetry_spans_deduped_total",
+    "Duplicate (trace, stage) hop spans discarded during assembly — "
+    "router at-least-once redelivery makes these normal")
+OTLP_LABELS = ("component_type", "component_id", "result")
+TELEMETRY_OTLP_PUSHES = _series(
+    Counter, "telemetry_otlp_pushes_total",
+    "OTLP/JSON export batches pushed to telemetry_otlp_url, by result "
+    "(ok / error)",
+    OTLP_LABELS)
+TELEMETRY_COLLECTOR_BACKLOG = _series(
+    Gauge, "telemetry_collector_backlog",
+    "Open (not yet completed or flushed) traces held by the collector's "
+    "assembler; sustained growth means the completion watermark is not "
+    "advancing (a stage's exporter went quiet) or ingest outruns assembly")

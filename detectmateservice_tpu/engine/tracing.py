@@ -26,6 +26,30 @@ from typing import Any, Dict, List, Optional
 
 from .framing import TraceContext
 
+# Per-THREAD frame context (dmtel log↔trace correlation): the engine loop
+# stores the trace id (an int, hex-formatted only at log time) and tenant of
+# the frame it is currently expanding/dispatching, and clears both at burst
+# finalize. JsonLogFormatter (health.py) reads it, so every log record — a
+# quarantine, a processor exception, a shed decision — emitted while a frame
+# is in flight carries ``trace_id``/``tenant_bucket`` and joins the spans the
+# collector assembled for the same frame. A threading.local, not a global:
+# records logged from admin/sender threads must never inherit another
+# thread's frame. Plain attribute stores, GIL-atomic — no lock on the hot
+# path.
+FRAME_CONTEXT = threading.local()
+
+
+def current_trace_id() -> Optional[int]:
+    """The engine-loop trace id active on THIS thread, or None. Observe
+    sites (exemplars) and the log formatter read through this instead of
+    touching the thread-local's unguaranteed attributes."""
+    return getattr(FRAME_CONTEXT, "trace_id", None)
+
+
+def current_tenant() -> Optional[str]:
+    """The tenant of the frame active on this thread, or None."""
+    return getattr(FRAME_CONTEXT, "tenant", None)
+
 
 def trace_to_dict(ctx: TraceContext, e2e_s: float) -> Dict[str, Any]:
     return {

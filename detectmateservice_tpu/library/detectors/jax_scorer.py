@@ -2117,7 +2117,13 @@ class JaxScorerDetector(CoreDetector):
             self._batch_obs[path] = children
         occ_h, wait_h, dev_h = children
         occ_h.observe(slot.real / bucket)
-        wait_h.observe(queue_wait_s)
+        # dmtel: link the queue-wait sample to the trace that was in flight
+        # at dispatch time so a scrape with ?format=openmetrics carries an
+        # exemplar pointing straight at an assembled trace in the collector.
+        if slot.trace_id:
+            wait_h.observe(queue_wait_s, {"trace_id": slot.trace_id})
+        else:
+            wait_h.observe(queue_wait_s)
         dev_h.observe(max(0.0, device_s))
         # running (dispatches, occupancy-sum) pair: the bench/smoke
         # harnesses read deltas of it per load phase (batching_stats)

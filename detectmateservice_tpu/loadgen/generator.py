@@ -354,7 +354,14 @@ class LoadGenerator:
             self._m_recv_frames.inc()
             self._m_recv_lines.inc(lines)
             if e2e is not None:
-                self._m_e2e.observe(e2e)
+                # dmtel: exemplar the client-observed e2e with the trace id
+                # so scrapes in openmetrics mode can jump from a latency
+                # bucket straight to the assembled trace in the collector.
+                if ctx is not None:
+                    self._m_e2e.observe(
+                        e2e, {"trace_id": f"{ctx.trace_id:016x}"})
+                else:
+                    self._m_e2e.observe(e2e)
 
 
 class LoadManager:

@@ -523,6 +523,44 @@ class ServiceSettings(BaseModel):
     # bounded ring of structured events behind GET /admin/events
     event_ring_size: int = Field(default=512, ge=8, le=65536)
 
+    # -- cross-stage telemetry: dmtel (telemetry/) ------------------------
+    # Span export (every traced stage): where this engine ships its
+    # completed hop spans — the collector stage's telemetry_collector_addr.
+    # Requires engine_trace: spans ARE the hop records the tracing path
+    # stamps. Unset (default) = hop records stay in the local flight
+    # recorder only, exactly the pre-dmtel behavior.
+    telemetry_addr: Optional[TransportAddr] = None
+    # bounded hot-path span queue; when full, spans are dropped (counted in
+    # telemetry_spans_export_dropped_total) — never the pipeline's frames
+    telemetry_queue_size: int = Field(default=4096, ge=16, le=1048576)
+    # sender-thread drain cadence: spans batch for up to this long before
+    # one JSON encode + one socket send ships them
+    telemetry_flush_interval_ms: float = Field(default=50.0, ge=1.0,
+                                               le=10000.0)
+    # Collector (one stage per pipeline, like the router): assemble spans
+    # into whole-pipeline traces, tail-sample, serve GET /admin/traces.
+    telemetry_collector: bool = False
+    telemetry_collector_addr: Optional[TransportAddr] = None
+    # tail sampling: the anomalous tail (error / shed / quarantined /
+    # fault / slow / incomplete) is ALWAYS kept; healthy traces are kept at
+    # this ratio by a deterministic hash of the trace id
+    telemetry_sample_healthy_ratio: float = Field(default=0.05, ge=0.0,
+                                                  le=1.0)
+    # e2e latency above which a completed trace is "slow" (kept 100%)
+    telemetry_slo_ms: float = Field(default=1000.0, gt=0.0)
+    # watermark settle window: a trace with its terminal hop completes once
+    # the newest send_ns seen across ALL spans has advanced this far past
+    # the trace's own newest hop (out-of-order stragglers had their chance)
+    telemetry_settle_ms: float = Field(default=200.0, ge=0.0, le=60000.0)
+    # collector-clock deadline after which a trace is flushed regardless —
+    # without a terminal hop it counts as incomplete (itself a signal)
+    telemetry_trace_timeout_s: float = Field(default=5.0, gt=0.0, le=600.0)
+    # bounded ring of kept traces behind GET /admin/traces
+    telemetry_retain_traces: int = Field(default=256, ge=8, le=65536)
+    # optional OTLP/HTTP traces endpoint (e.g. http://tempo:4318/v1/traces):
+    # kept traces are pushed as OTLP/JSON by a dedicated export thread
+    telemetry_otlp_url: Optional[str] = None
+
     # -- derived identity (reference: settings.py:93-114) -----------------
     @model_validator(mode="after")
     def _ensure_component_id(self) -> "ServiceSettings":
@@ -623,6 +661,19 @@ class ServiceSettings(BaseModel):
             raise ValueError(
                 "tenant_default_burst must be >= tenant_default_rate "
                 f"({self.tenant_default_burst} < {self.tenant_default_rate})")
+        return self
+
+    # -- telemetry cross-validation ---------------------------------------
+    @model_validator(mode="after")
+    def _check_telemetry(self) -> "ServiceSettings":
+        if self.telemetry_collector and not self.telemetry_collector_addr:
+            raise ValueError(
+                "telemetry_collector requires telemetry_collector_addr "
+                "(the address the collector listens for span frames on)")
+        if self.telemetry_addr and not self.engine_trace:
+            raise ValueError(
+                "telemetry_addr requires engine_trace: spans are built "
+                "from the hop records the tracing path stamps")
         return self
 
     # -- TLS cross-validation (reference: settings.py:116-132) ------------

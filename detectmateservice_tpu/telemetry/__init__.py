@@ -1,0 +1,34 @@
+"""dmtel — cross-stage trace assembly, tail-based sampling, OTLP export.
+
+PR 1 made every engine stamp its hop (stage, recv_ns, send_ns) into the v2
+frame it forwards, but each process kept only its own bounded flight-recorder
+ring: the richest debugging signal in the system was discarded at every stage
+boundary. This package is the fleet-scale half of that telemetry:
+
+* :mod:`spans`     — the engine-side exporter: completed hop records become
+  self-contained span dicts and leave the process via a bounded non-blocking
+  queue + sender thread (hot-loop cost: one deque append per frame);
+* :mod:`collector` — ``dmcollect``: assembles spans into whole-pipeline
+  traces (out-of-order arrival, at-least-once dedup, watermark completion)
+  and tail-samples them — 100% of the anomalous tail, a configured ratio of
+  the healthy rest;
+* :mod:`otlp`      — self-contained OTLP/JSON-over-HTTP encoder + push, so
+  assembled traces land in Jaeger/Tempo without an otel-SDK dependency;
+* :mod:`perfetto`  — the cross-stage Perfetto (Chrome trace-event) view that
+  supersedes the per-process ``GET /admin/trace?format=chrome``.
+
+The wire between exporter and collector is the span frame
+(``engine/framing.py`` MAGIC_SPAN, docs/transport.md); the settings knobs are
+the ``telemetry_*`` block (docs/configuration.md).
+"""
+from __future__ import annotations
+
+from .collector import TailSampler, TelemetryCollector, TraceAssembler
+from .spans import SpanExporter
+
+__all__ = [
+    "SpanExporter",
+    "TailSampler",
+    "TelemetryCollector",
+    "TraceAssembler",
+]

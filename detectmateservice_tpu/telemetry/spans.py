@@ -1,0 +1,202 @@
+"""Engine-side span export: hop records → span frames, off the hot path.
+
+The engine loop already pays one ``time.time_ns()`` per frame to stamp its
+hop into the forwarded v2 trace block; this module makes that same record
+leave the process. The contract with the hot loop is strict:
+
+* ``offer()`` is the ONLY hot-path surface and costs one bounded-deque
+  append (a ``len`` check + ``append``, both GIL-atomic) — no lock, no
+  allocation beyond the tuple the caller already built, no clock read;
+* when the queue is full the SPAN is dropped, never the frame — the
+  pipeline must not feel its own telemetry (``telemetry_spans_export_
+  dropped_total``, plus a rate-limited ``telemetry_export_degraded``
+  event);
+* everything with real cost — dict building, tenant→bucket hashing, JSON
+  encoding, the socket send — happens on the sender thread at
+  ``telemetry_flush_interval_ms`` cadence.
+
+Cold paths (shed refusals, quarantines, dispatch errors) annotate a trace
+through ``offer_flag``; flags ride the same queue as 3-tuples and become
+flag-only span records the collector merges into the trace's verdict.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..engine import metrics as m
+from ..engine.framing import pack_spans
+from ..shed.quota import tenant_bucket
+
+# re-emit the degraded event at most this often while drops continue — the
+# event ring must show the condition, not one entry per dropped span
+_DEGRADED_EVENT_INTERVAL_S = 60.0
+
+
+class SpanExporter:
+    """Ships completed hop spans to the telemetry collector over the
+    engine's own transport backend (``telemetry_addr``)."""
+
+    def __init__(self, settings, factory, stage: str,
+                 labels: Dict[str, str],
+                 logger: Optional[logging.Logger] = None,
+                 events: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                 ) -> None:
+        self._addr = settings.telemetry_addr
+        self._cap = int(getattr(settings, "telemetry_queue_size", 4096))
+        self._flush_s = max(
+            0.001,
+            float(getattr(settings, "telemetry_flush_interval_ms", 50.0))
+            / 1000.0)
+        self._buckets = int(getattr(settings, "shed_tenant_buckets", 16) or 16)
+        self._factory = factory
+        self._stage = stage
+        self._replica = labels.get("component_id", "")
+        self._logger = logger
+        self._events = events
+        # the bounded hot-path queue: hop 6-tuples and flag 3-tuples mixed
+        # in arrival order. A deque, not queue.Queue — offer() must never
+        # take a lock or wake a waiter.
+        self._q: deque = deque()
+        self._m_dropped = m.TELEMETRY_EXPORT_DROPPED().labels(**labels)
+        self._sock = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_degraded_emit = 0.0
+        self._send_errors = 0
+
+    # -- hot path ---------------------------------------------------------
+
+    def offer(self, trace_id: int, ingest_ns: int, recv_ns: int,
+              send_ns: int, terminal: bool, tenant: Optional[str]) -> None:
+        """Enqueue one completed hop. Called from the engine loop per frame
+        (``_stamp_trace`` / ``_finalize_traces``); bounded and non-blocking
+        by construction."""
+        # dmlint: hot-loop
+        q = self._q
+        if len(q) < self._cap:
+            q.append((trace_id, ingest_ns, recv_ns, send_ns, terminal,
+                      tenant))
+        else:
+            self._m_dropped.inc()
+
+    def offer_flag(self, trace_id: Optional[int], flag: str) -> None:
+        """Annotate ``trace_id`` with a verdict flag (``shed`` /
+        ``quarantined`` / ``error`` / ``fault``). Cold paths only — a shed
+        refusal, a poison frame, a dispatch exception."""
+        if trace_id is None:
+            return
+        q = self._q
+        if len(q) < self._cap:
+            q.append(("flag", trace_id, flag))
+        else:
+            self._m_dropped.inc()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-sender", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        self._thread = None
+        sock = self._sock
+        self._sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            # dmlint: ignore[DM-R001] best-effort close; the send path broke it
+            except Exception:
+                pass
+
+    @property
+    def backlog(self) -> int:
+        return len(self._q)
+
+    # -- sender thread ----------------------------------------------------
+
+    def _run(self) -> None:  # dmlint: thread(any)
+        while not self._stop.is_set():
+            self._stop.wait(self._flush_s)
+            self.flush()
+        self.flush()  # final drain so short-lived smokes lose nothing
+
+    def flush(self) -> int:
+        """Drain the queue into one span frame and send it. Returns the
+        number of spans shipped (0 when idle or the link refused). Public
+        for tests and for the engine's stop epilogue."""
+        q = self._q
+        if not q:
+            return 0
+        spans: List[Dict[str, Any]] = []
+        while q:
+            try:
+                item = q.popleft()
+            except IndexError:
+                break
+            if item[0] == "flag":
+                spans.append({
+                    "trace_id": f"{item[1]:016x}",
+                    "stage": self._stage,
+                    "replica": self._replica,
+                    "flags": [item[2]],
+                })
+                continue
+            trace_id, ingest_ns, recv_ns, send_ns, terminal, tenant = item
+            span: Dict[str, Any] = {
+                "trace_id": f"{trace_id:016x}",
+                "stage": self._stage,
+                "replica": self._replica,
+                "ingest_ns": ingest_ns,
+                "recv_ns": recv_ns,
+                "send_ns": send_ns,
+                "terminal": bool(terminal),
+            }
+            if tenant is not None:
+                span["tenant_bucket"] = tenant_bucket(tenant, self._buckets)
+            spans.append(span)
+        if not spans:
+            return 0
+        frame = pack_spans(spans)
+        try:
+            sock = self._sock
+            if sock is None:
+                sock = self._factory.create_output(self._addr, self._logger)
+                self._sock = sock
+            sock.send(frame)
+        except Exception as exc:
+            # span loss is the designed failure mode: count it, surface it,
+            # drop the batch — never backpressure into the engine
+            self._m_dropped.inc(len(spans))
+            self._send_errors += 1
+            self._sock = None
+            self._note_degraded(f"send to {self._addr} failed: {exc}")
+            return 0
+        return len(spans)
+
+    def _note_degraded(self, detail: str) -> None:
+        now = time.monotonic()
+        if now - self._last_degraded_emit < _DEGRADED_EVENT_INTERVAL_S:
+            return
+        self._last_degraded_emit = now
+        if self._events is not None:
+            try:
+                self._events({"kind": "telemetry_export_degraded",
+                              "detail": detail,
+                              "send_errors": self._send_errors})
+            # dmlint: ignore[DM-R001] a broken event ring must not kill sending
+            except Exception:
+                pass
+        elif self._logger is not None:
+            self._logger.warning("telemetry export degraded: %s", detail)

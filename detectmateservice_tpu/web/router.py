@@ -67,6 +67,18 @@ def _float_param(query: Dict[str, List[str]], name: str,
 
 # -- GET handlers -----------------------------------------------------------
 def _metrics(service, query, payload) -> Response:
+    fmt = (query.get("format") or ["prometheus"])[0]
+    if fmt == "openmetrics":
+        # OpenMetrics exposition carries the exemplars (trace ids on the
+        # e2e/queue-wait histogram buckets, dmtel); the handler contract
+        # has no request headers, so the format is a query param instead
+        # of Accept-negotiation
+        from prometheus_client import REGISTRY
+        from prometheus_client.openmetrics import exposition as om
+
+        return Response(200, om.generate_latest(REGISTRY), om.CONTENT_TYPE_LATEST)
+    if fmt != "prometheus":
+        return Response(400, {"detail": f"unknown format {fmt!r}"})
     return Response(200, generate_latest(), CONTENT_TYPE_LATEST)
 
 
@@ -107,12 +119,46 @@ def _trace(service, query, payload) -> Response:
     if recorder is None:
         return Response(404, {"detail": "engine has no flight recorder"})
     if fmt == "chrome":
-        return Response(200, recorder.chrome_events())
+        # the pipeline view: on the collector stage this serves the
+        # CROSS-STAGE Perfetto export (assembled traces, every hop of every
+        # stage); elsewhere only the local recorder exists, and the local
+        # view says so instead of masquerading as the pipeline
+        collector = getattr(service, "telemetry", None)
+        if collector is not None:
+            return Response(200, collector.perfetto_events())
+        doc = recorder.chrome_events()
+        doc["localOnly"] = True  # hops of THIS process only (walkthrough.md)
+        return Response(200, doc)
     if fmt == "json":
         body = recorder.snapshot()
         body["tracing_enabled"] = bool(
             getattr(service.settings, "engine_trace", False))
         return Response(200, body)
+    return Response(400, {"detail": f"unknown format {fmt!r}"})
+
+
+def _traces(service, query, payload) -> Response:
+    collector = getattr(service, "telemetry", None)
+    if collector is None:
+        return Response(404, {"detail": "this stage runs no telemetry "
+                                        "collector (telemetry_collector "
+                                        "not set)"})
+    trace_id = (query.get("id") or [None])[0]
+    if trace_id is not None:
+        trace = collector.trace(trace_id)
+        if trace is None:
+            return Response(404, {"detail": f"trace {trace_id!r} is not in "
+                                            "the retained ring (sampled "
+                                            "out, expired, or never seen)"})
+        return Response(200, trace)
+    fmt = (query.get("format") or ["json"])[0]
+    if fmt == "perfetto":
+        return Response(200, collector.perfetto_events())
+    if fmt == "otlp":
+        return Response(200, collector.otlp_payload())
+    if fmt == "json":
+        return Response(200, collector.snapshot(
+            _int_param(query, "limit", default=None)))
     return Response(400, {"detail": f"unknown format {fmt!r}"})
 
 
@@ -461,6 +507,9 @@ ROUTES: Tuple[Route, ...] = (
     Route("GET", "/admin/health", _health, "liveness / deep health"),
     Route("GET", "/admin/events", _events, "structured event ring"),
     Route("GET", "/admin/trace", _trace, "pipeline flight recorder"),
+    Route("GET", "/admin/traces", _traces,
+          "telemetry collector: assembled cross-stage traces "
+          "(?id=<hex> for one, ?format=perfetto|otlp for exports)"),
     Route("GET", "/admin/xla", _xla,
           "XLA compile ledger + device-batch spans"),
     Route("GET", "/admin/profile", _profile_status,

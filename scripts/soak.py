@@ -140,6 +140,11 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None,
         engine_trace=True, backend="cpu",
         engine_batch_size=max(512, 2 * burst), engine_batch_timeout_ms=5.0,
         engine_frame_batch=burst, engine_recv_timeout=50,
+        # dmtel rides along on every soak: each stage exports its hop spans
+        # to the collector the parser service hosts. Purely additive
+        # observability — no soak gate reads it, the stats land in the
+        # verdict JSON as evidence
+        telemetry_addr="inproc://soak-telemetry",
     )
     wal = {}
     if wal_dir is not None:
@@ -157,7 +162,10 @@ def build_settings(tmp: Path, burst: int, rollout_dir=None, wal_dir=None,
         component_type="parsers.template_matcher.MatcherParser",
         component_id="soak-parser", trace_stage="parser",
         engine_addr="inproc://soak-parser",
-        out_addr=["inproc://soak-detector"], **wal, **shed, **common)
+        out_addr=["inproc://soak-detector"],
+        telemetry_collector=True,
+        telemetry_collector_addr="inproc://soak-telemetry",
+        **wal, **shed, **common)
     rollout = {}
     if rollout_dir is not None:
         # the dmroll cycle, CI-sized: a generous mean-delta gate (a 1-epoch
@@ -1266,6 +1274,13 @@ def main() -> int:
                     generator.stop()
                 except Exception:
                     pass
+            # dmtel evidence: the collector's assembly/sampling stats ride
+            # in the verdict JSON (no gate — the telemetry-smoke CI job
+            # owns the hard assertions)
+            for service in services:
+                if getattr(service, "telemetry", None) is not None:
+                    record["telemetry"] = (
+                        service.telemetry.snapshot()["stats"])
             scraper.stop()
             teardown_pipeline(services)
 

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""CI smoke for dmtel: a live 4-stage pipeline's span stream reassembles
+into whole traces, and the tail sampler keeps the anomalous tail.
+
+One fail-fast phase around four REAL ``Engine`` stages (no jax, tiny echo
+processors — the shed-smoke shape) all pointing ``telemetry_addr`` at a
+live ``TelemetryCollector``:
+
+* **assembly**: every frame that crosses reader → parser → detector →
+  output must come back out of the collector as ONE complete 4-stage
+  trace whose hops are recv-ordered and monotonic — the spans arrived
+  from four independent sender threads, so this proves out-of-order
+  merge on live traffic, not fixtures;
+* **tail sampling**: frames the detector slept on (past the smoke's SLO)
+  must be retained 100% with verdict ``slow``; frames the detector threw
+  on must be retained with an ``error``/``quarantined`` verdict; the
+  healthy rest must be probabilistically thinned by
+  ``telemetry_sample_healthy_ratio`` — kept + dropped must reconcile;
+* **export**: the collector's OTLP/JSON document (the same bytes
+  ``GET /admin/traces?format=otlp`` serves) is written to ``--out`` as
+  the workflow artifact and must contain every retained hop as a span.
+
+Writes the OTLP payload (with a ``dm_smoke`` verdict block prepended) to
+``--out`` for the workflow-artifact upload.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+STAGES = ["reader", "parser", "detector", "output"]
+PLAIN, SLOW, ERR = 40, 3, 3
+SLO_MS = 30.0
+HEALTHY_RATIO = 0.25
+
+
+class Echo:
+    def process(self, data: bytes):
+        return data
+
+
+class MarkedDetector:
+    """Echo that sleeps past the smoke SLO on SLOW frames and throws on
+    ERR frames — the two tails the sampler must keep."""
+
+    def process(self, data: bytes):
+        if b"SLOW" in data:
+            time.sleep(SLO_MS / 1000.0 * 2)
+        if b"ERR" in data:
+            raise RuntimeError("telemetry-smoke poison frame")
+        return data
+
+
+class Collector:
+    """Terminal-stage processor: records what survived the pipeline. The
+    terminal engine has no outputs, which is what makes its hop spans
+    ``terminal`` — completion is proven by assembly, delivery by this."""
+
+    def __init__(self) -> None:
+        self.seen = set()
+
+    def process(self, data: bytes):
+        self.seen.add(bytes(data))
+        return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="telemetry-smoke-otlp.json")
+    args = ap.parse_args()
+
+    from detectmateservice_tpu.engine import Engine
+    from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+    from detectmateservice_tpu.settings import ServiceSettings
+    from detectmateservice_tpu.telemetry import TelemetryCollector
+
+    t0 = time.monotonic()
+    record = {"schema": "telemetry-smoke-v1", "gates": []}
+
+    def finish() -> None:
+        doc = dict(record)
+        doc.update(otlp_doc or {})
+        Path(args.out).write_text(json.dumps(doc, indent=2) + "\n",
+                                  encoding="utf-8")
+
+    def gate(name: str, ok: bool, detail: str) -> None:
+        record["gates"].append({"name": name, "ok": bool(ok),
+                                "detail": str(detail)})
+        print(f"[telemetry-smoke] {'PASS' if ok else 'FAIL'} "
+              f"{name}: {detail}")
+        if not ok:
+            finish()
+            raise SystemExit(f"telemetry-smoke failed at {name}")
+
+    otlp_doc = None
+    factory = InprocQueueSocketFactory(maxsize=4096)
+    col_addr = "inproc://tel-smoke-col"
+
+    col_settings = ServiceSettings(
+        component_type="core", component_id="tel-smoke-collector",
+        telemetry_collector=True, telemetry_collector_addr=col_addr,
+        telemetry_sample_healthy_ratio=HEALTHY_RATIO,
+        telemetry_slo_ms=SLO_MS, telemetry_settle_ms=50.0,
+        telemetry_trace_timeout_s=2.0, telemetry_retain_traces=1024,
+        log_to_file=False, log_to_console=False)
+    labels = {"component_type": "core",
+              "component_id": "tel-smoke-collector"}
+    collector = TelemetryCollector(col_settings, factory, labels=labels)
+    collector.start()
+
+    engines = []
+    terminal = Collector()
+    addrs = [f"inproc://tel-smoke-s{i}" for i in range(len(STAGES))]
+    for i, stage in enumerate(STAGES):
+        last = i == len(STAGES) - 1
+        settings = ServiceSettings(
+            component_type="core", component_id=f"tel-smoke-{stage}",
+            trace_stage=stage, engine_trace=True,
+            engine_addr=addrs[i],
+            out_addr=[] if last else [addrs[i + 1]],
+            engine_recv_timeout=20,
+            telemetry_addr=col_addr, telemetry_flush_interval_ms=20.0,
+            log_to_file=False, log_to_console=False)
+        if stage == "detector":
+            proc = MarkedDetector()
+        elif last:
+            proc = terminal
+        else:
+            proc = Echo()
+        engine = Engine(settings, proc, socket_factory=factory)
+        engine.start()
+        engines.append(engine)
+
+    sender = factory.create_output(addrs[0])
+
+    expect = set()
+    for i in range(PLAIN):
+        frame = b"plain-%03d" % i
+        expect.add(frame)
+        sender.send(frame)
+    for i in range(SLOW):
+        frame = b"SLOW-%03d" % i
+        expect.add(frame)
+        sender.send(frame)
+    for i in range(ERR):
+        sender.send(b"ERR-%03d" % i)  # dropped at the detector, on purpose
+
+    # -- drain the pipeline ------------------------------------------------
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and len(terminal.seen) < len(expect):
+        time.sleep(0.05)
+    gate("pipeline_delivered", terminal.seen >= expect,
+         f"{len(terminal.seen & expect)}/{len(expect)} plain+slow frames "
+         "crossed all four stages")
+
+    # -- wait for assembly: error traces only flush on the 2 s timeout -----
+    total = PLAIN + SLOW + ERR
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        stats = collector.snapshot()["stats"]
+        if stats["assembled"] + stats["incomplete"] >= total:
+            break
+        time.sleep(0.1)
+    stats = collector.snapshot()["stats"]
+    record["collector_stats"] = stats
+    gate("all_traces_flushed",
+         stats["assembled"] + stats["incomplete"] >= total,
+         f"assembled={stats['assembled']} incomplete={stats['incomplete']} "
+         f"of {total} sent (backlog={stats['backlog']})")
+
+    retained = collector.retained()
+    by_verdict = {}
+    for trace in retained:
+        by_verdict.setdefault(trace["verdict"], []).append(trace)
+
+    # -- gate: a fully-assembled 4-stage trace with monotonic hops ---------
+    complete4 = [t for t in retained
+                 if t["complete"] and len(t["hops"]) == len(STAGES)]
+    gate("four_stage_trace_assembled", len(complete4) >= 1,
+         f"{len(complete4)} retained traces carry all {len(STAGES)} hops")
+    ordered = 0
+    for trace in complete4:
+        stages = [h["stage"] for h in trace["hops"]]
+        recvs = [h["recv_ns"] for h in trace["hops"]]
+        sends = [h["send_ns"] for h in trace["hops"]]
+        if (stages == STAGES and recvs == sorted(recvs)
+                and all(s >= r for r, s in zip(recvs, sends))):
+            ordered += 1
+    gate("hops_monotonic", ordered == len(complete4),
+         f"{ordered}/{len(complete4)} complete traces are recv-ordered "
+         "reader→parser→detector→output with send>=recv per hop")
+
+    # -- gate: the anomalous tail is kept 100% -----------------------------
+    slow = by_verdict.get("slow", [])
+    gate("slow_traces_retained", len(slow) == SLOW,
+         f"{len(slow)}/{SLOW} SLO-busting traces retained with "
+         f"verdict=slow (e2e "
+         f"{[round((t['e2e_seconds'] or 0) * 1000, 1) for t in slow]} ms "
+         f"vs slo={SLO_MS} ms)")
+    errored = (by_verdict.get("error", [])
+               + by_verdict.get("quarantined", []))
+    gate("error_traces_retained", len(errored) == ERR,
+         f"{len(errored)}/{ERR} poison traces retained with verdict "
+         f"error/quarantined (flags "
+         f"{sorted(set(f for t in errored for f in t['flags']))})")
+
+    # -- gate: healthy thinned by the sampler, accounting reconciles -------
+    healthy_kept = len(by_verdict.get("healthy", []))
+    gate("healthy_sampled_at_ratio",
+         0 < healthy_kept < PLAIN
+         and stats["dropped"] == PLAIN - healthy_kept,
+         f"{healthy_kept}/{PLAIN} healthy traces kept at "
+         f"ratio={HEALTHY_RATIO} (dropped={stats['dropped']}; "
+         "the tail gates above prove drops never touch anomalies)")
+
+    # -- gate: OTLP artifact carries every retained hop --------------------
+    otlp_doc = collector.otlp_payload()
+    spans = otlp_doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    want_spans = sum(len(t["hops"]) for t in retained)
+    gate("otlp_payload_complete", len(spans) == want_spans and spans,
+         f"{len(spans)} OTLP spans for {len(retained)} retained traces "
+         f"→ {args.out}")
+
+    for engine in engines:
+        engine.stop()
+    collector.stop()
+    record["wall_s"] = round(time.monotonic() - t0, 2)
+    finish()
+    print(f"[telemetry-smoke] OK in {record['wall_s']}s; "
+          f"OTLP artifact at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,397 @@
+"""dmtel — cross-stage trace assembly + tail sampling (telemetry/, PR 20).
+
+Covers the telemetry subsystem's contracts end to end:
+
+* assembler: out-of-order hop arrival still yields one recv-ordered trace,
+  router at-least-once duplicates collapse to the earliest attempt,
+  terminal traces hold until the send-time watermark settles past their
+  newest hop, and terminal-less traces flush as ``incomplete`` after the
+  local-clock timeout;
+* tail sampler: the verdict matrix — error / quarantined / shed / fault /
+  incomplete / slow always kept, healthy gated by the deterministic
+  Fibonacci hash so a restarted collector reproduces the same sample set;
+* wire: ``pack_spans``/``unpack_spans`` round-trip, non-span frames are
+  not claimed, garbled bodies raise instead of poisoning the collector;
+* exporter: the hot-path queue is bounded (span dropped, frame never),
+  and a flush through a real inproc socket lands in a collector that
+  assembles the cross-stage trace;
+* exemplars: an OpenMetrics scrape of an exemplar'd histogram carries the
+  ``# {trace_id=...}`` suffix prometheus parsers expect;
+* OTLP: 32-hex ``traceId``, stable span ids, recv-order parent chain, and
+  ERROR status on errored traces.
+
+Assembler/sampler tests drive injected clocks — no sleeps, no threads.
+"""
+import re
+from types import SimpleNamespace
+
+import pytest
+
+from detectmateservice_tpu.engine import metrics as m
+from detectmateservice_tpu.engine.framing import (
+    FramingError,
+    MAGIC_SPAN,
+    pack_batch,
+    pack_spans,
+    unpack_spans,
+)
+from detectmateservice_tpu.engine.socket import InprocQueueSocketFactory
+from detectmateservice_tpu.telemetry import (
+    SpanExporter,
+    TailSampler,
+    TelemetryCollector,
+    TraceAssembler,
+)
+from detectmateservice_tpu.telemetry import otlp
+
+LABELS = {"component_type": "telemetry.test",
+          "component_id": "telemetry-test"}
+
+MS = 1_000_000  # ns
+
+
+def tel_settings(**over):
+    base = dict(
+        telemetry_addr="inproc://tel-test",
+        telemetry_queue_size=4096,
+        telemetry_flush_interval_ms=50.0,
+        telemetry_collector=True,
+        telemetry_collector_addr="inproc://tel-test",
+        telemetry_sample_healthy_ratio=1.0,
+        telemetry_slo_ms=1000.0,
+        telemetry_settle_ms=0.0,
+        telemetry_trace_timeout_s=5.0,
+        telemetry_retain_traces=256,
+        telemetry_otlp_url=None,
+        shed_tenant_buckets=16,
+    )
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+def hop(tid, stage, ingest, recv, send, terminal=False, **extra):
+    span = {"trace_id": f"{tid:016x}", "stage": stage, "replica": "r0",
+            "ingest_ns": ingest, "recv_ns": recv, "send_ns": send,
+            "terminal": terminal}
+    span.update(extra)
+    return span
+
+
+def built(tid=0xabc, complete=True, flags=(), e2e=0.010):
+    """A trace in the collector ``_build`` output shape, for sampler/OTLP
+    tests that start downstream of assembly."""
+    return {"trace_id": f"{tid:016x}", "ingest_ns": 1000,
+            "e2e_seconds": e2e if complete else None,
+            "complete": complete, "flags": sorted(flags),
+            "tenant_bucket": None,
+            "hops": [{"stage": "reader", "recv_ns": 1000,
+                      "send_ns": 2000, "replica": "r0"},
+                     {"stage": "detector", "recv_ns": 3000,
+                      "send_ns": 4000, "replica": "d0"}]}
+
+
+# ---------------------------------------------------------------------------
+# assembler
+
+
+class TestAssembler:
+    def test_out_of_order_arrival_builds_ordered_trace(self):
+        asm = TraceAssembler(settle_ns=0, timeout_ns=10_000 * MS)
+        # terminal hop first, upstream hops after — stages flush on their
+        # own cadence so this ordering is routine, not exotic
+        asm.add(hop(7, "output", 0, 30, 40, terminal=True), now_ns=0)
+        asm.add(hop(7, "detector", 0, 20, 30), now_ns=0)
+        asm.add(hop(7, "reader", 0, 0, 10), now_ns=0)
+        completed, expired = asm.poll(now_ns=0)
+        assert expired == []
+        assert len(completed) == 1
+        trace = completed[0]
+        assert trace["complete"] is True
+        assert [h["stage"] for h in trace["hops"]] == [
+            "reader", "detector", "output"]
+        recvs = [h["recv_ns"] for h in trace["hops"]]
+        assert recvs == sorted(recvs)
+        assert trace["e2e_seconds"] == pytest.approx(40 / 1e9)
+        assert asm.backlog == 0
+
+    def test_duplicate_hop_keeps_earliest_attempt(self):
+        asm = TraceAssembler(settle_ns=0, timeout_ns=10_000 * MS)
+        # at-least-once redelivery: the SECOND delivery arrives with later
+        # timing; the trace must keep the first attempt's clock stamps
+        assert asm.add(hop(9, "detector", 0, 100, 200), now_ns=0) == "hop"
+        assert asm.add(hop(9, "detector", 0, 500, 600), now_ns=0) == "dup"
+        assert asm.deduped == 1
+        asm.add(hop(9, "output", 0, 700, 800, terminal=True), now_ns=0)
+        completed, _ = asm.poll(now_ns=0)
+        stages = {h["stage"]: h for h in completed[0]["hops"]}
+        assert len(completed[0]["hops"]) == 2
+        assert stages["detector"]["recv_ns"] == 100
+
+    def test_duplicate_arriving_first_is_replaced_by_earlier(self):
+        asm = TraceAssembler(settle_ns=0, timeout_ns=10_000 * MS)
+        asm.add(hop(9, "detector", 0, 500, 600), now_ns=0)
+        asm.add(hop(9, "detector", 0, 100, 200), now_ns=0)
+        asm.add(hop(9, "output", 0, 700, 800, terminal=True), now_ns=0)
+        completed, _ = asm.poll(now_ns=0)
+        stages = {h["stage"]: h for h in completed[0]["hops"]}
+        assert stages["detector"]["recv_ns"] == 100
+
+    def test_watermark_holds_terminal_trace_until_settled(self):
+        settle = 5 * MS
+        asm = TraceAssembler(settle_ns=settle, timeout_ns=10_000 * MS)
+        asm.add(hop(1, "reader", 0, 0, 10), now_ns=0)
+        asm.add(hop(1, "output", 0, 20, 30, terminal=True), now_ns=0)
+        # watermark == the trace's own newest hop: stragglers from slower
+        # stages could still be in flight, so the trace must wait
+        completed, expired = asm.poll(now_ns=0)
+        assert completed == [] and expired == []
+        assert asm.backlog == 1
+        # unrelated later traffic advances the watermark past settle —
+        # proof the channel is live and the stragglers had their chance
+        asm.add(hop(2, "reader", 0, 40, 30 + settle), now_ns=0)
+        completed, _ = asm.poll(now_ns=0)
+        assert [t["trace_id"] for t in completed] == [f"{1:016x}"]
+
+    def test_incomplete_trace_flushes_on_timeout(self):
+        timeout = 1000 * MS
+        asm = TraceAssembler(settle_ns=0, timeout_ns=timeout)
+        asm.add(hop(3, "reader", 0, 0, 10), now_ns=0)
+        asm.add(hop(3, "detector", 0, 20, 30), now_ns=0)  # no terminal hop
+        completed, expired = asm.poll(now_ns=timeout - 1)
+        assert completed == [] and expired == []
+        completed, expired = asm.poll(now_ns=timeout)
+        assert completed == []
+        assert len(expired) == 1
+        trace = expired[0]
+        assert trace["complete"] is False
+        assert trace["e2e_seconds"] is None
+        assert len(trace["hops"]) == 2
+        assert asm.backlog == 0
+
+    def test_flag_only_record_annotates_trace(self):
+        asm = TraceAssembler(settle_ns=0, timeout_ns=10_000 * MS)
+        asm.add(hop(4, "reader", 0, 0, 10), now_ns=0)
+        outcome = asm.add({"trace_id": f"{4:016x}", "stage": "detector",
+                           "replica": "d0", "flags": ["error"]}, now_ns=0)
+        assert outcome == "flag"
+        asm.add(hop(4, "output", 0, 20, 30, terminal=True), now_ns=0)
+        completed, _ = asm.poll(now_ns=0)
+        assert completed[0]["flags"] == ["error"]
+        # a flag-only record is an annotation, never a hop
+        assert len(completed[0]["hops"]) == 2
+
+    def test_malformed_span_raises_for_caller_to_count(self):
+        asm = TraceAssembler(settle_ns=0, timeout_ns=10_000 * MS)
+        with pytest.raises((KeyError, TypeError, ValueError)):
+            asm.add({"stage": "reader"}, now_ns=0)  # no trace_id
+        with pytest.raises((KeyError, TypeError, ValueError)):
+            asm.add({"trace_id": "zz", "stage": "reader", "recv_ns": 1,
+                     "send_ns": 2, "ingest_ns": 0}, now_ns=0)
+
+
+# ---------------------------------------------------------------------------
+# tail sampler
+
+
+class TestTailSampler:
+    @pytest.mark.parametrize("flag", ["error", "quarantined", "shed",
+                                      "fault"])
+    def test_flagged_traces_always_kept(self, flag):
+        sampler = TailSampler(healthy_ratio=0.0, slo_s=1.0)
+        keep, verdict = sampler.verdict(built(flags=[flag]))
+        assert keep is True
+        assert verdict == flag
+
+    def test_incomplete_always_kept(self):
+        sampler = TailSampler(healthy_ratio=0.0, slo_s=1.0)
+        keep, verdict = sampler.verdict(built(complete=False))
+        assert (keep, verdict) == (True, "incomplete")
+
+    def test_slow_trace_kept_past_slo(self):
+        sampler = TailSampler(healthy_ratio=0.0, slo_s=1.0)
+        keep, verdict = sampler.verdict(built(e2e=1.5))
+        assert (keep, verdict) == (True, "slow")
+        keep, verdict = sampler.verdict(built(e2e=0.5))
+        assert (keep, verdict) == (False, "healthy")
+
+    def test_healthy_ratio_endpoints(self):
+        keep_all = TailSampler(healthy_ratio=1.0, slo_s=1.0)
+        keep_none = TailSampler(healthy_ratio=0.0, slo_s=1.0)
+        for tid in range(64):
+            assert keep_all.verdict(built(tid=tid + 1))[0] is True
+            assert keep_none.verdict(built(tid=tid + 1))[0] is False
+
+    def test_healthy_sampling_is_deterministic_and_ratioed(self):
+        sampler = TailSampler(healthy_ratio=0.25, slo_s=1.0)
+        ids = range(1, 2001)
+        first = [sampler.verdict(built(tid=i))[0] for i in ids]
+        again = [TailSampler(0.25, 1.0).verdict(built(tid=i))[0]
+                 for i in ids]
+        # restart-stable: a fresh sampler reproduces the exact sample set
+        assert first == again
+        kept = sum(first)
+        # the Fibonacci hash mixes sequential ids well; allow wide slack
+        assert 0.15 < kept / len(first) < 0.35
+
+    def test_error_flag_outranks_slow(self):
+        sampler = TailSampler(healthy_ratio=0.0, slo_s=1.0)
+        keep, verdict = sampler.verdict(built(flags=["error"], e2e=2.0))
+        assert (keep, verdict) == (True, "error")
+
+
+# ---------------------------------------------------------------------------
+# span wire format
+
+
+class TestSpanWire:
+    def test_round_trip(self):
+        spans = [hop(0xabc, "reader", 0, 1, 2),
+                 {"trace_id": f"{0xabc:016x}", "stage": "detector",
+                  "replica": "d0", "flags": ["shed"]}]
+        frame = pack_spans(spans)
+        assert frame.startswith(MAGIC_SPAN)
+        assert unpack_spans(frame) == spans
+
+    def test_non_span_frames_not_claimed(self):
+        assert unpack_spans(b"plain payload") is None
+        assert unpack_spans(pack_batch([b"msg"])) is None
+
+    def test_garbled_body_raises(self):
+        with pytest.raises(FramingError):
+            unpack_spans(MAGIC_SPAN + b"\x05notjs")
+        with pytest.raises(FramingError):
+            unpack_spans(pack_spans([]) + b"trailing")
+
+
+# ---------------------------------------------------------------------------
+# exporter → collector
+
+
+class TestExporterCollector:
+    def test_offer_is_bounded_drops_span_not_frame(self):
+        settings = tel_settings(telemetry_queue_size=16)
+        exporter = SpanExporter(settings, InprocQueueSocketFactory(),
+                                "reader", LABELS)
+        dropped = m.TELEMETRY_EXPORT_DROPPED().labels(**LABELS)
+        before = dropped._value.get()
+        for i in range(20):
+            exporter.offer(i + 1, 0, 1, 2, False, None)
+        assert exporter.backlog == 16
+        assert dropped._value.get() - before == 4
+
+    def test_inproc_flush_assembles_cross_stage_trace(self):
+        factory = InprocQueueSocketFactory()
+        settings = tel_settings(telemetry_addr="inproc://tel-rt",
+                                telemetry_collector_addr="inproc://tel-rt")
+        listener = factory.create("inproc://tel-rt", None, None)
+        listener.recv_timeout = 200
+        collector = TelemetryCollector(settings, factory, labels=LABELS)
+        stages = ["reader", "parser", "detector", "output"]
+        exporters = [SpanExporter(settings, factory, s, LABELS)
+                     for s in stages]
+        t0 = 1_000_000_000
+        for tid in (0x11, 0x22):
+            for i, exp in enumerate(exporters):
+                exp.offer(tid, t0, t0 + i * MS, t0 + (i + 1) * MS,
+                          i == len(exporters) - 1, "tenant-a")
+        # flush through the real inproc socket pair, no sender threads
+        for exp in exporters:
+            assert exp.flush() == 2
+        for _ in range(len(exporters)):
+            collector.ingest_frame(listener.recv())
+        collector.pump(now_ns=t0)
+        snap = collector.snapshot()
+        assert snap["stats"]["assembled"] == 2
+        assert snap["stats"]["kept"] == 2
+        assert snap["stats"]["incomplete"] == 0
+        trace = collector.trace("11")  # short id: left-pads to 16 hex
+        assert trace is not None
+        assert [h["stage"] for h in trace["hops"]] == stages
+        assert trace["verdict"] == "healthy"
+        assert trace["tenant_bucket"] is not None
+        recvs = [h["recv_ns"] for h in trace["hops"]]
+        assert recvs == sorted(recvs)
+        for exp in exporters:
+            exp.stop()
+
+    def test_collector_counts_bad_frames(self):
+        factory = InprocQueueSocketFactory()
+        collector = TelemetryCollector(tel_settings(), factory,
+                                       labels=LABELS)
+        assert collector.ingest_frame(MAGIC_SPAN + b"\x02{]") == 0
+        assert collector.ingest_frame(pack_spans([{"stage": "x"}])) == 0
+        assert collector.snapshot()["stats"]["bad_frames"] == 2
+
+    def test_flag_spans_flow_through_exporter(self):
+        factory = InprocQueueSocketFactory()
+        settings = tel_settings(telemetry_addr="inproc://tel-flag",
+                                telemetry_collector_addr="inproc://tel-flag")
+        listener = factory.create("inproc://tel-flag", None, None)
+        listener.recv_timeout = 200
+        collector = TelemetryCollector(settings, factory, labels=LABELS)
+        exporter = SpanExporter(settings, factory, "detector", LABELS)
+        t0 = 1_000_000_000
+        exporter.offer(0x33, t0, t0, t0 + MS, True, None)
+        exporter.offer_flag(0x33, "quarantined")
+        assert exporter.flush() == 2
+        collector.ingest_frame(listener.recv())
+        collector.pump(now_ns=t0)
+        trace = collector.trace(f"{0x33:016x}")
+        assert trace["flags"] == ["quarantined"]
+        assert trace["verdict"] == "quarantined"
+        exporter.stop()
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+
+
+def test_openmetrics_scrape_carries_trace_exemplar():
+    from prometheus_client import REGISTRY
+    from prometheus_client.openmetrics.exposition import generate_latest
+
+    e2e = m.PIPELINE_E2E_LATENCY().labels(**LABELS)
+    e2e.observe(0.042, {"trace_id": f"{0xdeadbeef:016x}"})
+    text = generate_latest(REGISTRY).decode("utf-8")
+    # the OpenMetrics exemplar suffix: value # {labels} exemplar-value ts
+    pattern = (r'pipeline_e2e_latency_seconds_bucket\{[^}]*\}'
+               r' [0-9.e+]+ # \{trace_id="00000000deadbeef"\} 0\.042')
+    assert re.search(pattern, text), "exemplar missing from scrape"
+
+
+# ---------------------------------------------------------------------------
+# OTLP encoding
+
+
+class TestOtlp:
+    def test_encoder_shape_and_parent_chain(self):
+        trace = built(tid=0xfeed)
+        trace["verdict"] = "healthy"
+        doc = otlp.encode_traces([trace], {"component_id": "t"})
+        spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(spans) == 2
+        for span in spans:
+            assert re.fullmatch(r"[0-9a-f]{32}", span["traceId"])
+            assert re.fullmatch(r"[0-9a-f]{16}", span["spanId"])
+            assert span["startTimeUnixNano"].isdigit()
+            assert span["endTimeUnixNano"].isdigit()
+        assert spans[0]["parentSpanId"] == ""
+        assert spans[1]["parentSpanId"] == spans[0]["spanId"]
+        assert spans[0]["name"] == "reader"
+        assert spans[1]["name"] == "detector"
+        assert all(s["status"]["code"] == 1 for s in spans)
+
+    def test_span_ids_stable_across_exports(self):
+        assert (otlp.span_id("00ab", "reader")
+                == otlp.span_id("00ab", "reader"))
+        assert (otlp.span_id("00ab", "reader")
+                != otlp.span_id("00ab", "detector"))
+
+    def test_error_verdict_sets_status(self):
+        trace = built(flags=["error"])
+        trace["verdict"] = "error"
+        doc = otlp.encode_traces([trace])
+        spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert all(s["status"]["code"] == 2 for s in spans)
+        keys = {a["key"] for s in spans for a in s["attributes"]}
+        assert "detectmate.flag.error" in keys
